@@ -1,0 +1,30 @@
+"""elasticsearch_tpu — a TPU-native search framework.
+
+A from-scratch, TPU-first re-design of the capabilities of
+zhaoweiwang/elasticsearch (an Elasticsearch fork): full-text BM25 search,
+dense-vector kNN, hybrid RRF ranking, an Elasticsearch-shaped REST API,
+sharded distribution over a `jax.sharding.Mesh`, durable segments + WAL.
+
+Architecture (maps to SURVEY.md layer map):
+  rest/       L1  — HTTP API, ES-shaped JSON (ref: server/.../rest/)
+  search/     L2/L6 — query DSL, compiler, coordinator + shard execution
+                     (ref: org.elasticsearch.search, action.search)
+  index/      L5  — mappings, document parsing, tiled columnar segments,
+                     translog WAL, engine (ref: org.elasticsearch.index)
+  analysis/       — Lucene-parity analyzers (ref: index.analysis)
+  models/         — scoring models: BM25, BM25F, kNN similarity, RRF
+                     (ref: Lucene BM25Similarity, VectorSimilarityFunction)
+  ops/            — device kernels: dense scatter-add scoring, top-k,
+                     matmul kNN, Pallas kernels (ref: Lucene scoring loop)
+  parallel/       — mesh, shard_map sharded search, ICI top-k merge
+                     (ref: sharding + transport scatter/gather)
+  cluster/    L3  — cluster state, settings registry, routing
+  utils/          — murmur3 (ES routing parity), SmallFloat norms, io
+
+The on-device data model is dense tiled arrays, not objects: postings are
+(doc_id, tf, norm_byte) int32 tiles of width 128, scored term-at-a-time
+into a dense per-doc accumulator, then `lax.top_k` (which tie-breaks by
+low index = low doc id, matching Lucene's score desc / doc asc order).
+"""
+
+__version__ = "0.1.0"

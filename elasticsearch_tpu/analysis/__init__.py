@@ -1,0 +1,16 @@
+from .analyzer import (
+    Analyzer,
+    AnalysisRegistry,
+    BUILTIN_ANALYZERS,
+    ENGLISH_STOP_WORDS,
+)
+from .tokenizer import StandardTokenizer, Token
+
+__all__ = [
+    "Analyzer",
+    "AnalysisRegistry",
+    "BUILTIN_ANALYZERS",
+    "ENGLISH_STOP_WORDS",
+    "StandardTokenizer",
+    "Token",
+]

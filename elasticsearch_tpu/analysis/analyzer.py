@@ -1,0 +1,196 @@
+"""Analyzers: tokenizer + token-filter chains, with an ES-style registry.
+
+Parity targets: Elasticsearch's AnalysisRegistry / IndexAnalyzers
+(server/.../index/analysis/AnalysisRegistry.java) and the built-in
+analyzers — `standard`, `simple`, `whitespace`, `keyword`, `stop`,
+`english` (modules/analysis-common). The default English stopword set is
+Lucene's EnglishAnalyzer.ENGLISH_STOP_WORDS_SET.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, List, Optional, Sequence
+
+from .porter import porter_stem
+from .tokenizer import (
+    KeywordTokenizer,
+    LetterTokenizer,
+    StandardTokenizer,
+    Token,
+    WhitespaceTokenizer,
+)
+
+# Lucene EnglishAnalyzer.ENGLISH_STOP_WORDS_SET (33 words)
+ENGLISH_STOP_WORDS = frozenset(
+    """a an and are as at be but by for if in into is it no not of on or such
+    that the their then there these they this to was will with""".split()
+)
+
+
+class TokenFilter:
+    def apply(self, tokens: List[Token]) -> List[Token]:  # pragma: no cover
+        raise NotImplementedError
+
+
+class LowercaseFilter(TokenFilter):
+    def apply(self, tokens: List[Token]) -> List[Token]:
+        return [t._replace(text=t.text.lower()) for t in tokens]
+
+
+class StopFilter(TokenFilter):
+    """Removes stopwords; later token *positions are preserved* (position
+    increments), matching Lucene's StopFilter, so phrase positions stay
+    parity-correct."""
+
+    def __init__(self, stopwords: Sequence[str] = ENGLISH_STOP_WORDS):
+        self.stopwords = frozenset(stopwords)
+
+    def apply(self, tokens: List[Token]) -> List[Token]:
+        return [t for t in tokens if t.text not in self.stopwords]
+
+
+class PorterStemFilter(TokenFilter):
+    def apply(self, tokens: List[Token]) -> List[Token]:
+        return [t._replace(text=porter_stem(t.text)) for t in tokens]
+
+
+class PossessiveFilter(TokenFilter):
+    """EnglishPossessiveFilter: strip trailing 's / ’s."""
+
+    def apply(self, tokens: List[Token]) -> List[Token]:
+        out = []
+        for t in tokens:
+            txt = t.text
+            if len(txt) >= 2 and txt[-1] in ("s", "S") and txt[-2] in ("'", "’", "＇"):
+                txt = txt[:-2]
+            out.append(t._replace(text=txt))
+        return out
+
+
+class AsciiFoldingFilter(TokenFilter):
+    """ASCIIFoldingFilter subset: NFKD-decompose and drop combining marks."""
+
+    def apply(self, tokens: List[Token]) -> List[Token]:
+        import unicodedata
+
+        out = []
+        for t in tokens:
+            folded = "".join(
+                c
+                for c in unicodedata.normalize("NFKD", t.text)
+                if not unicodedata.combining(c)
+            )
+            out.append(t._replace(text=folded))
+        return out
+
+
+def _resolve_stopwords(value) -> frozenset:
+    """ES stopwords setting: list of words, or a named set like `_english_`
+    / `_none_`."""
+    if value is None or value == "_english_":
+        return ENGLISH_STOP_WORDS
+    if value == "_none_":
+        return frozenset()
+    if isinstance(value, str):
+        raise ValueError(f"unknown stopwords set [{value}]")
+    return frozenset(value)
+
+
+class Analyzer:
+    def __init__(self, name: str, tokenizer, filters: Sequence[TokenFilter] = ()):
+        self.name = name
+        self.tokenizer = tokenizer
+        self.filters = list(filters)
+
+    def analyze(self, text: str) -> List[Token]:
+        tokens = self.tokenizer.tokenize(text)
+        for f in self.filters:
+            tokens = f.apply(tokens)
+        return tokens
+
+    def terms(self, text: str) -> List[str]:
+        return [t.text for t in self.analyze(text)]
+
+
+def _builtin(name: str) -> Analyzer:
+    if name == "standard":
+        return Analyzer(name, StandardTokenizer(), [LowercaseFilter()])
+    if name == "simple":
+        return Analyzer(name, LetterTokenizer(), [LowercaseFilter()])
+    if name == "whitespace":
+        return Analyzer(name, WhitespaceTokenizer())
+    if name == "keyword":
+        return Analyzer(name, KeywordTokenizer())
+    if name == "stop":
+        return Analyzer(name, LetterTokenizer(), [LowercaseFilter(), StopFilter()])
+    if name == "english":
+        return Analyzer(
+            name,
+            StandardTokenizer(),
+            [
+                PossessiveFilter(),
+                LowercaseFilter(),
+                StopFilter(),
+                PorterStemFilter(),
+            ],
+        )
+    raise ValueError(f"unknown analyzer [{name}]")
+
+
+BUILTIN_ANALYZERS = ("standard", "simple", "whitespace", "keyword", "stop", "english")
+
+
+class AnalysisRegistry:
+    """Per-index analyzer registry; supports custom analyzers from index
+    settings the way ES's AnalysisRegistry.build does (a subset: custom
+    tokenizer + filter chains by name)."""
+
+    _TOKENIZERS: Dict[str, Callable] = {
+        "standard": StandardTokenizer,
+        "whitespace": WhitespaceTokenizer,
+        "letter": LetterTokenizer,
+        "lowercase": LetterTokenizer,
+        "keyword": KeywordTokenizer,
+    }
+    _FILTERS: Dict[str, Callable[[dict], TokenFilter]] = {
+        "lowercase": lambda cfg: LowercaseFilter(),
+        "stop": lambda cfg: StopFilter(_resolve_stopwords(cfg.get("stopwords"))),
+        "porter_stem": lambda cfg: PorterStemFilter(),
+        "stemmer": lambda cfg: PorterStemFilter(),
+        "asciifolding": lambda cfg: AsciiFoldingFilter(),
+        "english_possessive": lambda cfg: PossessiveFilter(),
+    }
+
+    def __init__(self, index_settings: Optional[dict] = None):
+        self._analyzers: Dict[str, Analyzer] = {}
+        settings = (index_settings or {}).get("analysis", {})
+        self._custom = settings.get("analyzer", {})
+        self._custom_filters = settings.get("filter", {})
+
+    def get(self, name: str) -> Analyzer:
+        if name in self._analyzers:
+            return self._analyzers[name]
+        if name in self._custom:
+            a = self._build_custom(name, self._custom[name])
+        else:
+            a = _builtin(name)
+        self._analyzers[name] = a
+        return a
+
+    def _build_custom(self, name: str, cfg: dict) -> Analyzer:
+        if cfg.get("type", "custom") != "custom":
+            return _builtin(cfg["type"])
+        tok_name = cfg.get("tokenizer", "standard")
+        if tok_name not in self._TOKENIZERS:
+            raise ValueError(f"unknown tokenizer [{tok_name}]")
+        tokenizer = self._TOKENIZERS[tok_name]()
+        filters: List[TokenFilter] = []
+        if tok_name == "lowercase":
+            filters.append(LowercaseFilter())
+        for fname in cfg.get("filter", []):
+            fcfg = self._custom_filters.get(fname, {})
+            ftype = fcfg.get("type", fname)
+            if ftype not in self._FILTERS:
+                raise ValueError(f"unknown token filter [{fname}]")
+            filters.append(self._FILTERS[ftype](fcfg))
+        return Analyzer(name, tokenizer, filters)

@@ -1,0 +1,226 @@
+"""Tokenizers with Lucene parity semantics.
+
+Parity target: Lucene's StandardTokenizer (UAX#29 word-break; JFlex grammar
+StandardTokenizerImpl in the Lucene jar), which Elasticsearch's `standard`
+analyzer uses (server/.../index/analysis/, modules/analysis-common).
+
+This is a from-scratch implementation of the UAX#29 subset that matters for
+search corpora:
+
+  - words = runs of letters/digits (AHLetter × Numeric never breaks)
+  - MidLetter / MidNumLet / Single_Quote join letter·letter ("o'neil",
+    "elastic.co" stay single tokens)
+  - MidNum / MidNumLet / Single_Quote join digit·digit ("3.14", "1,000")
+  - ExtendNumLet (connector punctuation, "_") joins at run edges
+    ("foo_bar" is one token)
+  - hyphens and other punctuation break ("wi-fi" → "wi", "fi")
+  - Han and Hiragana ideographs are emitted as single-char tokens,
+    Katakana and Hangul as runs — matching StandardTokenizer's
+    IDEOGRAPHIC/HIRAGANA/KATAKANA/HANGUL token types
+  - combining marks extend the current token
+  - tokens longer than max_token_length (default 255) are split
+"""
+
+from __future__ import annotations
+
+import unicodedata
+from typing import Iterator, List, NamedTuple
+
+# Word-break character classes (subset of UAX#29 relevant to search text)
+_LETTER = 1
+_DIGIT = 2
+_EXTENDNUMLET = 3  # '_' and other connector punctuation
+_MIDLETTER = 4  # joins letter X letter
+_MIDNUM = 5  # joins digit X digit
+_MIDNUMLET = 6  # joins letter X letter and digit X digit ('.', "'", U+2019)
+_EXTEND = 7  # combining marks — extend whatever came before
+_HAN = 8
+_HIRAGANA = 9
+_KATAKANA = 10
+_OTHER = 0
+
+_MIDLETTER_SET = frozenset("··״‧")
+_MIDNUM_SET = frozenset(",٫٬﹐﹔，；")
+_MIDNUMLET_SET = frozenset(".'‘’․﹒＇．")
+
+
+def _classify(ch: str) -> int:
+    if ch.isascii():
+        # fast path for the common case
+        o = ord(ch)
+        if 0x61 <= o <= 0x7A or 0x41 <= o <= 0x5A:
+            return _LETTER
+        if 0x30 <= o <= 0x39:
+            return _DIGIT
+        if ch == "_":
+            return _EXTENDNUMLET
+        if ch == "." or ch == "'":
+            return _MIDNUMLET
+        if ch == ",":
+            return _MIDNUM
+        return _OTHER
+    if ch in _MIDNUMLET_SET:
+        return _MIDNUMLET
+    if ch in _MIDLETTER_SET:
+        return _MIDLETTER
+    if ch in _MIDNUM_SET:
+        return _MIDNUM
+    cat = unicodedata.category(ch)
+    if cat.startswith("L"):
+        cp = ord(ch)
+        # CJK scripts get their own break behavior
+        if 0x4E00 <= cp <= 0x9FFF or 0x3400 <= cp <= 0x4DBF or 0xF900 <= cp <= 0xFAFF:
+            return _HAN
+        if 0x3040 <= cp <= 0x309F:
+            return _HIRAGANA
+        if 0x30A0 <= cp <= 0x30FF or 0x31F0 <= cp <= 0x31FF:
+            return _KATAKANA
+        return _LETTER
+    if cat == "Nd" or cat == "Nl":
+        return _DIGIT
+    if cat == "Pc":
+        return _EXTENDNUMLET
+    if cat in ("Mn", "Mc", "Me"):
+        return _EXTEND
+    return _OTHER
+
+
+class Token(NamedTuple):
+    text: str
+    position: int  # token position (for phrase queries / position increments)
+    start_offset: int
+    end_offset: int
+
+
+# Katakana joins only Katakana (UAX#29 WB13), so it is NOT a word class here;
+# it gets its own run scan below.
+_WORD_CLASSES = frozenset((_LETTER, _DIGIT, _EXTENDNUMLET))
+
+
+class StandardTokenizer:
+    """UAX#29-subset word-break tokenizer (Lucene StandardTokenizer parity)."""
+
+    def __init__(self, max_token_length: int = 255):
+        self.max_token_length = max_token_length
+
+    def tokenize(self, text: str) -> List[Token]:
+        return list(self._iter_tokens(text))
+
+    def _iter_tokens(self, text: str) -> Iterator[Token]:
+        n = len(text)
+        i = 0
+        pos = 0
+        while i < n:
+            cls = _classify(text[i])
+            if cls in (_HAN, _HIRAGANA):
+                # single-char ideographic tokens
+                yield Token(text[i], pos, i, i + 1)
+                pos += 1
+                i += 1
+                continue
+            if cls == _KATAKANA:
+                start = i
+                while i < n and _classify(text[i]) in (_KATAKANA, _EXTEND):
+                    i += 1
+                yield Token(text[start:i], pos, start, i)
+                pos += 1
+                continue
+            if cls not in _WORD_CLASSES:
+                i += 1
+                continue
+            # start of a word run
+            start = i
+            j = i
+            while j < n:
+                c = _classify(text[j])
+                if c in _WORD_CLASSES or c == _EXTEND:
+                    j += 1
+                    continue
+                if c in (_MIDLETTER, _MIDNUM, _MIDNUMLET):
+                    # join only if sandwiched by compatible classes (WB6/7,
+                    # WB11/12): peek previous non-extend and next char
+                    prev = self._prev_base_class(text, j)
+                    nxt = _classify(text[j + 1]) if j + 1 < n else _OTHER
+                    letter_join = (
+                        c in (_MIDLETTER, _MIDNUMLET)
+                        and prev == _LETTER
+                        and nxt == _LETTER
+                    )
+                    digit_join = (
+                        c in (_MIDNUM, _MIDNUMLET)
+                        and prev == _DIGIT
+                        and nxt == _DIGIT
+                    )
+                    if letter_join or digit_join:
+                        j += 2  # consume the mid char and the following base
+                        continue
+                break
+            run = text[start:j]
+            # a token must contain at least one letter/digit (bare "_" or
+            # combining-mark runs are dropped, as Lucene does)
+            if any(ch.isalnum() for ch in run):
+                # split over-long runs like Lucene's maxTokenLength does
+                for k in range(0, len(run), self.max_token_length):
+                    piece = run[k : k + self.max_token_length]
+                    yield Token(piece, pos, start + k, start + k + len(piece))
+                    pos += 1
+            i = j
+
+    @staticmethod
+    def _prev_base_class(text: str, j: int) -> int:
+        k = j - 1
+        while k >= 0:
+            c = _classify(text[k])
+            if c != _EXTEND:
+                return c
+            k -= 1
+        return _OTHER
+
+
+class WhitespaceTokenizer:
+    """Lucene WhitespaceTokenizer: split on Unicode whitespace only."""
+
+    def tokenize(self, text: str) -> List[Token]:
+        out = []
+        pos = 0
+        i = 0
+        n = len(text)
+        while i < n:
+            if text[i].isspace():
+                i += 1
+                continue
+            start = i
+            while i < n and not text[i].isspace():
+                i += 1
+            out.append(Token(text[start:i], pos, start, i))
+            pos += 1
+        return out
+
+
+class LetterTokenizer:
+    """Lucene LetterTokenizer: maximal runs of letters."""
+
+    def tokenize(self, text: str) -> List[Token]:
+        out = []
+        pos = 0
+        i = 0
+        n = len(text)
+        while i < n:
+            if not text[i].isalpha():
+                i += 1
+                continue
+            start = i
+            while i < n and text[i].isalpha():
+                i += 1
+            out.append(Token(text[start:i], pos, start, i))
+            pos += 1
+        return out
+
+
+class KeywordTokenizer:
+    """Entire input as a single token."""
+
+    def tokenize(self, text: str) -> List[Token]:
+        if not text:
+            return []
+        return [Token(text, 0, 0, len(text))]

@@ -1,0 +1,4 @@
+from .murmur3 import murmur3_hash, shard_id
+from .smallfloat import int_to_byte4, byte4_to_int
+
+__all__ = ["murmur3_hash", "shard_id", "int_to_byte4", "byte4_to_int"]

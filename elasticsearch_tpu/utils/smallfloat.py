@@ -1,0 +1,86 @@
+"""Lucene SmallFloat int↔byte4 norm encoding.
+
+Parity target: org.apache.lucene.util.SmallFloat.intToByte4 / byte4ToInt
+(Lucene jar; used by BM25Similarity to store document length in one byte).
+Exact parity matters: BM25 scores are computed from the *decoded* quantized
+length, so using the raw length would silently break recall@1000 parity
+with the reference (SURVEY.md §7 hard parts: analyzer/norm parity).
+
+Encoding: values 0..39 map to themselves; larger values are stored as a
+4-bit-mantissa float (numBits=4, zeroExp=0 in longToInt4), shifted so the
+byte range covers lengths up to ~2^28.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+def _long_to_int4(i: int) -> int:
+    """SmallFloat.longToInt4: monotone map long→4-bit-mantissa 'float'."""
+    if i < 0:
+        raise ValueError("only supports positive values")
+    num_bits = i.bit_length()
+    if num_bits < 4:
+        # subnormal value
+        return i
+    # normal value
+    shift = num_bits - 4
+    # only keep the 5 most significant bits
+    encoded = i >> shift
+    # clear the most significant bit (always 1)
+    encoded &= 0x07
+    # encode the shift, adding 1 because 0 is reserved for subnormal values
+    encoded |= (shift + 1) << 3
+    return encoded
+
+
+def _int4_to_long(i: int) -> int:
+    """SmallFloat.int4ToLong: inverse of longToInt4 (lossy round-trip)."""
+    bits = i & 0x07
+    shift = (i >> 3) - 1
+    if shift == -1:
+        # subnormal value
+        decoded = bits
+    else:
+        # normal value
+        decoded = (bits | 0x08) << shift
+    return decoded
+
+
+MAX_INT4 = _long_to_int4(2**31 - 1)  # = 231
+NUM_FREE_VALUES = 255 - MAX_INT4  # = 24; values below this encode as themselves
+
+
+def int_to_byte4(i: int) -> int:
+    """SmallFloat.intToByte4: int in [0, 2^31) → byte (returned as 0..255)."""
+    if i < 0:
+        raise ValueError("only supports positive values")
+    if i < NUM_FREE_VALUES:
+        return i
+    return NUM_FREE_VALUES + _long_to_int4(i - NUM_FREE_VALUES)
+
+
+def byte4_to_int(b: int) -> int:
+    """SmallFloat.byte4ToInt: byte (0..255) → decoded int."""
+    if b < NUM_FREE_VALUES:
+        return b
+    return NUM_FREE_VALUES + _int4_to_long(b - NUM_FREE_VALUES)
+
+
+# Precomputed 256-entry decode table (BM25Similarity.LENGTH_TABLE analog).
+LENGTH_TABLE = np.array([byte4_to_int(b) for b in range(256)], dtype=np.int64)
+
+
+def encode_norms(lengths: np.ndarray) -> np.ndarray:
+    """Vectorized intToByte4 over an array of field lengths → uint8 norms.
+
+    intToByte4 truncates: encode(x) is the largest byte whose decoded value
+    is <= x. LENGTH_TABLE is strictly increasing, so searchsorted gives the
+    same answer as the scalar routine (property-tested against it).
+    """
+    lengths = np.asarray(lengths, dtype=np.int64)
+    if lengths.size and lengths.min() < 0:
+        raise ValueError("only supports positive values")
+    return (np.searchsorted(LENGTH_TABLE, lengths, side="right") - 1).astype(
+        np.uint8
+    )

@@ -1,0 +1,23 @@
+"""Test config: force an 8-virtual-device CPU mesh before JAX initializes.
+
+Mirrors the reference's InternalTestCluster idea (SURVEY.md §4): multi-"chip"
+tests run in one process on CPU so CI needs no TPU pod. Real-TPU runs happen
+only via bench.py / the driver.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+xla_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in xla_flags:
+    os.environ["XLA_FLAGS"] = (
+        xla_flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import numpy as np
+import pytest
+
+
+@pytest.fixture(autouse=True)
+def _seed():
+    np.random.seed(0)

@@ -1,0 +1,217 @@
+"""Golden-token tests for the analysis chain.
+
+Expected outputs match Lucene's standard analyzer behavior (the hard
+parity requirement from SURVEY.md §7: tokenization differences silently
+destroy recall parity).
+"""
+
+from elasticsearch_tpu.analysis import AnalysisRegistry, StandardTokenizer
+from elasticsearch_tpu.analysis.porter import porter_stem
+
+
+def std_terms(text):
+    return AnalysisRegistry().get("standard").terms(text)
+
+
+class TestStandardTokenizer:
+    def toks(self, text):
+        return [t.text for t in StandardTokenizer().tokenize(text)]
+
+    def test_basic_words(self):
+        assert self.toks("The quick brown fox") == ["The", "quick", "brown", "fox"]
+
+    def test_punctuation_breaks(self):
+        assert self.toks("hello, world!") == ["hello", "world"]
+        assert self.toks("wi-fi router") == ["wi", "fi", "router"]
+        assert self.toks("a+b=c") == ["a", "b", "c"]
+
+    def test_apostrophe_joins_letters(self):
+        assert self.toks("O'Neil's book") == ["O'Neil's", "book"]
+        assert self.toks("don’t") == ["don’t"]
+
+    def test_period_joins_letters_and_digits(self):
+        assert self.toks("visit elastic.co today") == ["visit", "elastic.co", "today"]
+        assert self.toks("pi is 3.14159") == ["pi", "is", "3.14159"]
+        # trailing period is not mid-word
+        assert self.toks("end.") == ["end"]
+        assert self.toks("U.S.A.") == ["U.S.A"]
+
+    def test_comma_joins_digits_only(self):
+        assert self.toks("1,000,000 items") == ["1,000,000", "items"]
+        assert self.toks("a,b") == ["a", "b"]
+
+    def test_underscore_joins(self):
+        assert self.toks("foo_bar baz") == ["foo_bar", "baz"]
+        assert self.toks("snake_case_name") == ["snake_case_name"]
+
+    def test_mixed_alnum(self):
+        assert self.toks("ipv6 2x faster") == ["ipv6", "2x", "faster"]
+        assert self.toks("B2B sales") == ["B2B", "sales"]
+
+    def test_cjk_single_char(self):
+        assert self.toks("日本語") == ["日", "本", "語"]
+
+    def test_katakana_run(self):
+        assert self.toks("カタカナ test") == ["カタカナ", "test"]
+
+    def test_katakana_does_not_merge_with_latin(self):
+        # UAX#29 WB13: Katakana joins only Katakana
+        assert self.toks("テストtest") == ["テスト", "test"]
+        assert self.toks("3カタ") == ["3", "カタ"]
+
+    def test_email_like(self):
+        # standard (not uax_url_email) splits emails at @
+        assert self.toks("user@example.com") == ["user", "example.com"]
+
+    def test_positions_and_offsets(self):
+        toks = StandardTokenizer().tokenize("foo bar baz")
+        assert [(t.position, t.start_offset, t.end_offset) for t in toks] == [
+            (0, 0, 3),
+            (1, 4, 7),
+            (2, 8, 11),
+        ]
+
+    def test_max_token_length_split(self):
+        long = "a" * 300
+        toks = self.toks(long)
+        assert toks == ["a" * 255, "a" * 45]
+
+    def test_empty_and_punct_only(self):
+        assert self.toks("") == []
+        assert self.toks("!!! --- ...") == []
+
+
+class TestAnalyzers:
+    def test_standard_lowercases(self):
+        assert std_terms("Quick BROWN Fox") == ["quick", "brown", "fox"]
+
+    def test_standard_keeps_stopwords(self):
+        # ES standard analyzer has NO stopwords by default
+        assert std_terms("the cat") == ["the", "cat"]
+
+    def test_stop_analyzer(self):
+        reg = AnalysisRegistry()
+        assert reg.get("stop").terms("the quick brown fox") == [
+            "quick",
+            "brown",
+            "fox",
+        ]
+
+    def test_whitespace(self):
+        reg = AnalysisRegistry()
+        assert reg.get("whitespace").terms("Hello, World!") == ["Hello,", "World!"]
+
+    def test_keyword(self):
+        reg = AnalysisRegistry()
+        assert reg.get("keyword").terms("New York") == ["New York"]
+
+    def test_simple(self):
+        reg = AnalysisRegistry()
+        assert reg.get("simple").terms("a1b2 c3") == ["a", "b", "c"]
+
+    def test_english_analyzer(self):
+        reg = AnalysisRegistry()
+        assert reg.get("english").terms("The foxes' running jumps") == [
+            "fox",
+            "run",
+            "jump",
+        ]
+
+    def test_custom_analyzer_from_settings(self):
+        reg = AnalysisRegistry(
+            {
+                "analysis": {
+                    "analyzer": {
+                        "my_analyzer": {
+                            "type": "custom",
+                            "tokenizer": "whitespace",
+                            "filter": ["lowercase"],
+                        }
+                    }
+                }
+            }
+        )
+        assert reg.get("my_analyzer").terms("Hello World") == ["hello", "world"]
+
+
+class TestPorter:
+    def test_known_stems(self):
+        cases = {
+            "caresses": "caress",
+            "ponies": "poni",
+            "ties": "ti",
+            "caress": "caress",
+            "cats": "cat",
+            "feed": "feed",
+            "agreed": "agre",
+            "plastered": "plaster",
+            "bled": "bled",
+            "motoring": "motor",
+            "sing": "sing",
+            "conflated": "conflat",
+            "troubled": "troubl",
+            "sized": "size",
+            "hopping": "hop",
+            "tanned": "tan",
+            "falling": "fall",
+            "hissing": "hiss",
+            "fizzed": "fizz",
+            "failing": "fail",
+            "filing": "file",
+            "happy": "happi",
+            "sky": "sky",
+            "relational": "relat",
+            "conditional": "condit",
+            "rational": "ration",
+            "valenci": "valenc",
+            "hesitanci": "hesit",
+            "digitizer": "digit",
+            "conformabli": "conform",
+            "radicalli": "radic",
+            "differentli": "differ",
+            "vileli": "vile",
+            "analogousli": "analog",
+            "vietnamization": "vietnam",
+            "predication": "predic",
+            "operator": "oper",
+            "feudalism": "feudal",
+            "decisiveness": "decis",
+            "hopefulness": "hope",
+            "callousness": "callous",
+            "formaliti": "formal",
+            "sensitiviti": "sensit",
+            "sensibiliti": "sensibl",
+            "triplicate": "triplic",
+            "formative": "form",
+            "formalize": "formal",
+            "electriciti": "electr",
+            "electrical": "electr",
+            "hopeful": "hope",
+            "goodness": "good",
+            "revival": "reviv",
+            "allowance": "allow",
+            "inference": "infer",
+            "airliner": "airlin",
+            "gyroscopic": "gyroscop",
+            "adjustable": "adjust",
+            "defensible": "defens",
+            "irritant": "irrit",
+            "replacement": "replac",
+            "adjustment": "adjust",
+            "dependent": "depend",
+            "adoption": "adopt",
+            "homologou": "homolog",
+            "communism": "commun",
+            "activate": "activ",
+            "angulariti": "angular",
+            "homologous": "homolog",
+            "effective": "effect",
+            "bowdlerize": "bowdler",
+            "probate": "probat",
+            "rate": "rate",
+            "cease": "ceas",
+            "controll": "control",
+            "roll": "roll",
+        }
+        for word, expected in cases.items():
+            assert porter_stem(word) == expected, word

@@ -1,0 +1,96 @@
+import numpy as np
+import pytest
+
+from elasticsearch_tpu.utils.murmur3 import murmur3_hash, murmurhash3_x86_32, shard_id
+from elasticsearch_tpu.utils.smallfloat import (
+    LENGTH_TABLE,
+    NUM_FREE_VALUES,
+    byte4_to_int,
+    encode_norms,
+    int_to_byte4,
+)
+
+
+class TestMurmur3:
+    def test_known_vectors_raw(self):
+        # Public murmur3_x86_32 test vectors (seed 0).
+        assert murmurhash3_x86_32(b"") == 0
+        assert murmurhash3_x86_32(b"hello") == 0x248BFA47
+        assert murmurhash3_x86_32(b"hello, world") == 0x149BBB7F
+        assert (
+            murmurhash3_x86_32(b"The quick brown fox jumps over the lazy dog")
+            == 0x2E4FF723
+        )
+
+    def test_es_routing_hash_is_utf16le_murmur(self):
+        # ES Murmur3HashFunction hashes the UTF-16 code units as LE byte
+        # pairs with seed 0; for BMP strings that is exactly utf-16-le.
+        for s in ("foo", "hello", "doc-123", "日本語", ""):
+            assert murmur3_hash(s) == murmurhash3_x86_32(s.encode("utf-16-le"))
+
+    def test_shard_id_range_and_determinism(self):
+        for n in (1, 2, 5, 8, 13):
+            for doc_id in ("a", "b", "doc-123", "日本語"):
+                s = shard_id(doc_id, n)
+                assert 0 <= s < n
+                assert s == shard_id(doc_id, n)
+
+    def test_routing_num_shards_defaults(self):
+        # MetadataCreateIndexService.calculateNumRoutingShards (7.0+)
+        from elasticsearch_tpu.utils.murmur3 import calculate_num_routing_shards
+
+        assert calculate_num_routing_shards(1) == 1024
+        assert calculate_num_routing_shards(2) == 1024
+        assert calculate_num_routing_shards(5) == 640
+        assert calculate_num_routing_shards(8) == 1024
+        assert calculate_num_routing_shards(1000) == 2000
+        # shard id uses the routing partition space / routing factor
+        for n in (2, 5, 8):
+            for doc in ("a", "doc-9", "zzz"):
+                assert 0 <= shard_id(doc, n) < n
+
+    def test_negative_hash_floormod(self):
+        neg = [s for s in (f"doc-{i}" for i in range(100)) if murmur3_hash(s) < 0]
+        assert neg  # signed 32-bit output must go negative somewhere
+        for s in neg:
+            assert 0 <= shard_id(s, 5) < 5
+
+
+class TestSmallFloat:
+    def test_free_values_identity(self):
+        assert NUM_FREE_VALUES == 24
+        for i in range(NUM_FREE_VALUES):
+            assert int_to_byte4(i) == i
+            assert byte4_to_int(i) == i
+
+    def test_monotone_and_lossy_floor(self):
+        prev = -1
+        for b in range(256):
+            v = byte4_to_int(b)
+            assert v > prev  # strictly increasing decode table
+            prev = v
+        for x in [0, 1, 23, 24, 25, 50, 100, 255, 1000, 123456, 2**20, 2**30]:
+            b = int_to_byte4(x)
+            assert byte4_to_int(b) <= x
+            if b < 255:
+                assert byte4_to_int(b + 1) > x
+
+    def test_roundtrip_exact_on_table(self):
+        for b in range(256):
+            assert int_to_byte4(byte4_to_int(b)) == b
+
+    def test_encode_norms_matches_scalar(self):
+        xs = np.concatenate(
+            [
+                np.arange(0, 300),
+                np.random.randint(0, 2**28, size=500),
+            ]
+        )
+        vec = encode_norms(xs)
+        for x, b in zip(xs, vec):
+            assert int(b) == int_to_byte4(int(x))
+
+    def test_length_table_head(self):
+        assert LENGTH_TABLE[0] == 0
+        assert LENGTH_TABLE[23] == 23
+        assert LENGTH_TABLE[24] == 24
