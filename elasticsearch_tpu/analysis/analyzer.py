@@ -27,6 +27,45 @@ ENGLISH_STOP_WORDS = frozenset(
 )
 
 
+class CharFilter:
+    """Applied to the raw text before tokenization (Lucene CharFilter).
+    Token offsets are relative to the *filtered* text (the reference keeps
+    offset-correction maps; round 1 does not)."""
+
+    def apply(self, text: str) -> str:  # pragma: no cover
+        raise NotImplementedError
+
+
+class HtmlStripCharFilter(CharFilter):
+    """HTMLStripCharFilter: remove tags, decode entities."""
+
+    _TAG = None
+
+    def apply(self, text: str) -> str:
+        import html
+        import re
+
+        if HtmlStripCharFilter._TAG is None:
+            HtmlStripCharFilter._TAG = re.compile(r"<[^>]*>")
+        return html.unescape(HtmlStripCharFilter._TAG.sub(" ", text))
+
+
+class MappingCharFilter(CharFilter):
+    """MappingCharFilter: literal "from=>to" replacements, longest-first."""
+
+    def __init__(self, mappings: Sequence[str]):
+        pairs = []
+        for m in mappings:
+            src, _, dst = m.partition("=>")
+            pairs.append((src.strip(), dst.strip()))
+        self.pairs = sorted(pairs, key=lambda p: -len(p[0]))
+
+    def apply(self, text: str) -> str:
+        for src, dst in self.pairs:
+            text = text.replace(src, dst)
+        return text
+
+
 class TokenFilter:
     def apply(self, tokens: List[Token]) -> List[Token]:  # pragma: no cover
         raise NotImplementedError
@@ -84,6 +123,17 @@ class AsciiFoldingFilter(TokenFilter):
         return out
 
 
+def _stemmer_for(language: str) -> "PorterStemFilter":
+    """Only English stemming is implemented (Porter, as Lucene's
+    porter_stem / PorterStemFilter). Note ES's `stemmer` filter default
+    for `english` is Porter2 (Snowball); this is classic Porter — a
+    documented round-1 divergence. Unsupported languages raise rather
+    than silently mangling text."""
+    if language in ("english", "porter", "porter2"):
+        return PorterStemFilter()
+    raise ValueError(f"unsupported stemmer language [{language}]")
+
+
 def _resolve_stopwords(value) -> frozenset:
     """ES stopwords setting: list of words, or a named set like `_english_`
     / `_none_`."""
@@ -97,12 +147,21 @@ def _resolve_stopwords(value) -> frozenset:
 
 
 class Analyzer:
-    def __init__(self, name: str, tokenizer, filters: Sequence[TokenFilter] = ()):
+    def __init__(
+        self,
+        name: str,
+        tokenizer,
+        filters: Sequence[TokenFilter] = (),
+        char_filters: Sequence[CharFilter] = (),
+    ):
         self.name = name
         self.tokenizer = tokenizer
         self.filters = list(filters)
+        self.char_filters = list(char_filters)
 
     def analyze(self, text: str) -> List[Token]:
+        for cf in self.char_filters:
+            text = cf.apply(text)
         tokens = self.tokenizer.tokenize(text)
         for f in self.filters:
             tokens = f.apply(tokens)
@@ -156,7 +215,7 @@ class AnalysisRegistry:
         "lowercase": lambda cfg: LowercaseFilter(),
         "stop": lambda cfg: StopFilter(_resolve_stopwords(cfg.get("stopwords"))),
         "porter_stem": lambda cfg: PorterStemFilter(),
-        "stemmer": lambda cfg: PorterStemFilter(),
+        "stemmer": lambda cfg: _stemmer_for(cfg.get("language", "english")),
         "asciifolding": lambda cfg: AsciiFoldingFilter(),
         "english_possessive": lambda cfg: PossessiveFilter(),
     }
@@ -166,6 +225,7 @@ class AnalysisRegistry:
         settings = (index_settings or {}).get("analysis", {})
         self._custom = settings.get("analyzer", {})
         self._custom_filters = settings.get("filter", {})
+        self._custom_char_filters = settings.get("char_filter", {})
 
     def get(self, name: str) -> Analyzer:
         if name in self._analyzers:
@@ -178,8 +238,9 @@ class AnalysisRegistry:
         return a
 
     def _build_custom(self, name: str, cfg: dict) -> Analyzer:
-        if cfg.get("type", "custom") != "custom":
-            return _builtin(cfg["type"])
+        atype = cfg.get("type", "custom")
+        if atype != "custom":
+            return self._build_configured_builtin(name, atype, cfg)
         tok_name = cfg.get("tokenizer", "standard")
         if tok_name not in self._TOKENIZERS:
             raise ValueError(f"unknown tokenizer [{tok_name}]")
@@ -193,4 +254,53 @@ class AnalysisRegistry:
             if ftype not in self._FILTERS:
                 raise ValueError(f"unknown token filter [{fname}]")
             filters.append(self._FILTERS[ftype](fcfg))
-        return Analyzer(name, tokenizer, filters)
+        char_filters = [
+            self._build_char_filter(cf) for cf in cfg.get("char_filter", [])
+        ]
+        return Analyzer(name, tokenizer, filters, char_filters)
+
+    def _build_char_filter(self, ref) -> CharFilter:
+        if isinstance(ref, dict):
+            cfg = ref
+        else:
+            cfg = self._custom_char_filters.get(ref, {"type": ref})
+        ctype = cfg.get("type", ref if isinstance(ref, str) else None)
+        if ctype == "html_strip":
+            return HtmlStripCharFilter()
+        if ctype == "mapping":
+            return MappingCharFilter(cfg.get("mappings", []))
+        raise ValueError(f"unknown char filter [{ref}]")
+
+    @staticmethod
+    def _build_configured_builtin(name: str, atype: str, cfg: dict) -> Analyzer:
+        """Builtin analyzer *types* with per-analyzer settings
+        (e.g. {"type": "standard", "stopwords": [...]})."""
+        stopwords = cfg.get("stopwords")
+        max_len = int(cfg.get("max_token_length", 255))
+        if atype == "standard":
+            filters: List[TokenFilter] = [LowercaseFilter()]
+            if stopwords is not None:
+                filters.append(StopFilter(_resolve_stopwords(stopwords)))
+            return Analyzer(name, StandardTokenizer(max_len), filters)
+        if atype == "stop":
+            return Analyzer(
+                name,
+                LetterTokenizer(),
+                [LowercaseFilter(), StopFilter(_resolve_stopwords(stopwords))],
+            )
+        if atype == "english":
+            return Analyzer(
+                name,
+                StandardTokenizer(max_len),
+                [
+                    PossessiveFilter(),
+                    LowercaseFilter(),
+                    StopFilter(_resolve_stopwords(stopwords)),
+                    PorterStemFilter(),
+                ],
+            )
+        if stopwords is not None or "max_token_length" in cfg:
+            raise ValueError(
+                f"analyzer type [{atype}] does not support the given settings"
+            )
+        return _builtin(atype)

@@ -68,8 +68,14 @@ def _classify(ch: str) -> int:
     cat = unicodedata.category(ch)
     if cat.startswith("L"):
         cp = ord(ch)
-        # CJK scripts get their own break behavior
-        if 0x4E00 <= cp <= 0x9FFF or 0x3400 <= cp <= 0x4DBF or 0xF900 <= cp <= 0xFAFF:
+        # CJK scripts get their own break behavior (incl. supplementary-plane
+        # ideographs: Ext B..H at U+20000.. and compatibility U+2F800..)
+        if (
+            0x4E00 <= cp <= 0x9FFF
+            or 0x3400 <= cp <= 0x4DBF
+            or 0xF900 <= cp <= 0xFAFF
+            or 0x20000 <= cp <= 0x3FFFF
+        ):
             return _HAN
         if 0x3040 <= cp <= 0x309F:
             return _HIRAGANA
@@ -122,8 +128,11 @@ class StandardTokenizer:
                 start = i
                 while i < n and _classify(text[i]) in (_KATAKANA, _EXTEND):
                     i += 1
-                yield Token(text[start:i], pos, start, i)
-                pos += 1
+                run = text[start:i]
+                for k in range(0, len(run), self.max_token_length):
+                    piece = run[k : k + self.max_token_length]
+                    yield Token(piece, pos, start + k, start + k + len(piece))
+                    pos += 1
                 continue
             if cls not in _WORD_CLASSES:
                 i += 1

@@ -1,0 +1,335 @@
+"""Field mappings and document parsing.
+
+Parity targets: org.elasticsearch.index.mapper — MapperService (mapping
+merge), DocumentParser.parseDocument (JSON doc → indexable fields),
+TextFieldMapper / KeywordFieldMapper / NumberFieldMapper /
+BooleanFieldMapper / DateFieldMapper / DenseVectorFieldMapper
+(server/src/main/java/org/elasticsearch/index/mapper/, .../mapper/vectors/).
+
+Unlike the reference's per-field Lucene IndexableField objects, parsing
+here produces columnar-friendly intermediates: term lists with positions
+(text), exact terms (keyword), numeric doc values, and dense vectors —
+inputs to the tiled segment builder (segment.py).
+"""
+
+from __future__ import annotations
+
+import datetime as _dt
+import math
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Tuple
+
+from ..analysis import AnalysisRegistry
+
+TEXT = "text"
+KEYWORD = "keyword"
+LONG = "long"
+INTEGER = "integer"
+SHORT = "short"
+BYTE = "byte"
+DOUBLE = "double"
+FLOAT = "float"
+HALF_FLOAT = "half_float"
+BOOLEAN = "boolean"
+DATE = "date"
+DENSE_VECTOR = "dense_vector"
+
+NUMERIC_TYPES = (LONG, INTEGER, SHORT, BYTE, DOUBLE, FLOAT, HALF_FLOAT)
+_INT_TYPES = (LONG, INTEGER, SHORT, BYTE)
+
+
+@dataclass
+class MappedField:
+    name: str  # full dotted path
+    type: str
+    analyzer: str = "standard"
+    search_analyzer: Optional[str] = None
+    index: bool = True
+    doc_values: bool = True
+    boost: float = 1.0
+    # dense_vector options
+    dims: int = 0
+    similarity: str = "cosine"  # cosine | dot_product | l2_norm
+    # date format (subset: epoch_millis and ISO handled)
+    format: Optional[str] = None
+    # keyword ignore_above
+    ignore_above: Optional[int] = None
+
+    def is_numeric(self) -> bool:
+        return self.type in NUMERIC_TYPES or self.type in (DATE, BOOLEAN)
+
+
+class MappingParseError(ValueError):
+    pass
+
+
+class Mappings:
+    """Parsed index mappings: flat dotted-path → MappedField registry, plus
+    dynamic mapping of unseen fields (ES default dynamic:true semantics:
+    strings → text + .keyword subfield, ints → long, floats → float,
+    bools → boolean)."""
+
+    def __init__(self, mapping_json: Optional[dict] = None, dynamic: bool = True):
+        self.fields: Dict[str, MappedField] = {}
+        self.dynamic = dynamic
+        mapping_json = mapping_json or {}
+        if "dynamic" in mapping_json:
+            self.dynamic = mapping_json["dynamic"] not in (False, "false", "strict")
+            self.strict = mapping_json["dynamic"] == "strict"
+        else:
+            self.strict = False
+        self._parse_properties(mapping_json.get("properties", {}), prefix="")
+
+    def _parse_properties(self, props: dict, prefix: str):
+        for name, cfg in props.items():
+            path = f"{prefix}{name}"
+            if "properties" in cfg and "type" not in cfg:
+                # object field
+                self._parse_properties(cfg["properties"], prefix=f"{path}.")
+                continue
+            ftype = cfg.get("type", "object")
+            if ftype == "object":
+                self._parse_properties(cfg.get("properties", {}), prefix=f"{path}.")
+                continue
+            self._add_field(path, ftype, cfg)
+            for sub, subcfg in cfg.get("fields", {}).items():
+                self._add_field(f"{path}.{sub}", subcfg.get("type", KEYWORD), subcfg)
+
+    def _add_field(self, path: str, ftype: str, cfg: dict):
+        known = (TEXT, KEYWORD, BOOLEAN, DATE, DENSE_VECTOR) + NUMERIC_TYPES
+        if ftype not in known:
+            raise MappingParseError(f"No handler for type [{ftype}] declared on field [{path}]")
+        f = MappedField(
+            name=path,
+            type=ftype,
+            analyzer=cfg.get("analyzer", "standard"),
+            search_analyzer=cfg.get("search_analyzer"),
+            index=cfg.get("index", True),
+            doc_values=cfg.get("doc_values", True),
+            boost=float(cfg.get("boost", 1.0)),
+            dims=int(cfg.get("dims", 0)),
+            similarity=cfg.get("similarity", "cosine"),
+            format=cfg.get("format"),
+            ignore_above=cfg.get("ignore_above"),
+        )
+        if ftype == DENSE_VECTOR and f.dims <= 0:
+            # ES infers dims from the first vector if unset; we allow that too
+            f.dims = int(cfg.get("dims", 0))
+        self.fields[path] = f
+
+    def get(self, name: str) -> Optional[MappedField]:
+        return self.fields.get(name)
+
+    def dynamic_map(self, name: str, value: Any) -> Optional[MappedField]:
+        """ES dynamic-mapping rules for an unseen field."""
+        if not self.dynamic:
+            if self.strict:
+                raise MappingParseError(
+                    f"mapping set to strict, dynamic introduction of [{name}] is not allowed"
+                )
+            return None
+        if isinstance(value, bool):
+            ftype = BOOLEAN
+        elif isinstance(value, int):
+            ftype = LONG
+        elif isinstance(value, float):
+            ftype = FLOAT
+        elif isinstance(value, str):
+            # ES maps strings to text with a .keyword multi-field
+            self._add_field(name, TEXT, {})
+            self._add_field(f"{name}.keyword", KEYWORD, {"ignore_above": 256})
+            return self.fields[name]
+        else:
+            return None
+        self._add_field(name, ftype, {})
+        return self.fields[name]
+
+    def merge(self, mapping_json: dict):
+        """MapperService.merge subset: add new fields; reject type changes."""
+        other = Mappings(mapping_json)
+        for name, f in other.fields.items():
+            mine = self.fields.get(name)
+            if mine is not None and mine.type != f.type:
+                raise MappingParseError(
+                    f"mapper [{name}] cannot be changed from type [{mine.type}] "
+                    f"to [{f.type}]"
+                )
+            self.fields[name] = f
+
+    def to_json(self) -> dict:
+        props: dict = {}
+        for name, f in sorted(self.fields.items()):
+            parts = name.split(".")
+            # reconstruct nested properties; multi-fields are flattened here
+            # (fidelity-enough for GET _mapping round-trips in round 1)
+            node = props
+            for p in parts[:-1]:
+                node = node.setdefault(p, {"properties": {}})["properties"]
+            entry: dict = {"type": f.type}
+            if f.type == TEXT and f.analyzer != "standard":
+                entry["analyzer"] = f.analyzer
+            if f.type == DENSE_VECTOR:
+                entry["dims"] = f.dims
+                entry["similarity"] = f.similarity
+            node[parts[-1]] = entry
+        return {"properties": props}
+
+
+@dataclass
+class ParsedDocument:
+    """Columnar-friendly parse result for one document."""
+
+    doc_id: str  # _id
+    source: dict
+    # field → list of (term, position) for indexed text fields
+    text_terms: Dict[str, List[Tuple[str, int]]] = field(default_factory=dict)
+    # field → exact terms (keyword); list to support arrays
+    keyword_terms: Dict[str, List[str]] = field(default_factory=dict)
+    # field → numeric doc value(s) as float64-compatible numbers
+    numeric_values: Dict[str, List[float]] = field(default_factory=dict)
+    # field → vector
+    vectors: Dict[str, List[float]] = field(default_factory=dict)
+    # field → field length (token count incl. duplicates) for norms
+    field_lengths: Dict[str, int] = field(default_factory=dict)
+
+
+def parse_date_millis(value: Any, fmt: Optional[str] = None) -> float:
+    """Date → epoch millis. Supports epoch_millis numbers and ISO-8601."""
+    if isinstance(value, (int, float)) and not isinstance(value, bool):
+        return float(value)
+    s = str(value)
+    if s.isdigit():
+        return float(int(s))
+    iso = s.replace("Z", "+00:00")
+    try:
+        dt = _dt.datetime.fromisoformat(iso)
+    except ValueError as e:
+        raise MappingParseError(f"failed to parse date field [{value}]") from e
+    if dt.tzinfo is None:
+        dt = dt.replace(tzinfo=_dt.timezone.utc)
+    return dt.timestamp() * 1000.0
+
+
+class DocumentParser:
+    """DocumentParser.parseDocument analog: walks the source JSON, resolves
+    each leaf against the mappings (dynamically mapping unseen fields), and
+    emits analyzer output / doc values / vectors."""
+
+    def __init__(self, mappings: Mappings, analysis: AnalysisRegistry):
+        self.mappings = mappings
+        self.analysis = analysis
+
+    def parse(self, doc_id: str, source: dict) -> ParsedDocument:
+        out = ParsedDocument(doc_id=doc_id, source=source)
+        self._walk(source, "", out)
+        return out
+
+    def _walk(self, obj: Any, prefix: str, out: ParsedDocument):
+        for key, value in obj.items():
+            path = f"{prefix}{key}"
+            if isinstance(value, dict):
+                f = self.mappings.get(path)
+                if f is not None and f.type == DENSE_VECTOR:
+                    raise MappingParseError(
+                        f"dense_vector field [{path}] must be an array of numbers"
+                    )
+                self._walk(value, f"{path}.", out)
+                continue
+            values = value if isinstance(value, list) else [value]
+            if not values:
+                continue
+            f = self.mappings.get(path)
+            if f is None:
+                probe = values[0]
+                if isinstance(probe, (int, float, str, bool)):
+                    f = self.mappings.dynamic_map(path, probe)
+                elif probe is None:
+                    continue
+                else:
+                    continue
+            if f is None:
+                continue
+            self._index_values(f, path, values, out)
+            # multi-fields (e.g. text's .keyword sub-field): mapping entries
+            # one dot below a leaf field are sub-fields of it, not object
+            # children (objects never coexist with a leaf at the same path)
+            for sub_path, sub in self.mappings.fields.items():
+                if (
+                    sub_path != path
+                    and sub_path.startswith(path + ".")
+                    and "." not in sub_path[len(path) + 1 :]
+                ):
+                    self._index_values(sub, sub_path, values, out)
+
+    def _index_values(self, f: MappedField, path: str, values: List[Any], out: ParsedDocument):
+        if f.type == TEXT:
+            if not f.index:
+                return
+            analyzer = self.analysis.get(f.analyzer)
+            terms = out.text_terms.setdefault(path, [])
+            pos = (max(p for _, p in terms) + 101) if terms else 0
+            length = out.field_lengths.get(path, 0)
+            for v in values:
+                if v is None:
+                    continue
+                toks = analyzer.analyze(str(v))
+                for t in toks:
+                    terms.append((t.text, pos + t.position))
+                if toks:
+                    pos += toks[-1].position + 101  # ES position_increment_gap=100
+                length += len(toks)
+            out.field_lengths[path] = length
+        elif f.type == KEYWORD:
+            kws = out.keyword_terms.setdefault(path, [])
+            for v in values:
+                if v is None:
+                    continue
+                s = str(v) if not isinstance(v, bool) else ("true" if v else "false")
+                if f.ignore_above is not None and len(s) > f.ignore_above:
+                    continue
+                kws.append(s)
+        elif f.type in NUMERIC_TYPES:
+            nums = out.numeric_values.setdefault(path, [])
+            for v in values:
+                if v is None:
+                    continue
+                try:
+                    x = float(v)
+                except (TypeError, ValueError) as e:
+                    raise MappingParseError(
+                        f"failed to parse field [{path}] of type [{f.type}]"
+                    ) from e
+                if f.type in _INT_TYPES and not isinstance(v, bool):
+                    x = float(int(x))
+                if math.isnan(x) or math.isinf(x):
+                    raise MappingParseError(f"illegal value for field [{path}]: {v}")
+                nums.append(x)
+        elif f.type == BOOLEAN:
+            nums = out.numeric_values.setdefault(path, [])
+            for v in values:
+                if v is None:
+                    continue
+                if isinstance(v, bool):
+                    nums.append(1.0 if v else 0.0)
+                elif v in ("true", "false", ""):
+                    nums.append(1.0 if v == "true" else 0.0)
+                else:
+                    raise MappingParseError(
+                        f"Failed to parse value [{v}] as only [true] or [false] are allowed."
+                    )
+        elif f.type == DATE:
+            nums = out.numeric_values.setdefault(path, [])
+            for v in values:
+                if v is None:
+                    continue
+                nums.append(parse_date_millis(v, f.format))
+        elif f.type == DENSE_VECTOR:
+            vec = [float(x) for x in values]
+            if f.dims and len(vec) != f.dims:
+                raise MappingParseError(
+                    f"The [{path}] field has dims [{f.dims}] but the indexed "
+                    f"vector has [{len(vec)}] dimensions"
+                )
+            if not f.dims:
+                f.dims = len(vec)
+            out.vectors[path] = vec

@@ -1,0 +1,44 @@
+"""Dense-vector similarity scoring.
+
+Parity target: Lucene VectorSimilarityFunction as mapped by ES's
+DenseVectorFieldMapper (server/.../index/mapper/vectors/
+DenseVectorFieldMapper.java):
+
+  cosine      → (1 + cos(q, d)) / 2
+  dot_product → (1 + dot(q, d)) / 2        (vectors must be unit length)
+  l2_norm     → 1 / (1 + ||q - d||²)
+  max_inner_product → dot < 0 ? 1/(1-dot) : dot + 1
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+SIMILARITIES = ("cosine", "dot_product", "l2_norm", "max_inner_product")
+
+
+def score_vectors(query: np.ndarray, vectors: np.ndarray, similarity: str,
+                  unit_vectors: np.ndarray | None = None) -> np.ndarray:
+    """Scores query (d,) against vectors (N, d) → float32[N]."""
+    q = np.asarray(query, dtype=np.float32)
+    if similarity == "cosine":
+        mats = unit_vectors if unit_vectors is not None else _unit(vectors)
+        qn = np.linalg.norm(q)
+        qu = q / (qn if qn else 1.0)
+        cos = mats @ qu
+        return ((1.0 + cos) / 2.0).astype(np.float32)
+    if similarity == "dot_product":
+        dot = vectors @ q
+        return ((1.0 + dot) / 2.0).astype(np.float32)
+    if similarity == "l2_norm":
+        d2 = ((vectors - q[None, :]) ** 2).sum(axis=1)
+        return (1.0 / (1.0 + d2)).astype(np.float32)
+    if similarity == "max_inner_product":
+        dot = vectors @ q
+        return np.where(dot < 0, 1.0 / (1.0 - dot), dot + 1.0).astype(np.float32)
+    raise ValueError(f"unknown similarity [{similarity}]")
+
+
+def _unit(vectors: np.ndarray) -> np.ndarray:
+    norms = np.linalg.norm(vectors, axis=1, keepdims=True)
+    return vectors / np.where(norms == 0, 1.0, norms)

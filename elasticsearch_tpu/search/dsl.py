@@ -1,0 +1,326 @@
+"""Query DSL: JSON → query node tree.
+
+Parity target: org.elasticsearch.index.query — AbstractQueryBuilder
+parsing and the concrete builders (MatchQueryBuilder, BoolQueryBuilder,
+TermQueryBuilder, TermsQueryBuilder, MultiMatchQueryBuilder,
+RangeQueryBuilder, ExistsQueryBuilder, MatchAllQueryBuilder,
+ConstantScoreQueryBuilder, MatchPhraseQueryBuilder), plus the top-level
+`knn` search section (KnnSearchBuilder, server/.../search/vectors/).
+
+The tree is executor-agnostic; both the NumPy oracle and the JAX executor
+walk it producing dense (match-mask, score) pairs per segment — the
+TPU-native replacement for Lucene's Weight/Scorer pull iterators.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field as dc_field
+from typing import Any, Dict, List, Optional
+
+
+class QueryParseError(ValueError):
+    pass
+
+
+@dataclass
+class Query:
+    boost: float = 1.0
+
+
+@dataclass
+class MatchAllQuery(Query):
+    pass
+
+
+@dataclass
+class MatchNoneQuery(Query):
+    pass
+
+
+@dataclass
+class MatchQuery(Query):
+    field: str = ""
+    query: str = ""
+    operator: str = "or"  # or | and
+    minimum_should_match: Optional[str] = None
+    analyzer: Optional[str] = None
+
+
+@dataclass
+class MatchPhraseQuery(Query):
+    field: str = ""
+    query: str = ""
+    slop: int = 0
+    analyzer: Optional[str] = None
+
+
+@dataclass
+class TermQuery(Query):
+    field: str = ""
+    value: Any = None
+
+
+@dataclass
+class TermsQuery(Query):
+    field: str = ""
+    values: List[Any] = dc_field(default_factory=list)
+
+
+@dataclass
+class RangeQuery(Query):
+    field: str = ""
+    gte: Any = None
+    gt: Any = None
+    lte: Any = None
+    lt: Any = None
+
+
+@dataclass
+class ExistsQuery(Query):
+    field: str = ""
+
+
+@dataclass
+class MultiMatchQuery(Query):
+    query: str = ""
+    fields: List[str] = dc_field(default_factory=list)  # may carry ^boost
+    type: str = "best_fields"  # best_fields | most_fields | cross_fields
+    operator: str = "or"
+    tie_breaker: float = 0.0
+
+
+@dataclass
+class BoolQuery(Query):
+    must: List[Query] = dc_field(default_factory=list)
+    should: List[Query] = dc_field(default_factory=list)
+    filter: List[Query] = dc_field(default_factory=list)
+    must_not: List[Query] = dc_field(default_factory=list)
+    minimum_should_match: Optional[Any] = None
+
+
+@dataclass
+class ConstantScoreQuery(Query):
+    filter_query: Query = None  # type: ignore[assignment]
+
+
+@dataclass
+class FunctionScoreQuery(Query):
+    query: Query = None  # type: ignore[assignment]
+    # round 1: weight-only function_score
+
+
+@dataclass
+class KnnSection:
+    """Top-level `knn` search element (can also appear as a query clause)."""
+
+    field: str
+    query_vector: List[float]
+    k: int = 10
+    num_candidates: int = 100
+    filter: Optional[Query] = None
+    boost: float = 1.0
+    similarity: Optional[float] = None  # min-similarity cutoff
+
+
+_SINGLE_KEY_ERR = "[%s] query malformed, no start_object after query name"
+
+
+def parse_query(body: Any) -> Query:
+    """Parses one query object ({"match": {...}} etc.)."""
+    if not isinstance(body, dict) or len(body) != 1:
+        if isinstance(body, dict) and len(body) == 0:
+            raise QueryParseError("query malformed, empty clause found")
+        raise QueryParseError(
+            "[bool] malformed query, expected a single query name"
+        )
+    name, params = next(iter(body.items()))
+    parser = _PARSERS.get(name)
+    if parser is None:
+        raise QueryParseError(f"unknown query [{name}]")
+    return parser(params)
+
+
+def _field_params(params: dict, qname: str) -> tuple:
+    if not isinstance(params, dict) or len(params) != 1:
+        raise QueryParseError(f"[{qname}] query doesn't support multiple fields")
+    fname, cfg = next(iter(params.items()))
+    return fname, cfg
+
+
+def _parse_match(params):
+    fname, cfg = _field_params(params, "match")
+    if isinstance(cfg, dict):
+        return MatchQuery(
+            field=fname,
+            query=str(cfg.get("query", "")),
+            operator=str(cfg.get("operator", "or")).lower(),
+            minimum_should_match=cfg.get("minimum_should_match"),
+            analyzer=cfg.get("analyzer"),
+            boost=float(cfg.get("boost", 1.0)),
+        )
+    return MatchQuery(field=fname, query=str(cfg))
+
+
+def _parse_match_phrase(params):
+    fname, cfg = _field_params(params, "match_phrase")
+    if isinstance(cfg, dict):
+        return MatchPhraseQuery(
+            field=fname,
+            query=str(cfg.get("query", "")),
+            slop=int(cfg.get("slop", 0)),
+            analyzer=cfg.get("analyzer"),
+            boost=float(cfg.get("boost", 1.0)),
+        )
+    return MatchPhraseQuery(field=fname, query=str(cfg))
+
+
+def _parse_term(params):
+    fname, cfg = _field_params(params, "term")
+    if isinstance(cfg, dict):
+        return TermQuery(
+            field=fname, value=cfg.get("value"), boost=float(cfg.get("boost", 1.0))
+        )
+    return TermQuery(field=fname, value=cfg)
+
+
+def _parse_terms(params):
+    params = dict(params)
+    boost = float(params.pop("boost", 1.0))
+    if len(params) != 1:
+        raise QueryParseError("[terms] query requires exactly one field")
+    fname, values = next(iter(params.items()))
+    if not isinstance(values, list):
+        raise QueryParseError("[terms] query requires an array of values")
+    return TermsQuery(field=fname, values=values, boost=boost)
+
+
+def _parse_range(params):
+    fname, cfg = _field_params(params, "range")
+    if not isinstance(cfg, dict):
+        raise QueryParseError("[range] query malformed")
+    known = {"gte", "gt", "lte", "lt", "boost", "format", "relation", "time_zone"}
+    for k in cfg:
+        if k not in known:
+            raise QueryParseError(f"[range] query does not support [{k}]")
+    return RangeQuery(
+        field=fname,
+        gte=cfg.get("gte"),
+        gt=cfg.get("gt"),
+        lte=cfg.get("lte"),
+        lt=cfg.get("lt"),
+        boost=float(cfg.get("boost", 1.0)),
+    )
+
+
+def _parse_exists(params):
+    if "field" not in params:
+        raise QueryParseError("[exists] query requires [field]")
+    return ExistsQuery(field=params["field"], boost=float(params.get("boost", 1.0)))
+
+
+def _parse_multi_match(params):
+    if "query" not in params:
+        raise QueryParseError("[multi_match] query requires [query]")
+    return MultiMatchQuery(
+        query=str(params["query"]),
+        fields=list(params.get("fields", [])),
+        type=params.get("type", "best_fields"),
+        operator=str(params.get("operator", "or")).lower(),
+        tie_breaker=float(params.get("tie_breaker", 0.0)),
+        boost=float(params.get("boost", 1.0)),
+    )
+
+
+def _as_list(v):
+    return v if isinstance(v, list) else [v]
+
+
+def _parse_bool(params):
+    return BoolQuery(
+        must=[parse_query(q) for q in _as_list(params.get("must", []))],
+        should=[parse_query(q) for q in _as_list(params.get("should", []))],
+        filter=[parse_query(q) for q in _as_list(params.get("filter", []))],
+        must_not=[parse_query(q) for q in _as_list(params.get("must_not", []))],
+        minimum_should_match=params.get("minimum_should_match"),
+        boost=float(params.get("boost", 1.0)),
+    )
+
+
+def _parse_constant_score(params):
+    if "filter" not in params:
+        raise QueryParseError("[constant_score] requires a filter")
+    return ConstantScoreQuery(
+        filter_query=parse_query(params["filter"]),
+        boost=float(params.get("boost", 1.0)),
+    )
+
+
+def _parse_match_all(params):
+    params = params or {}
+    return MatchAllQuery(boost=float(params.get("boost", 1.0)))
+
+
+def _parse_match_none(params):
+    return MatchNoneQuery()
+
+
+def _parse_knn_query(params):
+    return KnnQueryWrapper(parse_knn(params))
+
+
+@dataclass
+class KnnQueryWrapper(Query):
+    """`knn` used as a query clause (ES 8.12+)."""
+
+    knn: KnnSection = None  # type: ignore[assignment]
+
+
+def parse_knn(params: dict) -> KnnSection:
+    if "field" not in params or "query_vector" not in params:
+        raise QueryParseError("[knn] requires [field] and [query_vector]")
+    k = int(params.get("k", 10))
+    return KnnSection(
+        field=params["field"],
+        query_vector=[float(x) for x in params["query_vector"]],
+        k=k,
+        num_candidates=int(params.get("num_candidates", max(100, k))),
+        filter=parse_query(params["filter"]) if params.get("filter") else None,
+        boost=float(params.get("boost", 1.0)),
+        similarity=params.get("similarity"),
+    )
+
+
+_PARSERS = {
+    "match": _parse_match,
+    "match_phrase": _parse_match_phrase,
+    "term": _parse_term,
+    "terms": _parse_terms,
+    "range": _parse_range,
+    "exists": _parse_exists,
+    "multi_match": _parse_multi_match,
+    "bool": _parse_bool,
+    "constant_score": _parse_constant_score,
+    "match_all": _parse_match_all,
+    "match_none": _parse_match_none,
+    "knn": _parse_knn_query,
+}
+
+
+def parse_minimum_should_match(msm: Any, num_clauses: int) -> int:
+    """Lucene Queries.calculateMinShouldMatch subset: integers, negatives,
+    and percentages (incl. negative percentages)."""
+    if msm is None:
+        return 0
+    s = str(msm).strip()
+    try:
+        if s.endswith("%"):
+            pct = float(s[:-1])
+            if pct < 0:
+                return num_clauses - int(-pct / 100.0 * num_clauses)
+            return int(pct / 100.0 * num_clauses)
+        v = int(s)
+        if v < 0:
+            return max(0, num_clauses + v)
+        return min(v, num_clauses)
+    except ValueError as e:
+        raise QueryParseError(f"invalid minimum_should_match [{msm}]") from e

@@ -1,0 +1,637 @@
+"""Shard-level query execution — NumPy oracle executor.
+
+Reference analog: the QueryPhase hot path — SearchService.executeQueryPhase
+→ QueryPhase.execute → ContextIndexSearcher.search with Lucene
+Weight/Scorer iterators (server/.../search/query/QueryPhase.java).
+
+Execution model (TPU-native, shared by this oracle and the JAX executor in
+ops/): every query node evaluates to a dense pair over a segment's docs —
+(match_mask: bool[N], scores: float32[N]) — composed with elementwise
+AND/OR/sum instead of Lucene's doc-at-a-time iterator trees. The NumPy
+version is the *semantics oracle*: the JAX/Pallas path must match it
+exactly (tests enforce parity), and it doubles as the measured CPU
+baseline for BASELINE.md.
+
+Lucene semantics honored here:
+  - shard-level term statistics (df, ttf summed across segments, deletes
+    ignored) feed idf/avgdl — as IndexSearcher collectionStatistics does;
+  - fields with omitted norms (keyword) score with encodedNorm == 1;
+  - bool minimum_should_match defaults: 1 when no must/filter, else 0;
+  - top-k ordering is (score desc, global doc asc), global doc order =
+    segment order × local doc id (Lucene docBase);
+  - match_phrase is evaluated as a conjunction then position-verified
+    against re-analyzed stored source (positions are not yet columnar;
+    see ROADMAP).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ..analysis import AnalysisRegistry
+from ..index.mapping import (
+    DENSE_VECTOR,
+    KEYWORD,
+    TEXT,
+    DATE,
+    BOOLEAN,
+    Mappings,
+    parse_date_millis,
+)
+from ..index.segment import Segment
+from ..models import bm25
+from ..models.similarity import score_vectors
+from . import dsl
+from .dsl import (
+    BoolQuery,
+    ConstantScoreQuery,
+    ExistsQuery,
+    KnnQueryWrapper,
+    KnnSection,
+    MatchAllQuery,
+    MatchNoneQuery,
+    MatchPhraseQuery,
+    MatchQuery,
+    MultiMatchQuery,
+    Query,
+    QueryParseError,
+    RangeQuery,
+    TermQuery,
+    TermsQuery,
+)
+
+
+@dataclass
+class Hit:
+    score: float
+    segment: int
+    local_doc: int
+    doc_id: str
+
+
+@dataclass
+class TopDocs:
+    total: int
+    hits: List[Hit]
+    max_score: Optional[float] = None
+
+
+class ShardReader:
+    """A point-in-time view over a shard's segments (ReaderContext analog)."""
+
+    def __init__(
+        self,
+        segments: List[Segment],
+        mappings: Mappings,
+        analysis: AnalysisRegistry,
+        live_docs: Optional[List[Optional[np.ndarray]]] = None,
+    ):
+        self.segments = segments
+        self.mappings = mappings
+        self.analysis = analysis
+        self.live_docs = live_docs or [None] * len(segments)
+
+    # ---- shard-level statistics (IndexSearcher.collectionStatistics) ----
+
+    def field_stats(self, field: str) -> Tuple[int, int]:
+        """(doc_count, sum_total_term_freq) across segments."""
+        dc = 0
+        ttf = 0
+        for seg in self.segments:
+            pf = seg.postings.get(field)
+            if pf is not None:
+                dc += pf.stats.doc_count
+                ttf += pf.stats.sum_total_term_freq
+        return dc, ttf
+
+    def term_stats(self, field: str, term: str) -> Tuple[int, int]:
+        """(doc_freq, total_term_freq) across segments (deletes ignored,
+        as Lucene does)."""
+        df = 0
+        ttf = 0
+        for seg in self.segments:
+            pf = seg.postings.get(field)
+            if pf is None:
+                continue
+            tid = pf.term_id(term)
+            if tid >= 0:
+                df += int(pf.term_df[tid])
+                ttf += int(pf.term_total_tf[tid])
+        return df, ttf
+
+    def num_docs(self) -> int:
+        return sum(s.num_docs for s in self.segments)
+
+
+class NumpyExecutor:
+    """The oracle: executes a query tree densely per segment."""
+
+    def __init__(self, reader: ShardReader, k1: float = bm25.DEFAULT_K1, b: float = bm25.DEFAULT_B):
+        self.reader = reader
+        self.k1 = k1
+        self.b = b
+        self._weight_cache: Dict[Tuple[str, str], float] = {}
+        self._norm_cache: Dict[str, np.ndarray] = {}
+
+    # ---- term weight / norm cache (BM25Similarity.scorer) ----
+
+    def _field_cache(self, field: str) -> np.ndarray:
+        cache = self._norm_cache.get(field)
+        if cache is None:
+            dc, ttf = self.reader.field_stats(field)
+            avgdl = bm25.avg_field_length(ttf, dc)
+            cache = bm25.norm_inverse_cache(avgdl, self.k1, self.b)
+            self._norm_cache[field] = cache
+        return cache
+
+    def _term_weight(self, field: str, term: str) -> float:
+        key = (field, term)
+        w = self._weight_cache.get(key)
+        if w is None:
+            df, _ = self.reader.term_stats(field, term)
+            dc, _ = self.reader.field_stats(field)
+            w = float(bm25.idf(dc, df)) if df > 0 else 0.0
+            self._weight_cache[key] = w
+        return w
+
+    # ---- entry point ----
+
+    def search(
+        self,
+        query: Optional[Query],
+        size: int = 10,
+        from_: int = 0,
+        knn: Optional[List[KnnSection]] = None,
+        min_score: Optional[float] = None,
+    ) -> TopDocs:
+        # knn sections: per-segment candidates, then a *global* top-k cut
+        # across segments (SearchPhaseController.mergeKnnResults semantics)
+        knn_sets = [self._knn_topk_global(sec) for sec in (knn or [])]
+        per_segment: List[Tuple[np.ndarray, np.ndarray]] = []
+        for si, seg in enumerate(self.reader.segments):
+            mask, scores = self._execute_root(query, knn_sets, si, seg)
+            live = self.reader.live_docs[si]
+            if live is not None:
+                mask = mask & live
+            if min_score is not None:
+                mask = mask & (scores >= min_score)
+            per_segment.append((mask, scores))
+
+        total = int(sum(m.sum() for m, _ in per_segment))
+        # global collection: (score desc, global doc asc)
+        all_scores = []
+        all_keys = []
+        for si, (mask, scores) in enumerate(per_segment):
+            idx = np.nonzero(mask)[0]
+            all_scores.append(scores[idx])
+            all_keys.append([(si, int(i)) for i in idx])
+        if all_scores:
+            flat_scores = np.concatenate(all_scores)
+        else:
+            flat_scores = np.zeros(0, np.float32)
+        flat_keys = [k for ks in all_keys for k in ks]
+        order = sorted(
+            range(len(flat_keys)), key=lambda i: (-float(flat_scores[i]), flat_keys[i])
+        )
+        top = order[from_ : from_ + size]
+        hits = [
+            Hit(
+                score=float(flat_scores[i]),
+                segment=flat_keys[i][0],
+                local_doc=flat_keys[i][1],
+                doc_id=self.reader.segments[flat_keys[i][0]].doc_ids[flat_keys[i][1]],
+            )
+            for i in top
+        ]
+        max_score = float(flat_scores.max()) if len(flat_scores) else None
+        return TopDocs(total=total, hits=hits, max_score=max_score)
+
+    def _execute_root(
+        self,
+        query: Optional[Query],
+        knn_sets: List[List[Tuple[np.ndarray, np.ndarray]]],
+        si: int,
+        seg: Segment,
+    ) -> Tuple[np.ndarray, np.ndarray]:
+        n = seg.num_docs
+        if query is None and not knn_sets:
+            query = MatchAllQuery()
+        if query is not None:
+            mask, scores = self._exec(query, seg)
+        else:
+            mask = np.zeros(n, dtype=bool)
+            scores = np.zeros(n, dtype=np.float32)
+        # knn winners become additional SHOULD-like exact doc/score sets
+        # (KnnScoreDocQuery semantics: scores add where both match)
+        for ks in knn_sets:
+            kmask, kscores = ks[si]
+            scores = np.where(kmask, scores + kscores, scores).astype(np.float32)
+            mask = mask | kmask
+        return mask, scores
+
+    def _knn_topk_global(self, sec: KnnSection) -> List[Tuple[np.ndarray, np.ndarray]]:
+        """Per-segment knn candidates cut to the global top-k of the shard:
+        per segment keep num_candidates, then keep only the k best
+        (score desc, global doc asc) across all segments."""
+        per_seg = [
+            self._exec_knn(sec, si, seg)
+            for si, seg in enumerate(self.reader.segments)
+        ]
+        entries = []  # (score, si, doc)
+        for si, (mask, scores) in enumerate(per_seg):
+            for doc in np.nonzero(mask)[0]:
+                entries.append((float(scores[doc]), si, int(doc)))
+        entries.sort(key=lambda t: (-t[0], t[1], t[2]))
+        keep = entries[: sec.k]
+        out = []
+        for si, (mask, scores) in enumerate(per_seg):
+            new_mask = np.zeros_like(mask)
+            for s, ksi, doc in keep:
+                if ksi == si:
+                    new_mask[doc] = True
+            out.append((new_mask, scores))
+        return out
+
+    # ---- node dispatch ----
+
+    def _exec(self, q: Query, seg: Segment) -> Tuple[np.ndarray, np.ndarray]:
+        n = seg.num_docs
+        if isinstance(q, MatchAllQuery):
+            return np.ones(n, bool), np.full(n, np.float32(q.boost), np.float32)
+        if isinstance(q, MatchNoneQuery):
+            return np.zeros(n, bool), np.zeros(n, np.float32)
+        if isinstance(q, MatchQuery):
+            return self._exec_match(q, seg)
+        if isinstance(q, MatchPhraseQuery):
+            return self._exec_phrase(q, seg)
+        if isinstance(q, TermQuery):
+            return self._exec_term(q, seg)
+        if isinstance(q, TermsQuery):
+            return self._exec_terms(q, seg)
+        if isinstance(q, RangeQuery):
+            return self._exec_range(q, seg)
+        if isinstance(q, ExistsQuery):
+            return self._exec_exists(q, seg)
+        if isinstance(q, BoolQuery):
+            return self._exec_bool(q, seg)
+        if isinstance(q, ConstantScoreQuery):
+            m, _ = self._exec(q.filter_query, seg)
+            return m, np.where(m, np.float32(q.boost), np.float32(0)).astype(np.float32)
+        if isinstance(q, MultiMatchQuery):
+            return self._exec_multi_match(q, seg)
+        if isinstance(q, KnnQueryWrapper):
+            si = self.reader.segments.index(seg)
+            return self._exec_knn(q.knn, si, seg)
+        raise QueryParseError(f"unsupported query node [{type(q).__name__}]")
+
+    # ---- leaves ----
+
+    def _score_term_dense(
+        self, seg: Segment, field: str, term: str, boost: float
+    ) -> Tuple[np.ndarray, np.ndarray]:
+        """TermQuery scoring: dense (mask, scores) for one term."""
+        n = seg.num_docs
+        mask = np.zeros(n, bool)
+        scores = np.zeros(n, np.float32)
+        pf = seg.postings.get(field)
+        if pf is None:
+            return mask, scores
+        tid = pf.term_id(term)
+        if tid < 0:
+            return mask, scores
+        start = int(pf.term_tile_start[tid])
+        count = int(pf.term_tile_count[tid])
+        doc_rows = pf.doc_ids[start : start + count].ravel()
+        tf_rows = pf.tfs[start : start + count].ravel()
+        valid = doc_rows >= 0
+        docs = doc_rows[valid]
+        tfs = tf_rows[valid]
+        mf = self.reader.mappings.get(field)
+        omit_norms = mf is not None and mf.type != TEXT
+        if omit_norms:
+            norm_bytes = np.ones(len(docs), np.int64)
+        else:
+            norm_bytes = pf.norms[docs].astype(np.int64)
+        weight = np.float32(boost) * np.float32(self._term_weight(field, term))
+        cache = self._field_cache(field)
+        s = bm25.score_freqs(tfs, norm_bytes, weight, cache)
+        mask[docs] = True
+        scores[docs] = s
+        return mask, scores
+
+    def _exec_match(self, q: MatchQuery, seg: Segment) -> Tuple[np.ndarray, np.ndarray]:
+        mf = self.reader.mappings.get(q.field)
+        n = seg.num_docs
+        if mf is None:
+            return np.zeros(n, bool), np.zeros(n, np.float32)
+        if mf.type != TEXT:
+            # match on keyword/numeric degrades to a term query (ES behavior)
+            return self._exec_term(TermQuery(field=q.field, value=q.query, boost=q.boost), seg)
+        analyzer_name = q.analyzer or mf.search_analyzer or mf.analyzer
+        terms = [t.text for t in self.reader.analysis.get(analyzer_name).analyze(q.query)]
+        if not terms:
+            # analyzes to no tokens → matches nothing (MatchNoDocsQuery)
+            return np.zeros(n, bool), np.zeros(n, np.float32)
+        masks = []
+        scores = np.zeros(n, np.float32)
+        for t in terms:
+            m, s = self._score_term_dense(seg, q.field, t, q.boost)
+            masks.append(m)
+            scores = (scores + s).astype(np.float32)
+        stacked = np.stack(masks)
+        if q.operator == "and":
+            mask = stacked.all(axis=0)
+        else:
+            msm = dsl.parse_minimum_should_match(q.minimum_should_match, len(terms))
+            msm = max(1, msm)
+            mask = stacked.sum(axis=0) >= msm
+        return mask, np.where(mask, scores, 0).astype(np.float32)
+
+    def _exec_phrase(self, q: MatchPhraseQuery, seg: Segment) -> Tuple[np.ndarray, np.ndarray]:
+        mf = self.reader.mappings.get(q.field)
+        n = seg.num_docs
+        if mf is None or mf.type != TEXT:
+            return np.zeros(n, bool), np.zeros(n, np.float32)
+        analyzer_name = q.analyzer or mf.search_analyzer or mf.analyzer
+        analyzer = self.reader.analysis.get(analyzer_name)
+        qtoks = analyzer.analyze(q.query)
+        terms = [t.text for t in qtoks]
+        if not terms:
+            return np.zeros(n, bool), np.zeros(n, np.float32)
+        # conjunction prefilter
+        conj, scores = self._exec_match(
+            MatchQuery(field=q.field, query=q.query, operator="and",
+                       analyzer=analyzer_name, boost=q.boost),
+            seg,
+        )
+        # position verification against re-analyzed stored source
+        qpos = [t.position for t in qtoks]
+        rel = [p - qpos[0] for p in qpos]
+        mask = np.zeros(n, bool)
+        for doc in np.nonzero(conj)[0]:
+            src = seg.sources[doc] or {}
+            value = _extract_field(src, q.field)
+            ok = False
+            for v in value:
+                toks = analyzer.analyze(str(v))
+                pos_of: Dict[str, List[int]] = {}
+                for t in toks:
+                    pos_of.setdefault(t.text, []).append(t.position)
+                if _phrase_match(pos_of, terms, rel, q.slop):
+                    ok = True
+                    break
+            mask[doc] = ok
+        return mask, np.where(mask, scores, 0).astype(np.float32)
+
+    def _exec_term(self, q: TermQuery, seg: Segment) -> Tuple[np.ndarray, np.ndarray]:
+        n = seg.num_docs
+        mf = self.reader.mappings.get(q.field)
+        if q.field == "_id":
+            mask = np.zeros(n, bool)
+            for i, d in enumerate(seg.doc_ids):
+                if d == str(q.value):
+                    mask[i] = True
+            return mask, np.where(mask, np.float32(q.boost), 0).astype(np.float32)
+        if mf is None:
+            return np.zeros(n, bool), np.zeros(n, np.float32)
+        if mf.type in (TEXT, KEYWORD):
+            value = q.value
+            if isinstance(value, bool):
+                value = "true" if value else "false"
+            return self._score_term_dense(seg, q.field, str(value), q.boost)
+        # numeric/date/boolean: doc-values equality, constant score
+        nf = seg.numerics.get(q.field)
+        if nf is None:
+            return np.zeros(n, bool), np.zeros(n, np.float32)
+        target = _coerce_numeric(mf.type, q.value)
+        mask = nf.exists & (nf.values == target)
+        return mask, np.where(mask, np.float32(q.boost), 0).astype(np.float32)
+
+    def _exec_terms(self, q: TermsQuery, seg: Segment) -> Tuple[np.ndarray, np.ndarray]:
+        n = seg.num_docs
+        mask = np.zeros(n, bool)
+        for v in q.values:
+            m, _ = self._exec_term(TermQuery(field=q.field, value=v), seg)
+            mask |= m
+        # terms query is constant-scoring (boost)
+        return mask, np.where(mask, np.float32(q.boost), 0).astype(np.float32)
+
+    def _exec_range(self, q: RangeQuery, seg: Segment) -> Tuple[np.ndarray, np.ndarray]:
+        n = seg.num_docs
+        mf = self.reader.mappings.get(q.field)
+        if mf is None:
+            return np.zeros(n, bool), np.zeros(n, np.float32)
+        if mf.type in (TEXT, KEYWORD):
+            of = seg.ordinals.get(q.field)
+            if of is None:
+                return np.zeros(n, bool), np.zeros(n, np.float32)
+            terms = of.ord_terms
+            lo, hi = 0, len(terms)
+            if q.gte is not None:
+                lo = _bisect_left(terms, str(q.gte))
+            if q.gt is not None:
+                lo = max(lo, _bisect_right(terms, str(q.gt)))
+            if q.lte is not None:
+                hi = min(hi, _bisect_right(terms, str(q.lte)))
+            if q.lt is not None:
+                hi = min(hi, _bisect_left(terms, str(q.lt)))
+            # multi-value: any of the doc's ordinals in [lo, hi)
+            in_range = (of.mv_ords >= lo) & (of.mv_ords < hi)
+            hit_counts = np.diff(np.concatenate([[0], np.cumsum(in_range)])[of.mv_offsets])
+            mask = hit_counts > 0
+            return mask, np.where(mask, np.float32(q.boost), 0).astype(np.float32)
+        nf = seg.numerics.get(q.field)
+        if nf is None:
+            return np.zeros(n, bool), np.zeros(n, np.float32)
+        mask = nf.exists.copy()
+        conv = (lambda v: parse_date_millis(v)) if mf.type == DATE else float
+        if q.gte is not None:
+            mask &= nf.values >= conv(q.gte)
+        if q.gt is not None:
+            mask &= nf.values > conv(q.gt)
+        if q.lte is not None:
+            mask &= nf.values <= conv(q.lte)
+        if q.lt is not None:
+            mask &= nf.values < conv(q.lt)
+        return mask, np.where(mask, np.float32(q.boost), 0).astype(np.float32)
+
+    def _exec_exists(self, q: ExistsQuery, seg: Segment) -> Tuple[np.ndarray, np.ndarray]:
+        n = seg.num_docs
+        mask = np.zeros(n, bool)
+        pf = seg.postings.get(q.field)
+        if pf is not None:
+            mask |= pf.norms > 0
+        nf = seg.numerics.get(q.field)
+        if nf is not None:
+            mask |= nf.exists
+        vf = seg.vectors.get(q.field)
+        if vf is not None:
+            mask |= vf.exists
+        of = seg.ordinals.get(q.field)
+        if of is not None:
+            mask |= of.ords >= 0
+        return mask, np.where(mask, np.float32(q.boost), 0).astype(np.float32)
+
+    # ---- compounds ----
+
+    def _exec_bool(self, q: BoolQuery, seg: Segment) -> Tuple[np.ndarray, np.ndarray]:
+        n = seg.num_docs
+        mask = np.ones(n, bool)
+        scores = np.zeros(n, np.float32)
+        any_positive = bool(q.must or q.filter or q.should)
+        for c in q.must:
+            m, s = self._exec(c, seg)
+            mask &= m
+            scores = (scores + s).astype(np.float32)
+        for c in q.filter:
+            m, _ = self._exec(c, seg)
+            mask &= m
+        if q.should:
+            smasks = []
+            sscores = np.zeros(n, np.float32)
+            for c in q.should:
+                m, s = self._exec(c, seg)
+                smasks.append(m)
+                sscores = (sscores + np.where(m, s, 0)).astype(np.float32)
+            stacked = np.stack(smasks)
+            match_count = stacked.sum(axis=0)
+            default_msm = 0 if (q.must or q.filter) else 1
+            msm = (
+                dsl.parse_minimum_should_match(q.minimum_should_match, len(q.should))
+                if q.minimum_should_match is not None
+                else default_msm
+            )
+            if msm > 0:
+                mask &= match_count >= msm
+            scores = (scores + np.where(match_count > 0, sscores, 0)).astype(np.float32)
+        elif not any_positive:
+            # only must_not: everything matches with score 0
+            pass
+        for c in q.must_not:
+            m, _ = self._exec(c, seg)
+            mask &= ~m
+        if q.boost != 1.0:
+            scores = (scores * np.float32(q.boost)).astype(np.float32)
+        return mask, np.where(mask, scores, 0).astype(np.float32)
+
+    def _exec_multi_match(self, q: MultiMatchQuery, seg: Segment) -> Tuple[np.ndarray, np.ndarray]:
+        n = seg.num_docs
+        fields: List[Tuple[str, float]] = []
+        for f in q.fields:
+            if "^" in f:
+                name, _, b = f.partition("^")
+                fields.append((name, float(b)))
+            else:
+                fields.append((f, 1.0))
+        if not fields:
+            return np.zeros(n, bool), np.zeros(n, np.float32)
+        per_field: List[Tuple[np.ndarray, np.ndarray]] = []
+        for fname, fboost in fields:
+            m, s = self._exec_match(
+                MatchQuery(field=fname, query=q.query, operator=q.operator,
+                           boost=q.boost * fboost),
+                seg,
+            )
+            per_field.append((m, s))
+        masks = np.stack([m for m, _ in per_field])
+        score_mat = np.stack([s for _, s in per_field])
+        mask = masks.any(axis=0)
+        if q.type == "best_fields":
+            best = score_mat.max(axis=0)
+            if q.tie_breaker:
+                rest = score_mat.sum(axis=0) - best
+                total = (best + np.float32(q.tie_breaker) * rest).astype(np.float32)
+            else:
+                total = best
+        else:  # most_fields / cross_fields (round 1: summed per-field scores)
+            total = score_mat.sum(axis=0, dtype=np.float32)
+        return mask, np.where(mask, total, 0).astype(np.float32)
+
+    # ---- knn ----
+
+    def _exec_knn(self, sec: KnnSection, si: int, seg: Segment) -> Tuple[np.ndarray, np.ndarray]:
+        n = seg.num_docs
+        vf = seg.vectors.get(sec.field)
+        if vf is None:
+            return np.zeros(n, bool), np.zeros(n, np.float32)
+        scores = score_vectors(
+            np.asarray(sec.query_vector, np.float32),
+            vf.vectors,
+            vf.similarity,
+            vf.unit_vectors,
+        )
+        mask = vf.exists.copy()
+        if sec.filter is not None:
+            fm, _ = self._exec(sec.filter, seg)
+            mask &= fm
+        live = self.reader.live_docs[si]
+        if live is not None:
+            mask = mask & live
+        if sec.similarity is not None:
+            mask &= scores >= np.float32(sec.similarity)
+        # per-shard: keep only top num_candidates, then top k overall
+        cand = min(sec.num_candidates, int(mask.sum()))
+        if cand < int(mask.sum()):
+            masked = np.where(mask, scores, -np.inf)
+            kth = np.partition(masked, -cand)[-cand]
+            mask &= masked >= kth
+        # top-level k cut happens at merge; apply boost
+        out = (scores * np.float32(sec.boost)).astype(np.float32)
+        return mask, np.where(mask, out, 0).astype(np.float32)
+
+
+# ---- helpers ----
+
+def _extract_field(src: dict, path: str):
+    node = src
+    for part in path.split("."):
+        if isinstance(node, dict) and part in node:
+            node = node[part]
+        else:
+            return []
+    return node if isinstance(node, list) else [node]
+
+
+def _phrase_match(pos_of: Dict[str, List[int]], terms: List[str], rel: List[int], slop: int) -> bool:
+    """Exact phrase when slop=0: all terms at consecutive relative positions.
+    Sloppy phrases use a simple window check (admits standard slop cases)."""
+    first = pos_of.get(terms[0], [])
+    for p0 in first:
+        if slop == 0:
+            if all(p0 + r in pos_of.get(t, []) for t, r in zip(terms[1:], rel[1:])):
+                return True
+        else:
+            ok = True
+            for t, r in zip(terms[1:], rel[1:]):
+                cands = pos_of.get(t, [])
+                if not any(abs(p - (p0 + r)) <= slop for p in cands):
+                    ok = False
+                    break
+            if ok:
+                return True
+    return False
+
+
+def _coerce_numeric(ftype: str, value) -> float:
+    if ftype == BOOLEAN:
+        if isinstance(value, bool):
+            return 1.0 if value else 0.0
+        return 1.0 if value == "true" else 0.0
+    if ftype == DATE:
+        return parse_date_millis(value)
+    return float(value)
+
+
+def _bisect_left(arr: List[str], x: str) -> int:
+    import bisect
+
+    return bisect.bisect_left(arr, x)
+
+
+def _bisect_right(arr: List[str], x: str) -> int:
+    import bisect
+
+    return bisect.bisect_right(arr, x)

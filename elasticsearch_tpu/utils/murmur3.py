@@ -87,5 +87,11 @@ def shard_id(routing: str, num_shards: int, routing_num_shards: int | None = Non
     """
     if routing_num_shards is None:
         routing_num_shards = calculate_num_routing_shards(num_shards)
+    if routing_num_shards % num_shards != 0:
+        # IndexMetadata validates routingFactor * numShards == routingNumShards
+        raise ValueError(
+            f"the number of routing shards [{routing_num_shards}] must be a "
+            f"multiple of the number of shards [{num_shards}]"
+        )
     routing_factor = routing_num_shards // num_shards
     return (murmur3_hash(routing) % routing_num_shards) // routing_factor

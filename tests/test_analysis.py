@@ -117,6 +117,80 @@ class TestAnalyzers:
             "jump",
         ]
 
+    def test_html_strip_char_filter(self):
+        reg = AnalysisRegistry(
+            {
+                "analysis": {
+                    "analyzer": {
+                        "x": {
+                            "type": "custom",
+                            "tokenizer": "standard",
+                            "char_filter": ["html_strip"],
+                            "filter": ["lowercase"],
+                        }
+                    }
+                }
+            }
+        )
+        assert reg.get("x").terms("<b>Hello</b> &amp; World") == ["hello", "world"]
+
+    def test_mapping_char_filter(self):
+        reg = AnalysisRegistry(
+            {
+                "analysis": {
+                    "char_filter": {
+                        "subs": {"type": "mapping", "mappings": ["ph=>f"]}
+                    },
+                    "analyzer": {
+                        "x": {
+                            "type": "custom",
+                            "tokenizer": "standard",
+                            "char_filter": ["subs"],
+                            "filter": ["lowercase"],
+                        }
+                    },
+                }
+            }
+        )
+        assert reg.get("x").terms("phone") == ["fone"]
+
+    def test_builtin_type_with_stopwords(self):
+        reg = AnalysisRegistry(
+            {
+                "analysis": {
+                    "analyzer": {
+                        "my_std": {"type": "standard", "stopwords": ["hello"]}
+                    }
+                }
+            }
+        )
+        assert reg.get("my_std").terms("hello world") == ["world"]
+
+    def test_stemmer_unsupported_language_raises(self):
+        import pytest
+
+        reg = AnalysisRegistry(
+            {
+                "analysis": {
+                    "filter": {"de": {"type": "stemmer", "language": "german"}},
+                    "analyzer": {
+                        "x": {"type": "custom", "tokenizer": "standard", "filter": ["de"]}
+                    },
+                }
+            }
+        )
+        with pytest.raises(ValueError, match="unsupported stemmer language"):
+            reg.get("x")
+
+    def test_supplementary_cjk_single_char(self):
+        assert StandardTokenizer().tokenize("\U00020000\U00020001 ab")[0].text == "\U00020000"
+        toks = [t.text for t in StandardTokenizer().tokenize("\U00020000\U00020001 ab")]
+        assert toks == ["\U00020000", "\U00020001", "ab"]
+
+    def test_katakana_max_token_length(self):
+        toks = [t.text for t in StandardTokenizer().tokenize("カ" * 300)]
+        assert [len(t) for t in toks] == [255, 45]
+
     def test_custom_analyzer_from_settings(self):
         reg = AnalysisRegistry(
             {

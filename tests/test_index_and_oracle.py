@@ -1,0 +1,343 @@
+"""End-to-end: parse docs → build tiled segment → search with the NumPy
+oracle. BM25 scores are cross-checked against an independent from-formula
+implementation computed on raw tokens in the test itself."""
+
+import math
+
+import numpy as np
+import pytest
+
+from elasticsearch_tpu.analysis import AnalysisRegistry
+from elasticsearch_tpu.index.mapping import DocumentParser, Mappings
+from elasticsearch_tpu.index.segment import TILE, Segment, SegmentBuilder
+from elasticsearch_tpu.search import dsl
+from elasticsearch_tpu.search.executor import NumpyExecutor, ShardReader
+from elasticsearch_tpu.utils.smallfloat import byte4_to_int, int_to_byte4
+
+MAPPING = {
+    "properties": {
+        "title": {"type": "text"},
+        "body": {"type": "text"},
+        "tag": {"type": "keyword"},
+        "views": {"type": "integer"},
+        "published": {"type": "boolean"},
+        "embedding": {"type": "dense_vector", "dims": 4, "similarity": "cosine"},
+    }
+}
+
+DOCS = [
+    ("1", {"title": "quick brown fox", "body": "the quick brown fox jumps over the lazy dog", "tag": "animal", "views": 10, "published": True, "embedding": [1.0, 0.0, 0.0, 0.0]}),
+    ("2", {"title": "lazy dog", "body": "the dog sleeps all day the dog dreams", "tag": "animal", "views": 5, "published": False, "embedding": [0.0, 1.0, 0.0, 0.0]}),
+    ("3", {"title": "fox hunting", "body": "fox fox fox everywhere a fox", "tag": "hunt", "views": 50, "published": True, "embedding": [0.7, 0.7, 0.0, 0.0]}),
+    ("4", {"title": "cooking pasta", "body": "boil water add pasta and salt", "tag": ["food", "recipe"], "views": 100, "published": True, "embedding": [0.0, 0.0, 1.0, 0.0]}),
+    ("5", {"title": "empty views doc", "body": "nothing interesting here", "tag": "misc", "published": False, "embedding": [0.0, 0.0, 0.0, 1.0]}),
+]
+
+
+@pytest.fixture
+def reader():
+    mappings = Mappings(MAPPING)
+    analysis = AnalysisRegistry()
+    parser = DocumentParser(mappings, analysis)
+    builder = SegmentBuilder(mappings)
+    for _id, src in DOCS:
+        builder.add(parser.parse(_id, src))
+    seg = builder.build()
+    return ShardReader([seg], mappings, analysis)
+
+
+@pytest.fixture
+def ex(reader):
+    return NumpyExecutor(reader)
+
+
+def search(ex, query_json, size=10, knn=None):
+    q = dsl.parse_query(query_json) if query_json else None
+    return ex.search(q, size=size, knn=knn)
+
+
+# ---------- independent BM25 reference ----------
+
+def ref_bm25_scores(field_texts, query_terms, k1=1.2, b=0.75):
+    """Scores per doc from raw token lists, using the documented Lucene
+    formula with byte4-quantized lengths. Returns float32 array."""
+    analysis = AnalysisRegistry()
+    std = analysis.get("standard")
+    tokens = [std.terms(t) for t in field_texts]
+    n_docs_with = sum(1 for t in tokens if t)
+    sum_ttf = sum(len(t) for t in tokens)
+    avgdl = np.float32(sum_ttf / n_docs_with)
+    scores = np.zeros(len(tokens), np.float32)
+    for term in query_terms:
+        df = sum(1 for t in tokens if term in t)
+        if df == 0:
+            continue
+        idf = np.float32(math.log(1 + (n_docs_with - df + 0.5) / (df + 0.5)))
+        for i, toks in enumerate(tokens):
+            tf = toks.count(term)
+            if tf == 0:
+                continue
+            dl = np.float32(byte4_to_int(int_to_byte4(len(toks))))
+            denom = np.float32(k1) * ((1 - np.float32(b)) + np.float32(b) * dl / avgdl)
+            inv = np.float32(1.0) / denom
+            s = idf - idf / (np.float32(1) + np.float32(tf) * inv)
+            scores[i] = np.float32(scores[i] + s)
+    return scores
+
+
+class TestSegmentFormat:
+    def test_tiles_and_stats(self, reader):
+        pf = reader.segments[0].postings["body"]
+        assert pf.doc_ids.shape[1] == TILE
+        assert pf.doc_ids.dtype == np.int32
+        # "fox" appears in docs 0 and 2 of body
+        tid = pf.term_id("fox")
+        assert tid >= 0
+        assert pf.term_df[tid] == 2
+        assert pf.term_total_tf[tid] == 5  # 1 + 4
+        row = pf.doc_ids[pf.term_tile_start[tid]]
+        assert list(row[:2]) == [0, 2]
+        assert all(row[2:] == -1)
+        dc, ttf = reader.field_stats("body")
+        assert dc == 5
+        assert ttf == sum(
+            len(AnalysisRegistry().get("standard").terms(src["body"]))
+            for _, src in DOCS
+        )
+
+    def test_save_load_roundtrip(self, reader, tmp_path):
+        seg = reader.segments[0]
+        seg.save(str(tmp_path / "seg0"))
+        loaded = Segment.load(str(tmp_path / "seg0"))
+        assert loaded.num_docs == seg.num_docs
+        assert loaded.doc_ids == seg.doc_ids
+        pf0, pf1 = seg.postings["body"], loaded.postings["body"]
+        assert pf0.terms == pf1.terms
+        np.testing.assert_array_equal(pf0.doc_ids, pf1.doc_ids)
+        np.testing.assert_array_equal(pf0.tfs, pf1.tfs)
+        np.testing.assert_array_equal(pf0.norms, pf1.norms)
+        np.testing.assert_array_equal(
+            seg.vectors["embedding"].vectors, loaded.vectors["embedding"].vectors
+        )
+        assert loaded.sources[0]["title"] == "quick brown fox"
+
+
+class TestMatchQuery:
+    def test_match_scores_against_reference(self, ex):
+        res = search(ex, {"match": {"body": "quick fox"}})
+        ref = ref_bm25_scores([s["body"] for _, s in DOCS], ["quick", "fox"])
+        expect_order = sorted(
+            [(i, s) for i, s in enumerate(ref) if s > 0], key=lambda t: (-t[1], t[0])
+        )
+        assert res.total == len(expect_order)
+        for hit, (i, s) in zip(res.hits, expect_order):
+            assert hit.doc_id == DOCS[i][0]
+            assert hit.score == pytest.approx(float(s), rel=1e-6)
+
+    def test_match_operator_and(self, ex):
+        res = search(ex, {"match": {"body": {"query": "quick dog", "operator": "and"}}})
+        assert [h.doc_id for h in res.hits] == ["1"]
+
+    def test_match_no_tokens_matches_nothing(self, ex):
+        res = search(ex, {"match": {"body": "!!!"}})
+        assert res.total == 0
+
+    def test_match_unmapped_field(self, ex):
+        res = search(ex, {"match": {"nope": "x"}})
+        assert res.total == 0
+
+    def test_minimum_should_match(self, ex):
+        res = search(
+            ex,
+            {"match": {"body": {"query": "quick lazy dog", "minimum_should_match": 2}}},
+        )
+        # doc1: quick+lazy+dog (3), doc2: dog (1)
+        assert [h.doc_id for h in res.hits] == ["1"]
+
+
+class TestTermAndFilters:
+    def test_term_keyword(self, ex):
+        res = search(ex, {"term": {"tag": "animal"}})
+        assert {h.doc_id for h in res.hits} == {"1", "2"}
+
+    def test_term_keyword_array(self, ex):
+        res = search(ex, {"term": {"tag": "recipe"}})
+        assert [h.doc_id for h in res.hits] == ["4"]
+
+    def test_terms_query(self, ex):
+        res = search(ex, {"terms": {"tag": ["hunt", "food"]}})
+        assert {h.doc_id for h in res.hits} == {"3", "4"}
+
+    def test_term_numeric(self, ex):
+        res = search(ex, {"term": {"views": 50}})
+        assert [h.doc_id for h in res.hits] == ["3"]
+
+    def test_term_boolean(self, ex):
+        res = search(ex, {"term": {"published": True}})
+        assert {h.doc_id for h in res.hits} == {"1", "3", "4"}
+
+    def test_term_id(self, ex):
+        res = search(ex, {"term": {"_id": "2"}})
+        assert [h.doc_id for h in res.hits] == ["2"]
+
+    def test_range_numeric(self, ex):
+        res = search(ex, {"range": {"views": {"gte": 10, "lt": 100}}})
+        assert {h.doc_id for h in res.hits} == {"1", "3"}
+
+    def test_range_missing_field_excluded(self, ex):
+        res = search(ex, {"range": {"views": {"gte": 0}}})
+        assert "5" not in {h.doc_id for h in res.hits}
+
+    def test_range_keyword_lexicographic(self, ex):
+        res = search(ex, {"range": {"tag": {"gte": "a", "lte": "food"}}})
+        # animal (1,2) + food (4); "hunt"/"misc"/"recipe" out of range
+        assert {h.doc_id for h in res.hits} == {"1", "2", "4"}
+        res = search(ex, {"range": {"tag": {"gte": "a", "lt": "food"}}})
+        assert {h.doc_id for h in res.hits} == {"1", "2"}
+
+    def test_exists(self, ex):
+        res = search(ex, {"exists": {"field": "views"}})
+        assert {h.doc_id for h in res.hits} == {"1", "2", "3", "4"}
+
+    def test_match_all(self, ex):
+        res = search(ex, {"match_all": {}})
+        assert res.total == 5
+        assert all(h.score == 1.0 for h in res.hits)
+
+
+class TestBoolQuery:
+    def test_must_filter_must_not(self, ex):
+        res = search(
+            ex,
+            {
+                "bool": {
+                    "must": [{"match": {"body": "fox"}}],
+                    "filter": [{"term": {"published": True}}],
+                    "must_not": [{"term": {"tag": "hunt"}}],
+                }
+            },
+        )
+        assert [h.doc_id for h in res.hits] == ["1"]
+        # filter does not contribute to score: equals pure match score
+        pure = search(ex, {"match": {"body": "fox"}})
+        doc1 = next(h for h in pure.hits if h.doc_id == "1")
+        assert res.hits[0].score == pytest.approx(doc1.score)
+
+    def test_should_scoring_adds(self, ex):
+        res = search(
+            ex,
+            {
+                "bool": {
+                    "must": [{"match": {"body": "fox"}}],
+                    "should": [{"term": {"tag": "hunt"}}],
+                }
+            },
+        )
+        by_id = {h.doc_id: h.score for h in res.hits}
+        pure = {h.doc_id: h.score for h in search(ex, {"match": {"body": "fox"}}).hits}
+        term = {h.doc_id: h.score for h in search(ex, {"term": {"tag": "hunt"}}).hits}
+        # term on keyword is BM25-scored (norms omitted → encodedNorm 1)
+        assert by_id["3"] == pytest.approx(pure["3"] + term["3"], rel=1e-6)
+        assert by_id["1"] == pytest.approx(pure["1"])
+
+    def test_pure_should_requires_one(self, ex):
+        res = search(
+            ex,
+            {
+                "bool": {
+                    "should": [
+                        {"term": {"tag": "hunt"}},
+                        {"term": {"tag": "food"}},
+                    ]
+                }
+            },
+        )
+        assert {h.doc_id for h in res.hits} == {"3", "4"}
+
+    def test_only_must_not(self, ex):
+        res = search(ex, {"bool": {"must_not": [{"term": {"tag": "animal"}}]}})
+        assert {h.doc_id for h in res.hits} == {"3", "4", "5"}
+
+    def test_constant_score(self, ex):
+        res = search(
+            ex, {"constant_score": {"filter": {"match": {"body": "fox"}}, "boost": 2.5}}
+        )
+        assert {h.doc_id for h in res.hits} == {"1", "3"}
+        assert all(h.score == 2.5 for h in res.hits)
+
+
+class TestMultiMatch:
+    def test_best_fields(self, ex):
+        res = search(
+            ex,
+            {"multi_match": {"query": "fox", "fields": ["title", "body"]}},
+        )
+        assert {h.doc_id for h in res.hits} == {"1", "3"}
+
+    def test_field_boost_applies(self, ex):
+        plain = search(ex, {"multi_match": {"query": "pasta", "fields": ["title"]}})
+        boosted = search(
+            ex, {"multi_match": {"query": "pasta", "fields": ["title^3"]}}
+        )
+        assert boosted.hits[0].score == pytest.approx(plain.hits[0].score * 3, rel=1e-5)
+
+
+class TestPhrase:
+    def test_exact_phrase(self, ex):
+        res = search(ex, {"match_phrase": {"body": "quick brown fox"}})
+        assert [h.doc_id for h in res.hits] == ["1"]
+        res = search(ex, {"match_phrase": {"body": "brown quick fox"}})
+        assert res.total == 0
+
+    def test_phrase_with_slop(self, ex):
+        res = search(ex, {"match_phrase": {"body": {"query": "quick fox", "slop": 1}}})
+        assert [h.doc_id for h in res.hits] == ["1"]
+
+
+class TestKnn:
+    def test_knn_cosine(self, ex):
+        knn = [dsl.parse_knn({"field": "embedding", "query_vector": [1, 0, 0, 0], "k": 2, "num_candidates": 5})]
+        res = search(ex, None, knn=knn)
+        # k=2 caps the knn hit set even though num_candidates=5
+        assert res.total == 2
+        assert res.hits[0].doc_id == "1"
+        assert res.hits[0].score == pytest.approx(1.0)  # (1+cos)/2 = 1
+        assert res.hits[1].doc_id == "3"
+
+    def test_knn_with_filter(self, ex):
+        knn = [
+            dsl.parse_knn(
+                {
+                    "field": "embedding",
+                    "query_vector": [1, 0, 0, 0],
+                    "k": 3,
+                    "filter": {"term": {"published": False}},
+                }
+            )
+        ]
+        res = search(ex, None, knn=knn)
+        ids = [h.doc_id for h in res.hits]
+        assert "1" not in ids and "3" not in ids
+
+    def test_hybrid_scores_add(self, ex):
+        knn = [dsl.parse_knn({"field": "embedding", "query_vector": [1, 0, 0, 0], "k": 5})]
+        q = {"match": {"body": "fox"}}
+        res = search(ex, q, knn=knn)
+        pure_q = {h.doc_id: h.score for h in search(ex, q).hits}
+        pure_k = {h.doc_id: h.score for h in search(ex, None, knn=knn).hits}
+        combined = {h.doc_id: h.score for h in res.hits}
+        assert combined["1"] == pytest.approx(pure_q["1"] + pure_k["1"], rel=1e-6)
+
+
+class TestPagination:
+    def test_size_and_from(self, ex):
+        all_res = search(ex, {"match_all": {}}, size=5)
+        q = dsl.parse_query({"match_all": {}})
+        page = ex.search(q, size=2, from_=2)
+        assert [h.doc_id for h in page.hits] == [
+            h.doc_id for h in all_res.hits[2:4]
+        ]
+
+    def test_tie_break_doc_order(self, ex):
+        res = search(ex, {"match_all": {}})
+        assert [h.doc_id for h in res.hits] == ["1", "2", "3", "4", "5"]

@@ -22,11 +22,26 @@ class TestMurmur3:
             == 0x2E4FF723
         )
 
-    def test_es_routing_hash_is_utf16le_murmur(self):
-        # ES Murmur3HashFunction hashes the UTF-16 code units as LE byte
-        # pairs with seed 0; for BMP strings that is exactly utf-16-le.
-        for s in ("foo", "hello", "doc-123", "日本語", ""):
-            assert murmur3_hash(s) == murmurhash3_x86_32(s.encode("utf-16-le"))
+    def test_es_routing_hash_golden_values(self):
+        # Pinned outputs of murmur3_x86_32 over UTF-16LE code-unit bytes
+        # (ES Murmur3HashFunction semantics). The raw byte-level function is
+        # pinned by public vectors above; these pin the string encoding so
+        # a future encoding change cannot silently break routing.
+        golden = {
+            "foo": 2085578581,
+            "hello": -675079799,
+            "doc-123": 1100537891,
+            "日本語": 1004281861,
+            "": 0,
+            "doc-🔥": -1756815810,  # surrogate pair, as Java chars
+            "The quick brown fox": -1522435555,
+        }
+        for s, expected in golden.items():
+            assert murmur3_hash(s) == expected, s
+
+    def test_shard_id_rejects_bad_routing_num_shards(self):
+        with pytest.raises(ValueError):
+            shard_id("doc-3", 3, 4)
 
     def test_shard_id_range_and_determinism(self):
         for n in (1, 2, 5, 8, 13):
