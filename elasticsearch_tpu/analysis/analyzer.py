@@ -37,7 +37,9 @@ class CharFilter:
 
 
 class HtmlStripCharFilter(CharFilter):
-    """HTMLStripCharFilter: remove tags, decode entities."""
+    """HTMLStripCharFilter: remove tags/comments, decode entities. A stray
+    '<' that does not start a tag (not followed by a letter, '/', or '!')
+    is preserved, as the reference's lexer does."""
 
     _TAG = None
 
@@ -46,12 +48,17 @@ class HtmlStripCharFilter(CharFilter):
         import re
 
         if HtmlStripCharFilter._TAG is None:
-            HtmlStripCharFilter._TAG = re.compile(r"<[^>]*>")
+            HtmlStripCharFilter._TAG = re.compile(
+                r"<!--.*?-->|<!\[CDATA\[.*?\]\]>|</?[a-zA-Z][^>]*>|<![^>]*>",
+                re.DOTALL,
+            )
         return html.unescape(HtmlStripCharFilter._TAG.sub(" ", text))
 
 
 class MappingCharFilter(CharFilter):
-    """MappingCharFilter: literal "from=>to" replacements, longest-first."""
+    """MappingCharFilter: literal "from=>to" replacements. Single pass,
+    longest match at each position; replacement output is NOT re-scanned
+    (so a=>b, b=>c maps "a" to "b", as the reference does)."""
 
     def __init__(self, mappings: Sequence[str]):
         pairs = []
@@ -61,9 +68,21 @@ class MappingCharFilter(CharFilter):
         self.pairs = sorted(pairs, key=lambda p: -len(p[0]))
 
     def apply(self, text: str) -> str:
-        for src, dst in self.pairs:
-            text = text.replace(src, dst)
-        return text
+        if not self.pairs:
+            return text
+        out = []
+        i = 0
+        n = len(text)
+        while i < n:
+            for src, dst in self.pairs:
+                if src and text.startswith(src, i):
+                    out.append(dst)
+                    i += len(src)
+                    break
+            else:
+                out.append(text[i])
+                i += 1
+        return "".join(out)
 
 
 class TokenFilter:

@@ -1,0 +1,4 @@
+from .mapping import Mappings, DocumentParser, MappingParseError
+from .segment import Segment, SegmentBuilder, TILE
+
+__all__ = ["Mappings", "DocumentParser", "MappingParseError", "Segment", "SegmentBuilder", "TILE"]

@@ -71,6 +71,8 @@ class Mappings:
 
     def __init__(self, mapping_json: Optional[dict] = None, dynamic: bool = True):
         self.fields: Dict[str, MappedField] = {}
+        # parent path → sub-field names declared via "fields" (multi-fields)
+        self.multi_fields: Dict[str, List[str]] = {}
         self.dynamic = dynamic
         mapping_json = mapping_json or {}
         if "dynamic" in mapping_json:
@@ -94,6 +96,7 @@ class Mappings:
             self._add_field(path, ftype, cfg)
             for sub, subcfg in cfg.get("fields", {}).items():
                 self._add_field(f"{path}.{sub}", subcfg.get("type", KEYWORD), subcfg)
+                self.multi_fields.setdefault(path, []).append(sub)
 
     def _add_field(self, path: str, ftype: str, cfg: dict):
         known = (TEXT, KEYWORD, BOOLEAN, DATE, DENSE_VECTOR) + NUMERIC_TYPES
@@ -138,6 +141,7 @@ class Mappings:
             # ES maps strings to text with a .keyword multi-field
             self._add_field(name, TEXT, {})
             self._add_field(f"{name}.keyword", KEYWORD, {"ignore_above": 256})
+            self.multi_fields.setdefault(name, []).append("keyword")
             return self.fields[name]
         else:
             return None
@@ -145,16 +149,31 @@ class Mappings:
         return self.fields[name]
 
     def merge(self, mapping_json: dict):
-        """MapperService.merge subset: add new fields; reject type changes."""
+        """MapperService.merge subset: add new fields; reject type changes
+        and changes to index-time parameters (analyzer, dims, similarity)
+        on existing fields, as the reference does."""
         other = Mappings(mapping_json)
         for name, f in other.fields.items():
             mine = self.fields.get(name)
-            if mine is not None and mine.type != f.type:
-                raise MappingParseError(
-                    f"mapper [{name}] cannot be changed from type [{mine.type}] "
-                    f"to [{f.type}]"
-                )
+            if mine is not None:
+                if mine.type != f.type:
+                    raise MappingParseError(
+                        f"mapper [{name}] cannot be changed from type "
+                        f"[{mine.type}] to [{f.type}]"
+                    )
+                for param in ("analyzer", "dims", "similarity"):
+                    if getattr(mine, param) != getattr(f, param):
+                        raise MappingParseError(
+                            f"Mapper for [{name}] conflicts: cannot update "
+                            f"parameter [{param}] from "
+                            f"[{getattr(mine, param)}] to [{getattr(f, param)}]"
+                        )
             self.fields[name] = f
+        for parent, subs in other.multi_fields.items():
+            mine_subs = self.multi_fields.setdefault(parent, [])
+            for s in subs:
+                if s not in mine_subs:
+                    mine_subs.append(s)
 
     def to_json(self) -> dict:
         props: dict = {}
@@ -229,9 +248,14 @@ class DocumentParser:
             path = f"{prefix}{key}"
             if isinstance(value, dict):
                 f = self.mappings.get(path)
-                if f is not None and f.type == DENSE_VECTOR:
+                if f is not None:
+                    # leaf/object conflict — the reference rejects this at
+                    # parse time rather than silently corrupting fields
                     raise MappingParseError(
-                        f"dense_vector field [{path}] must be an array of numbers"
+                        f"object mapping for [{path}] tried to parse field "
+                        f"as object, but found a concrete value"
+                        if f.type != DENSE_VECTOR
+                        else f"dense_vector field [{path}] must be an array of numbers"
                     )
                 self._walk(value, f"{path}.", out)
                 continue
@@ -250,16 +274,12 @@ class DocumentParser:
             if f is None:
                 continue
             self._index_values(f, path, values, out)
-            # multi-fields (e.g. text's .keyword sub-field): mapping entries
-            # one dot below a leaf field are sub-fields of it, not object
-            # children (objects never coexist with a leaf at the same path)
-            for sub_path, sub in self.mappings.fields.items():
-                if (
-                    sub_path != path
-                    and sub_path.startswith(path + ".")
-                    and "." not in sub_path[len(path) + 1 :]
-                ):
-                    self._index_values(sub, sub_path, values, out)
+            # multi-fields explicitly declared via "fields" (or dynamic
+            # .keyword) — never object children that merely share a prefix
+            for sub in self.mappings.multi_fields.get(path, ()):
+                sub_field = self.mappings.get(f"{path}.{sub}")
+                if sub_field is not None:
+                    self._index_values(sub_field, f"{path}.{sub}", values, out)
 
     def _index_values(self, f: MappedField, path: str, values: List[Any], out: ParsedDocument):
         if f.type == TEXT:

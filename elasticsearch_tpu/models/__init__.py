@@ -1,0 +1,3 @@
+from . import bm25, similarity
+
+__all__ = ["bm25", "similarity"]

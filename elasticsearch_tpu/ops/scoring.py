@@ -1,0 +1,185 @@
+"""Device scoring kernels (JAX/XLA) over tiled postings.
+
+Reference analog: the Lucene scoring hot loop — BM25Similarity.score inside
+WANDScorer/ConjunctionDISI iteration with ForUtil block decode
+(SURVEY.md §3.3 "THE LOOP TO PUT ON TPU"). The TPU formulation replaces
+doc-at-a-time iterators with:
+
+  gather tile rows (XLA gather from HBM-resident [n_tiles, 128] arrays)
+  → elementwise BM25 on the VPU
+  → scatter-add into a dense per-doc accumulator (term-at-a-time)
+  → lax.top_k (ties broken by lowest index = doc asc, matching Lucene).
+
+Scatter-add also accumulates a per-doc *matching-term count*, which makes
+conjunctions (operator=and) and minimum_should_match pure elementwise
+masks — Lucene's leapfrog intersection becomes arithmetic.
+
+All shapes are static: per-query tile lists are padded to a bucket size
+(`pad_tiles`) so XLA compiles once per (bucket, n_docs) pair, and query
+*batches* score as one [B, T, 128] launch (`make_batched_bm25_scorer`) —
+the "score query batches in parallel" idea from BASELINE.json's north
+star. Scores are float32 end-to-end for oracle parity.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import NamedTuple, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def next_bucket(n: int, minimum: int = 8) -> int:
+    """Round up to a power of two for shape-stable compilation."""
+    b = minimum
+    while b < n:
+        b *= 2
+    return b
+
+
+def pad_tiles(
+    tile_idx: np.ndarray, tile_weights: np.ndarray, bucket: Optional[int] = None
+) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+    """Pads per-query tile index/weight lists to a bucket size.
+
+    Returns (tile_idx[T], tile_weights[T], tile_valid[T]) with T a power
+    of two. Padded entries point at tile 0 with weight 0 and valid=False.
+    """
+    t = len(tile_idx)
+    bucket = bucket or next_bucket(t)
+    idx = np.zeros(bucket, np.int32)
+    w = np.zeros(bucket, np.float32)
+    v = np.zeros(bucket, bool)
+    idx[:t] = tile_idx
+    w[:t] = tile_weights
+    v[:t] = True
+    return idx, w, v
+
+
+@functools.partial(jax.jit, static_argnames=("n_docs",))
+def score_tiles(
+    doc_rows: jax.Array,  # int32[T, 128] gathered doc-id tiles
+    tf_rows: jax.Array,  # int32[T, 128]
+    tile_weights: jax.Array,  # float32[T] boost*idf per tile
+    tile_valid: jax.Array,  # bool[T]
+    inv_norm: jax.Array,  # float32[n_docs] cache[norm_byte] per doc
+    n_docs: int,
+) -> Tuple[jax.Array, jax.Array]:
+    """Returns (scores[float32, n_docs], match_counts[int32, n_docs]).
+
+    score contribution per posting: w - w / (1 + tf * inv_norm[doc])
+    (BM25Similarity.score with the 256-entry norm-inverse cache folded
+    into a dense per-doc array).
+    """
+    return _score_tiles_inner(
+        doc_rows, tf_rows, tile_weights, tile_valid, inv_norm, n_docs
+    )
+
+
+@functools.partial(jax.jit, static_argnames=("k",))
+def topk_hits(scores: jax.Array, mask: jax.Array, k: int) -> Tuple[jax.Array, jax.Array]:
+    """(top scores, top doc ids), score desc / doc asc (lax.top_k keeps the
+    lowest index among equals). Masked-out docs get -inf and surface as
+    doc id entries with -inf score; callers trim by count."""
+    masked = jnp.where(mask, scores, -jnp.inf)
+    return jax.lax.top_k(masked, k)
+
+
+class BatchedScoreResult(NamedTuple):
+    scores: jax.Array  # float32[B, k]
+    docs: jax.Array  # int32[B, k]
+    totals: jax.Array  # int32[B] number of matching docs
+
+
+def make_batched_bm25_scorer(doc_ids, tfs, inv_norm, n_docs: int, k: int):
+    """Builds a jitted batched scorer closed over HBM-resident postings.
+
+    Scores B queries in one launch: gathers [B, T, 128] tiles, BM25s them
+    on the VPU, scatter-adds per query, applies minimum-should-match, and
+    returns per-query top-k. One compilation per (B, T) bucket.
+
+    Args live on device: doc_ids/tfs int32[n_tiles, 128], inv_norm
+    float32[n_docs].
+    """
+    doc_ids = jnp.asarray(doc_ids)
+    tfs = jnp.asarray(tfs)
+    inv_norm = jnp.asarray(inv_norm, jnp.float32)
+
+    @jax.jit
+    def score_batch(
+        tile_idx: jax.Array,  # int32[B, T]
+        tile_weights: jax.Array,  # float32[B, T]
+        tile_valid: jax.Array,  # bool[B, T]
+        msm: jax.Array,  # int32[B] min matching terms (1 = OR, n_terms = AND)
+    ) -> BatchedScoreResult:
+        rows_doc = doc_ids[tile_idx]  # [B, T, 128]
+        rows_tf = tfs[tile_idx]
+
+        def one(rd, rt, w, v, m):
+            scores, cnt = _score_tiles_inner(rd, rt, w, v, inv_norm, n_docs)
+            mask = cnt >= jnp.maximum(m, 1)
+            s, d = topk_hits(scores, mask, k)
+            return s, d, mask.sum().astype(jnp.int32)
+
+        s, d, t = jax.vmap(one)(rows_doc, rows_tf, tile_weights, tile_valid, msm)
+        return BatchedScoreResult(s, d, t)
+
+    return score_batch
+
+
+def _score_tiles_inner(doc_rows, tf_rows, tile_weights, tile_valid, inv_norm, n_docs):
+    valid = (doc_rows >= 0) & tile_valid[:, None]
+    docs = jnp.where(valid, doc_rows, n_docs)
+    safe = jnp.clip(doc_rows, 0, max(n_docs - 1, 0))
+    inv = inv_norm[safe]
+    tf = tf_rows.astype(jnp.float32)
+    w = tile_weights[:, None]
+    s = w - w / (jnp.float32(1.0) + tf * inv)
+    s = jnp.where(valid, s, 0.0)
+    acc = jnp.zeros(n_docs + 1, jnp.float32).at[docs.ravel()].add(s.ravel())
+    cnt = (
+        jnp.zeros(n_docs + 1, jnp.int32)
+        .at[docs.ravel()]
+        .add(valid.ravel().astype(jnp.int32))
+    )
+    return acc[:n_docs], cnt[:n_docs]
+
+
+# ---------------- kNN ----------------
+
+
+@functools.partial(jax.jit, static_argnames=("similarity", "k"))
+def knn_topk(
+    queries: jax.Array,  # float32[B, d]
+    vectors: jax.Array,  # float32[N, d] (unit-normalized for cosine)
+    exists: jax.Array,  # bool[N]
+    similarity: str,
+    k: int,
+) -> Tuple[jax.Array, jax.Array]:
+    """Brute-force kNN: one MXU matmul + top_k per query batch.
+
+    Score transforms mirror Lucene VectorSimilarityFunction as mapped by
+    DenseVectorFieldMapper (see models/similarity.py).
+    """
+    if similarity == "l2_norm":
+        # ||q - v||² = |q|² + |v|² - 2 q·v — matmul-friendly
+        dots = queries @ vectors.T
+        q2 = jnp.sum(queries * queries, axis=1, keepdims=True)
+        v2 = jnp.sum(vectors * vectors, axis=1)[None, :]
+        d2 = jnp.maximum(q2 + v2 - 2.0 * dots, 0.0)
+        scores = 1.0 / (1.0 + d2)
+    else:
+        if similarity == "cosine":
+            qn = jnp.linalg.norm(queries, axis=1, keepdims=True)
+            queries = queries / jnp.where(qn == 0, 1.0, qn)
+        dots = queries @ vectors.T
+        if similarity in ("cosine", "dot_product"):
+            scores = (1.0 + dots) / 2.0
+        elif similarity == "max_inner_product":
+            scores = jnp.where(dots < 0, 1.0 / (1.0 - dots), dots + 1.0)
+        else:
+            raise ValueError(f"unknown similarity [{similarity}]")
+    scores = jnp.where(exists[None, :], scores.astype(jnp.float32), -jnp.inf)
+    return jax.lax.top_k(scores, k)

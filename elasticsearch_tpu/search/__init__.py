@@ -1,0 +1,5 @@
+from . import dsl
+from .executor import NumpyExecutor, ShardReader, TopDocs, Hit
+from .executor_jax import JaxExecutor
+
+__all__ = ["dsl", "NumpyExecutor", "JaxExecutor", "ShardReader", "TopDocs", "Hit"]

@@ -134,6 +134,49 @@ class TestAnalyzers:
         )
         assert reg.get("x").terms("<b>Hello</b> &amp; World") == ["hello", "world"]
 
+    def test_html_strip_preserves_stray_lt(self):
+        reg = AnalysisRegistry(
+            {
+                "analysis": {
+                    "analyzer": {
+                        "x": {
+                            "type": "custom",
+                            "tokenizer": "standard",
+                            "char_filter": ["html_strip"],
+                            "filter": ["lowercase"],
+                        }
+                    }
+                }
+            }
+        )
+        # a stray '<' must not swallow text up to the next '>'
+        assert reg.get("x").terms("price < 100 and > 50") == [
+            "price",
+            "100",
+            "and",
+            "50",
+        ]
+
+    def test_mapping_char_filter_single_pass(self):
+        reg = AnalysisRegistry(
+            {
+                "analysis": {
+                    "char_filter": {
+                        "chain": {"type": "mapping", "mappings": ["a=>b", "b=>c"]}
+                    },
+                    "analyzer": {
+                        "x": {
+                            "type": "custom",
+                            "tokenizer": "keyword",
+                            "char_filter": ["chain"],
+                        }
+                    },
+                }
+            }
+        )
+        # output of a=>b is not re-scanned by b=>c
+        assert reg.get("x").terms("ab") == ["bc"]
+
     def test_mapping_char_filter(self):
         reg = AnalysisRegistry(
             {

@@ -1,0 +1,100 @@
+"""The batched BM25 scorer (one [B,T,128] launch for B queries) must agree
+with the oracle per query — this is the benchmark hot path."""
+
+import numpy as np
+import jax.numpy as jnp
+
+from elasticsearch_tpu.analysis import AnalysisRegistry
+from elasticsearch_tpu.index.mapping import DocumentParser, Mappings
+from elasticsearch_tpu.index.segment import SegmentBuilder
+from elasticsearch_tpu.models import bm25
+from elasticsearch_tpu.ops import scoring
+from elasticsearch_tpu.search import dsl
+from elasticsearch_tpu.search.executor import NumpyExecutor, ShardReader
+
+VOCAB = ["red", "green", "blue", "cyan", "teal", "pink", "gold", "gray"]
+
+
+def build(n_docs=150, seed=13):
+    rng = np.random.default_rng(seed)
+    mappings = Mappings({"properties": {"body": {"type": "text"}}})
+    analysis = AnalysisRegistry()
+    parser = DocumentParser(mappings, analysis)
+    b = SegmentBuilder(mappings)
+    p = 1.0 / np.arange(1, len(VOCAB) + 1)
+    p /= p.sum()
+    for i in range(n_docs):
+        words = rng.choice(VOCAB, size=int(rng.integers(3, 40)), p=p)
+        b.add(parser.parse(f"d{i}", {"body": " ".join(words)}))
+    seg = b.build()
+    return ShardReader([seg], mappings, analysis), seg
+
+
+def test_batched_matches_oracle():
+    reader, seg = build()
+    oracle = NumpyExecutor(reader)
+    pf = seg.postings["body"]
+    n = seg.num_docs
+    k = 10
+
+    # per-doc inverse-norm array
+    cache = oracle._field_cache("body")
+    inv_norm = cache[pf.norms.astype(np.int64)]
+
+    scorer = scoring.make_batched_bm25_scorer(pf.doc_ids, pf.tfs, inv_norm, n, k)
+
+    queries = [
+        ("red", "or"),
+        ("red green", "or"),
+        ("red green blue", "and"),
+        ("teal gold", "or"),
+        ("pink gray cyan", "and"),
+        ("blue", "or"),
+        ("green blue teal pink", "or"),
+        ("red red green", "or"),  # duplicate term, each occurrence scores
+    ]
+    T = 16
+    B = len(queries)
+    tile_idx = np.zeros((B, T), np.int32)
+    tile_w = np.zeros((B, T), np.float32)
+    tile_v = np.zeros((B, T), bool)
+    msm = np.zeros(B, np.int32)
+    for qi, (text, op) in enumerate(queries):
+        terms = text.split()
+        idx_list, w_list = [], []
+        for t in terms:
+            tid = pf.term_id(t)
+            assert tid >= 0
+            s0, c0 = int(pf.term_tile_start[tid]), int(pf.term_tile_count[tid])
+            w = float(oracle._term_weight("body", t))
+            idx_list.extend(range(s0, s0 + c0))
+            w_list.extend([w] * c0)
+        idx, w, v = scoring.pad_tiles(
+            np.asarray(idx_list, np.int32), np.asarray(w_list, np.float32), bucket=T
+        )
+        tile_idx[qi], tile_w[qi], tile_v[qi] = idx, w, v
+        msm[qi] = len(terms) if op == "and" else 1
+
+    res = scorer(
+        jnp.asarray(tile_idx),
+        jnp.asarray(tile_w),
+        jnp.asarray(tile_v),
+        jnp.asarray(msm),
+    )
+    scores = np.asarray(res.scores)
+    docs = np.asarray(res.docs)
+    totals = np.asarray(res.totals)
+
+    for qi, (text, op) in enumerate(queries):
+        q = dsl.parse_query({"match": {"body": {"query": text, "operator": op}}})
+        ref = oracle.search(q, size=k)
+        assert totals[qi] == ref.total, (text, op)
+        n_hits = min(k, ref.total)
+        for j in range(n_hits):
+            assert docs[qi, j] == ref.hits[j].local_doc, (text, j)
+            np.testing.assert_allclose(
+                scores[qi, j], ref.hits[j].score, rtol=1e-5, atol=1e-6
+            )
+        # beyond the real hits, scores must be -inf
+        for j in range(n_hits, k):
+            assert np.isneginf(scores[qi, j])

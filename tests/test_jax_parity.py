@@ -1,0 +1,200 @@
+"""Property tests: the JAX executor must match the NumPy oracle hit-for-hit
+on randomized corpora (the recall-parity gate from SURVEY.md §4, in-process
+form). Runs on CPU JAX (conftest forces JAX_PLATFORMS=cpu)."""
+
+import numpy as np
+import pytest
+
+from elasticsearch_tpu.analysis import AnalysisRegistry
+from elasticsearch_tpu.index.mapping import DocumentParser, Mappings
+from elasticsearch_tpu.index.segment import SegmentBuilder
+from elasticsearch_tpu.search import dsl
+from elasticsearch_tpu.search.executor import NumpyExecutor, ShardReader
+from elasticsearch_tpu.search.executor_jax import JaxExecutor
+
+VOCAB = [
+    "alpha", "bravo", "charlie", "delta", "echo", "foxtrot", "golf",
+    "hotel", "india", "juliet", "kilo", "lima", "mike", "november",
+    "oscar", "papa", "quebec", "romeo", "sierra", "tango",
+]
+
+MAPPING = {
+    "properties": {
+        "title": {"type": "text"},
+        "body": {"type": "text"},
+        "tag": {"type": "keyword"},
+        "views": {"type": "integer"},
+        "vec": {"type": "dense_vector", "dims": 8, "similarity": "cosine"},
+    }
+}
+
+
+def zipf_text(rng, n_words):
+    # zipfian-ish draw over the vocab
+    p = 1.0 / np.arange(1, len(VOCAB) + 1)
+    p /= p.sum()
+    return " ".join(rng.choice(VOCAB, size=n_words, p=p))
+
+
+def build_readers(n_docs=300, n_segments=1, seed=7):
+    rng = np.random.default_rng(seed)
+    mappings = Mappings(MAPPING)
+    analysis = AnalysisRegistry()
+    parser = DocumentParser(mappings, analysis)
+    segs = []
+    doc_num = 0
+    for _ in range(n_segments):
+        builder = SegmentBuilder(mappings)
+        for _ in range(n_docs // n_segments):
+            src = {
+                "title": zipf_text(rng, int(rng.integers(2, 8))),
+                "body": zipf_text(rng, int(rng.integers(5, 60))),
+                "tag": str(rng.choice(["a", "b", "c", "d"])),
+                "views": int(rng.integers(0, 1000)),
+                "vec": rng.standard_normal(8).astype(np.float32).tolist(),
+            }
+            builder.add(parser.parse(f"doc-{doc_num}", src))
+            doc_num += 1
+        segs.append(builder.build())
+    reader = ShardReader(segs, mappings, analysis)
+    return NumpyExecutor(reader), JaxExecutor(reader)
+
+
+ORACLE, JAXEX = build_readers()
+ORACLE_MULTI, JAXEX_MULTI = build_readers(n_docs=200, n_segments=3, seed=11)
+
+QUERIES = [
+    {"match": {"body": "alpha"}},
+    {"match": {"body": "alpha bravo charlie"}},
+    {"match": {"body": {"query": "alpha bravo", "operator": "and"}}},
+    {"match": {"body": {"query": "alpha bravo charlie delta", "minimum_should_match": 3}}},
+    {"match": {"body": {"query": "alpha", "boost": 2.5}}},
+    {"term": {"tag": "a"}},
+    {"terms": {"tag": ["a", "c"]}},
+    {"term": {"views": 500}},
+    {"range": {"views": {"gte": 100, "lt": 700}}},
+    {"range": {"tag": {"gte": "a", "lte": "b"}}},
+    {"exists": {"field": "views"}},
+    {"match_all": {}},
+    {"constant_score": {"filter": {"match": {"body": "echo"}}, "boost": 3.0}},
+    {"multi_match": {"query": "alpha echo", "fields": ["title^2", "body"]}},
+    {"multi_match": {"query": "alpha echo", "fields": ["title", "body"], "type": "most_fields"}},
+    {"multi_match": {"query": "alpha echo", "fields": ["title", "body"], "tie_breaker": 0.3}},
+    {
+        "bool": {
+            "must": [{"match": {"body": "alpha"}}],
+            "filter": [{"range": {"views": {"gte": 50}}}],
+            "should": [{"term": {"tag": "b"}}],
+            "must_not": [{"term": {"tag": "d"}}],
+        }
+    },
+    {
+        "bool": {
+            "should": [
+                {"match": {"title": "bravo"}},
+                {"match": {"body": "quebec tango"}},
+            ],
+            "minimum_should_match": 1,
+        }
+    },
+    {"bool": {"must_not": [{"term": {"tag": "a"}}]}},
+    {
+        "bool": {
+            "must": [
+                {
+                    "bool": {
+                        "should": [
+                            {"match": {"body": "alpha"}},
+                            {"match": {"body": "bravo"}},
+                        ]
+                    }
+                }
+            ],
+            "boost": 2.0,
+        }
+    },
+]
+
+
+def assert_same(res_np, res_jax, scores_rtol=1e-5):
+    assert res_np.total == res_jax.total
+    assert len(res_np.hits) == len(res_jax.hits)
+    np_scores = np.array([h.score for h in res_np.hits])
+    jax_scores = np.array([h.score for h in res_jax.hits])
+    np.testing.assert_allclose(jax_scores, np_scores, rtol=scores_rtol, atol=1e-6)
+    # doc order must match except where adjacent scores are ulp-equal
+    for i, (hn, hj) in enumerate(zip(res_np.hits, res_jax.hits)):
+        if hn.doc_id != hj.doc_id:
+            # permissible only if scores tie within tolerance
+            assert np.isclose(hn.score, hj.score, rtol=scores_rtol), (
+                i,
+                hn,
+                hj,
+            )
+
+
+@pytest.mark.parametrize("qi", range(len(QUERIES)))
+def test_query_parity_single_segment(qi):
+    q = dsl.parse_query(QUERIES[qi])
+    assert_same(ORACLE.search(q, size=20), JAXEX.search(q, size=20))
+
+
+@pytest.mark.parametrize("qi", range(0, len(QUERIES), 3))
+def test_query_parity_multi_segment(qi):
+    q = dsl.parse_query(QUERIES[qi])
+    assert_same(ORACLE_MULTI.search(q, size=20), JAXEX_MULTI.search(q, size=20))
+
+
+def test_knn_parity():
+    rng = np.random.default_rng(3)
+    vec = rng.standard_normal(8).tolist()
+    knn = [dsl.parse_knn({"field": "vec", "query_vector": vec, "k": 15, "num_candidates": 50})]
+    assert_same(ORACLE.search(None, knn=knn, size=15), JAXEX.search(None, knn=knn, size=15))
+
+
+def test_knn_filtered_parity():
+    rng = np.random.default_rng(4)
+    vec = rng.standard_normal(8).tolist()
+    knn = [
+        dsl.parse_knn(
+            {
+                "field": "vec",
+                "query_vector": vec,
+                "k": 10,
+                "filter": {"term": {"tag": "b"}},
+            }
+        )
+    ]
+    assert_same(ORACLE.search(None, knn=knn, size=10), JAXEX.search(None, knn=knn, size=10))
+
+
+def test_hybrid_parity():
+    rng = np.random.default_rng(5)
+    vec = rng.standard_normal(8).tolist()
+    knn = [dsl.parse_knn({"field": "vec", "query_vector": vec, "k": 10})]
+    q = dsl.parse_query({"match": {"body": "alpha bravo"}})
+    assert_same(ORACLE.search(q, knn=knn, size=20), JAXEX.search(q, knn=knn, size=20))
+
+
+def test_knn_multi_segment_parity():
+    rng = np.random.default_rng(6)
+    vec = rng.standard_normal(8).tolist()
+    knn = [dsl.parse_knn({"field": "vec", "query_vector": vec, "k": 12, "num_candidates": 30})]
+    assert_same(
+        ORACLE_MULTI.search(None, knn=knn, size=12),
+        JAXEX_MULTI.search(None, knn=knn, size=12),
+    )
+
+
+def test_pagination_parity():
+    q = dsl.parse_query({"match": {"body": "alpha bravo charlie"}})
+    r_np = ORACLE.search(q, size=5, from_=5)
+    r_jx = JAXEX.search(q, size=5, from_=5)
+    assert_same(r_np, r_jx)
+
+
+def test_min_score_parity():
+    q = dsl.parse_query({"match": {"body": "alpha"}})
+    r_np = ORACLE.search(q, size=50, min_score=0.5)
+    r_jx = JAXEX.search(q, size=50, min_score=0.5)
+    assert_same(r_np, r_jx)

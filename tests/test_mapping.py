@@ -1,0 +1,64 @@
+import pytest
+
+from elasticsearch_tpu.analysis import AnalysisRegistry
+from elasticsearch_tpu.index.mapping import DocumentParser, MappingParseError, Mappings
+
+
+class TestMappingsMerge:
+    def test_add_new_field(self):
+        m = Mappings({"properties": {"a": {"type": "text"}}})
+        m.merge({"properties": {"b": {"type": "long"}}})
+        assert m.get("b").type == "long"
+
+    def test_reject_type_change(self):
+        m = Mappings({"properties": {"a": {"type": "text"}}})
+        with pytest.raises(MappingParseError, match="cannot be changed"):
+            m.merge({"properties": {"a": {"type": "long"}}})
+
+    def test_reject_analyzer_change(self):
+        m = Mappings({"properties": {"a": {"type": "text"}}})
+        with pytest.raises(MappingParseError, match="analyzer"):
+            m.merge({"properties": {"a": {"type": "text", "analyzer": "whitespace"}}})
+
+    def test_reject_dims_change(self):
+        m = Mappings({"properties": {"v": {"type": "dense_vector", "dims": 4}}})
+        with pytest.raises(MappingParseError, match="dims"):
+            m.merge({"properties": {"v": {"type": "dense_vector", "dims": 8}}})
+
+
+class TestLeafObjectConflicts:
+    def test_object_value_on_leaf_field_rejected(self):
+        m = Mappings({})
+        p = DocumentParser(m, AnalysisRegistry())
+        p.parse("1", {"a": "hello"})  # dynamically maps a: text
+        with pytest.raises(MappingParseError, match="object"):
+            p.parse("2", {"a": {"b": "world"}})
+
+    def test_multi_field_not_leaked_to_object_children(self):
+        m = Mappings(
+            {
+                "properties": {
+                    "a": {"type": "object", "properties": {"b": {"type": "text"}}},
+                }
+            }
+        )
+        p = DocumentParser(m, AnalysisRegistry())
+        d = p.parse("1", {"a": {"b": "world"}})
+        assert "a.b" in d.text_terms
+        assert "a" not in d.text_terms
+
+    def test_declared_multi_fields_indexed(self):
+        m = Mappings(
+            {
+                "properties": {
+                    "name": {
+                        "type": "text",
+                        "fields": {"raw": {"type": "keyword"}},
+                    }
+                }
+            }
+        )
+        p = DocumentParser(m, AnalysisRegistry())
+        d = p.parse("1", {"name": "Alice Smith"})
+        assert [t for t, _ in d.text_terms["name"]] == ["alice", "smith"]
+        assert d.keyword_terms["name.raw"] == ["Alice Smith"]
