@@ -1,0 +1,219 @@
+"""Benchmark: batched BM25 top-k QPS on device vs the NumPy CPU oracle.
+
+Prints ONE JSON line:
+  {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+
+The workload mirrors BASELINE.md's primary config (match-query BM25,
+single shard, default k1/b, top-10) on a synthetic Zipf corpus — MS MARCO
+itself is not available in this zero-egress image, so the corpus is
+generated with a power-law vocabulary to give realistic posting-list
+skew. ``vs_baseline`` is the speedup over the measured CPU baseline
+(the NumPy Lucene-semantics oracle executing the identical queries),
+per BASELINE.md: "the CPU baseline must be measured ... and becomes the
+denominator". Both sides produce identical rankings (asserted).
+
+All diagnostics go to stderr; stdout is exactly the one JSON line.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+import numpy as np
+
+
+def log(*a):
+    print(*a, file=sys.stderr, flush=True)
+
+
+N_DOCS = 50_000
+VOCAB = 4_000
+N_QUERIES = 512
+BATCH = 64
+K = 10
+SEED = 42
+
+
+def build_corpus():
+    rng = np.random.default_rng(SEED)
+    # Zipf vocabulary: term i has probability ~ 1/(i+1)
+    probs = 1.0 / np.arange(1, VOCAB + 1)
+    probs /= probs.sum()
+    vocab = np.array([f"w{i}" for i in range(VOCAB)])
+    lengths = rng.integers(20, 60, size=N_DOCS)
+    texts = []
+    for n in lengths:
+        texts.append(" ".join(vocab[rng.choice(VOCAB, size=n, p=probs)]))
+    return texts
+
+
+def build_index(texts):
+    from elasticsearch_tpu.analysis import AnalysisRegistry
+    from elasticsearch_tpu.index.mapping import DocumentParser, Mappings
+    from elasticsearch_tpu.index.segment import SegmentBuilder
+    from elasticsearch_tpu.search.executor import ShardReader
+
+    mappings = Mappings({"properties": {"body": {"type": "text"}}})
+    analysis = AnalysisRegistry()
+    parser = DocumentParser(mappings, analysis)
+    builder = SegmentBuilder(mappings)
+    for i, t in enumerate(texts):
+        builder.add(parser.parse(str(i), {"body": t}))
+    seg = builder.build()
+    return ShardReader([seg], mappings, analysis), seg
+
+
+def make_queries(seg):
+    """2-4 term OR queries drawn from the mid-frequency vocabulary."""
+    rng = np.random.default_rng(7)
+    pf = seg.postings["body"]
+    # skip the 20 most common terms (stopword-like) and the ultra-rare tail
+    df = pf.term_df
+    order = np.argsort(-df)
+    candidates = [pf.terms[i] for i in order[20 : min(len(order), 1500)]]
+    queries = []
+    for _ in range(N_QUERIES):
+        n = int(rng.integers(2, 5))
+        terms = rng.choice(len(candidates), size=n, replace=False)
+        queries.append([candidates[int(t)] for t in terms])
+    return queries
+
+
+def device_bench(seg, queries):
+    import jax
+
+    from elasticsearch_tpu.models import bm25
+    from elasticsearch_tpu.ops.scoring import make_batched_bm25_scorer, next_bucket
+
+    pf = seg.postings["body"]
+    st = pf.stats
+    avgdl = bm25.avg_field_length(st.sum_total_term_freq, st.doc_count or 1)
+    cache = bm25.norm_inverse_cache(avgdl)
+    inv_norm = cache[pf.norms.astype(np.int64)].astype(np.float32)
+    weights = {
+        t: float(bm25.idf(st.doc_count, int(pf.term_df[i])))
+        for i, t in enumerate(pf.terms)
+    }
+
+    # host-side query compilation (tile plans), part of the measured path
+    def compile_batch(batch, T):
+        B = len(batch)
+        tile_idx = np.zeros((B, T), np.int32)
+        tile_w = np.zeros((B, T), np.float32)
+        tile_v = np.zeros((B, T), bool)
+        for bi, terms in enumerate(batch):
+            pos = 0
+            for t in terms:
+                tid = pf.term_id(t)
+                if tid < 0:
+                    continue
+                s0 = int(pf.term_tile_start[tid])
+                c = int(pf.term_tile_count[tid])
+                tile_idx[bi, pos : pos + c] = np.arange(s0, s0 + c)
+                tile_w[bi, pos : pos + c] = weights[t]
+                tile_v[bi, pos : pos + c] = True
+                pos += c
+        return tile_idx, tile_w, tile_v, np.ones(B, np.int32)
+
+    t_max = 1
+    for terms in queries:
+        n = 0
+        for t in terms:
+            tid = pf.term_id(t)
+            if tid >= 0:
+                n += int(pf.term_tile_count[tid])
+        t_max = max(t_max, n)
+    T = next_bucket(t_max)
+    log(f"tile bucket T={T}")
+
+    scorer = make_batched_bm25_scorer(pf.doc_ids, pf.tfs, inv_norm, seg.num_docs, K)
+
+    batches = [queries[i : i + BATCH] for i in range(0, len(queries), BATCH)]
+    # warmup / compile
+    args = compile_batch(batches[0], T)
+    out = scorer(*args)
+    jax.block_until_ready(out)
+    log("compiled")
+
+    t0 = time.perf_counter()
+    results = []
+    for batch in batches:
+        args = compile_batch(batch, T)
+        results.append(scorer(*args))
+    jax.block_until_ready(results)
+    dt = time.perf_counter() - t0
+    qps = len(queries) / dt
+    log(f"device: {len(queries)} queries in {dt:.3f}s → {qps:.1f} QPS")
+    return qps, results
+
+
+def cpu_baseline(reader, queries, results, seg):
+    """NumPy oracle on the same queries; also asserts ranking parity."""
+    from elasticsearch_tpu.search import dsl
+    from elasticsearch_tpu.search.executor import NumpyExecutor
+
+    ex = NumpyExecutor(reader)
+    n_base = min(64, len(queries))
+    t0 = time.perf_counter()
+    tds = []
+    for terms in queries[:n_base]:
+        q = dsl.parse_query({"match": {"body": " ".join(terms)}})
+        tds.append(ex.search(q, size=K))
+    dt = time.perf_counter() - t0
+    qps = n_base / dt
+    log(f"cpu oracle: {n_base} queries in {dt:.3f}s → {qps:.1f} QPS")
+
+    # parity gate (BASELINE.md: parity must hold before throughput counts)
+    mism = 0
+    for qi in range(n_base):
+        bi, off = divmod(qi, BATCH)
+        ds = np.asarray(results[bi].scores[off])
+        dd = np.asarray(results[bi].docs[off])
+        oracle = tds[qi]
+        n_hits = min(len(oracle.hits), K)
+        for j in range(n_hits):
+            if int(dd[j]) != oracle.hits[j].local_doc or not np.isclose(
+                float(ds[j]), oracle.hits[j].score, rtol=1e-4
+            ):
+                mism += 1
+                break
+    if mism:
+        log(f"WARNING: {mism}/{n_base} queries mismatched oracle ranking")
+    else:
+        log(f"parity: {n_base}/{n_base} queries match oracle ranking exactly")
+    return qps, mism
+
+
+def main():
+    t0 = time.perf_counter()
+    log("building corpus…")
+    texts = build_corpus()
+    log(f"corpus built ({time.perf_counter()-t0:.1f}s); indexing…")
+    reader, seg = build_index(texts)
+    log(
+        f"indexed {seg.num_docs} docs, "
+        f"{len(seg.postings['body'].terms)} terms, "
+        f"{seg.postings['body'].n_tiles} tiles ({time.perf_counter()-t0:.1f}s)"
+    )
+    queries = make_queries(seg)
+    qps, results = device_bench(seg, queries)
+    base_qps, mism = cpu_baseline(reader, queries, results, seg)
+    # parity gates throughput (BASELINE.md): a mismatched ranking must not
+    # be reported as a valid speedup
+    vs = round(qps / base_qps, 2) if base_qps and mism == 0 else None
+    print(
+        json.dumps(
+            {
+                "metric": "bm25_top10_qps_50k_docs",
+                "value": round(qps, 1),
+                "unit": "queries/s",
+                "vs_baseline": vs,
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

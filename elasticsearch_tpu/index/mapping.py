@@ -162,12 +162,19 @@ class Mappings:
                         f"[{mine.type}] to [{f.type}]"
                     )
                 for param in ("analyzer", "dims", "similarity"):
-                    if getattr(mine, param) != getattr(f, param):
+                    theirs = getattr(f, param)
+                    if param == "dims" and not theirs:
+                        # dims omitted in the incoming mapping: keep the
+                        # (possibly doc-inferred) existing value — an
+                        # idempotent PUT-mapping must be a no-op
+                        continue
+                    if getattr(mine, param) != theirs:
                         raise MappingParseError(
                             f"Mapper for [{name}] conflicts: cannot update "
                             f"parameter [{param}] from "
-                            f"[{getattr(mine, param)}] to [{getattr(f, param)}]"
+                            f"[{getattr(mine, param)}] to [{theirs}]"
                         )
+                continue  # keep the existing (richer) field object
             self.fields[name] = f
         for parent, subs in other.multi_fields.items():
             mine_subs = self.multi_fields.setdefault(parent, [])

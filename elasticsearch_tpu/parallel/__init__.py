@@ -1,0 +1,28 @@
+"""Distributed execution: device meshes, sharded indexes, SPMD search.
+
+Reference analogs: OperationRouting / AbstractSearchAsyncAction /
+SearchPhaseController (SURVEY.md §2.6-§2.7) — redesigned as mesh-sharded
+arrays + XLA collectives instead of RPC scatter/gather.
+"""
+
+from .mesh import DATA_AXIS, SHARD_AXIS, make_mesh, mesh_shape, single_device_mesh
+from .sharded import (
+    ShardedIndex,
+    ShardedTopK,
+    build_sharded_bm25_step,
+    build_sharded_knn_step,
+    rrf_fuse,
+)
+
+__all__ = [
+    "DATA_AXIS",
+    "SHARD_AXIS",
+    "make_mesh",
+    "mesh_shape",
+    "single_device_mesh",
+    "ShardedIndex",
+    "ShardedTopK",
+    "build_sharded_bm25_step",
+    "build_sharded_knn_step",
+    "rrf_fuse",
+]

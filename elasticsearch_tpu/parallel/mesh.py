@@ -1,0 +1,52 @@
+"""Device-mesh construction for distributed search.
+
+Reference analog: Elasticsearch's distribution model (SURVEY.md §2.6) —
+an index is split into primary shards (`OperationRouting.shardId =
+hash(_routing) % P`) and every search fans out to one copy of each shard
+(`AbstractSearchAsyncAction`). On TPU the fan-out is not RPC: shards are
+a named mesh axis and the per-shard arrays are laid out with
+`jax.sharding.NamedSharding`, so "send the query to every shard" is just
+running one `shard_map`ped program over the mesh, and "merge shard
+responses" is an `all_gather` over the ICI.
+
+Two mesh axes:
+  - ``shards``: partitions of the document space (ES data parallelism);
+  - ``data``:   concurrent query batches (the ES coordinator serving many
+                searches at once — replica/ARS throughput scaling).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+SHARD_AXIS = "shards"
+DATA_AXIS = "data"
+
+
+def make_mesh(
+    n_shards: int,
+    n_data: int = 1,
+    devices: Optional[Sequence[jax.Device]] = None,
+) -> Mesh:
+    """Builds a (data, shards) mesh over ``n_data * n_shards`` devices."""
+    devices = list(devices if devices is not None else jax.devices())
+    need = n_shards * n_data
+    if len(devices) < need:
+        raise ValueError(
+            f"mesh needs {need} devices (data={n_data} x shards={n_shards}), "
+            f"have {len(devices)}"
+        )
+    grid = np.asarray(devices[:need]).reshape(n_data, n_shards)
+    return Mesh(grid, (DATA_AXIS, SHARD_AXIS))
+
+
+def single_device_mesh() -> Mesh:
+    return make_mesh(1, 1)
+
+
+def mesh_shape(mesh: Mesh) -> Tuple[int, int]:
+    return mesh.shape[DATA_AXIS], mesh.shape[SHARD_AXIS]

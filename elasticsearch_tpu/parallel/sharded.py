@@ -1,0 +1,413 @@
+"""Sharded (multi-chip) search execution over a device mesh.
+
+Reference analog: the coordinator scatter/gather pipeline
+(`TransportSearchAction` → per-shard `SearchService.executeQueryPhase` →
+`SearchPhaseController.reducedQueryPhase`, SURVEY.md §3.3). The TPU-native
+redesign collapses the whole round-trip into ONE SPMD program:
+
+  - every shard's tiled postings live stacked on the ``shards`` mesh axis
+    (`doc_ids[S, T, 128]` with `PartitionSpec('shards', None, None)`);
+  - a query batch is sharded over the ``data`` axis (many concurrent
+    searches — the ES coordinator's in-flight search set);
+  - inside `shard_map`, each device scores ITS shard for ITS slice of the
+    query batch (QueryPhase), takes a local top-k, and the shard-merge
+    (`QueryPhaseResultConsumer` / reduce) is a `lax.all_gather` over the
+    ICI followed by a k-way `top_k` — no transport layer, no
+    serialization, no per-shard RPC correlation.
+
+Tie-break parity: Lucene's coordinator merge orders (score desc,
+shard asc, doc asc). `lax.top_k` keeps the lowest index among equal
+scores, and the gathered axis is laid out shard-major with per-shard
+results already doc-ascending among ties, so the merged ordering matches.
+
+Totals (`hits.total.value`) reduce with a `psum` over ``shards`` — the
+analog of summing each shard's `QuerySearchResult.totalHits`.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Dict, List, NamedTuple, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..index.segment import INVALID_DOC, TILE, Segment
+from ..models import bm25
+from ..ops.scoring import _score_tiles_inner, next_bucket
+from .mesh import DATA_AXIS, SHARD_AXIS
+
+shard_map = jax.shard_map
+
+
+class ShardedTopK(NamedTuple):
+    scores: jax.Array  # float32[B, k] merged, score desc
+    global_docs: jax.Array  # int32[B, k] doc_base[shard] + local doc (-1 pad)
+    totals: jax.Array  # int32[B] total matching docs across shards
+
+
+@dataclass
+class _ShardPostings:
+    """Host-side per-shard postings handle for one field."""
+
+    segment: Segment
+    field: str
+    inv_norm: np.ndarray  # float32[n_docs_padded]
+
+
+class ShardedIndex:
+    """Stacks S single-shard segments into mesh-sharded device arrays.
+
+    The ES analog of an index with `number_of_shards: S` whose shards are
+    pinned one-per-chip (BASELINE.json north star: "shards pinned to
+    distinct chips"). Each shard is an independent Segment (its own term
+    dictionary, norms, stats — exactly like an ES shard is a full Lucene
+    index); this class pads them to a common dense shape and lays the
+    stack out over the ``shards`` mesh axis.
+    """
+
+    def __init__(
+        self,
+        mesh: Mesh,
+        segments: Sequence[Segment],
+        field: str,
+        k1: float = bm25.DEFAULT_K1,
+        b: float = bm25.DEFAULT_B,
+        vector_field: Optional[str] = None,
+    ):
+        if mesh.shape[SHARD_AXIS] != len(segments):
+            raise ValueError(
+                f"{len(segments)} shards but mesh '{SHARD_AXIS}' axis is "
+                f"{mesh.shape[SHARD_AXIS]}"
+            )
+        self.mesh = mesh
+        self.segments = list(segments)
+        self.field = field
+        self.n_shards = len(segments)
+        self.k1 = k1
+        self.b = b
+
+        # ---- per-shard BM25 term weights (each shard uses ITS OWN stats,
+        # like per-shard IDF without the optional DFS phase) ----
+        self._weights: List[Dict[str, float]] = []
+        self._inv_norms: List[np.ndarray] = []
+        n_tiles_max = 1
+        n_docs_max = 1
+        for seg in self.segments:
+            pf = seg.postings.get(field)
+            if pf is None or pf.n_tiles == 0:
+                self._weights.append({})
+                self._inv_norms.append(np.zeros(max(seg.num_docs, 1), np.float32))
+                n_docs_max = max(n_docs_max, max(seg.num_docs, 1))
+                continue
+            st = pf.stats
+            doc_count = st.doc_count or 1
+            avgdl = bm25.avg_field_length(st.sum_total_term_freq, doc_count)
+            cache = bm25.norm_inverse_cache(avgdl, k1, b)
+            self._weights.append(
+                {
+                    t: float(bm25.idf(doc_count, int(pf.term_df[i])))
+                    for i, t in enumerate(pf.terms)
+                }
+            )
+            self._inv_norms.append(cache[pf.norms.astype(np.int64)])
+            n_tiles_max = max(n_tiles_max, pf.n_tiles)
+            n_docs_max = max(n_docs_max, seg.num_docs)
+        self.n_docs_max = n_docs_max
+        self.n_tiles_max = n_tiles_max
+
+        # ---- stacked, padded device arrays sharded over 'shards' ----
+        S = self.n_shards
+        doc_ids = np.full((S, n_tiles_max, TILE), INVALID_DOC, np.int32)
+        tfs = np.zeros((S, n_tiles_max, TILE), np.int32)
+        inv_norm = np.zeros((S, n_docs_max), np.float32)
+        doc_base = np.zeros(S, np.int32)
+        base = 0
+        for si, seg in enumerate(self.segments):
+            pf = seg.postings.get(field)
+            if pf is not None and pf.n_tiles:
+                doc_ids[si, : pf.n_tiles] = pf.doc_ids
+                tfs[si, : pf.n_tiles] = pf.tfs
+            inv_norm[si, : len(self._inv_norms[si])] = self._inv_norms[si]
+            doc_base[si] = base
+            base += seg.num_docs
+        self.total_docs = base
+
+        shard3 = NamedSharding(mesh, P(SHARD_AXIS, None, None))
+        shard2 = NamedSharding(mesh, P(SHARD_AXIS, None))
+        shard1 = NamedSharding(mesh, P(SHARD_AXIS))
+        self.doc_ids = jax.device_put(doc_ids, shard3)
+        self.tfs = jax.device_put(tfs, shard3)
+        self.inv_norm = jax.device_put(inv_norm, shard2)
+        self.doc_base = jax.device_put(doc_base, shard1)
+
+        # ---- optional dense-vector shard stack ----
+        self.vector_field = vector_field
+        self.vectors = None
+        self.vec_exists = None
+        if vector_field is not None:
+            dims = None
+            for seg in self.segments:
+                vf = seg.vectors.get(vector_field)
+                if vf is not None:
+                    dims = vf.vectors.shape[1]
+                    break
+            if dims is not None:
+                vecs = np.zeros((S, n_docs_max, dims), np.float32)
+                exists = np.zeros((S, n_docs_max), bool)
+                for si, seg in enumerate(self.segments):
+                    vf = seg.vectors.get(vector_field)
+                    if vf is None:
+                        continue
+                    mat = (
+                        vf.unit_vectors
+                        if vf.similarity == "cosine" and vf.unit_vectors is not None
+                        else vf.vectors
+                    )
+                    vecs[si, : seg.num_docs] = mat
+                    exists[si, : seg.num_docs] = vf.exists
+                self.vectors = jax.device_put(vecs, shard3)
+                self.vec_exists = jax.device_put(exists, shard2)
+
+    # ---- host-side query compilation (the per-shard Weight creation) ----
+
+    def compile_queries(
+        self,
+        term_lists: Sequence[Sequence[str]],
+        operators: Optional[Sequence[str]] = None,
+        bucket: Optional[int] = None,
+    ) -> Tuple[np.ndarray, np.ndarray, np.ndarray, np.ndarray]:
+        """Queries → per-(shard, query) padded tile plans.
+
+        Returns (tile_idx[S,B,T], tile_w[S,B,T], tile_v[S,B,T], msm[B]).
+        Each shard resolves the same terms against its own dictionary and
+        stats — the analog of per-shard `Weight` creation in
+        `SearchService.executeQueryPhase`.
+        """
+        B = len(term_lists)
+        plans: List[List[Tuple[List[int], List[float]]]] = []
+        t_max = 1
+        for si, seg in enumerate(self.segments):
+            pf = seg.postings.get(self.field)
+            shard_plans: List[Tuple[List[int], List[float]]] = []
+            for terms in term_lists:
+                idxs: List[int] = []
+                ws: List[float] = []
+                if pf is not None:
+                    for t in terms:
+                        tid = pf.term_id(t)
+                        if tid < 0:
+                            continue
+                        start = int(pf.term_tile_start[tid])
+                        cnt = int(pf.term_tile_count[tid])
+                        w = self._weights[si].get(t, 0.0)
+                        idxs.extend(range(start, start + cnt))
+                        ws.extend([w] * cnt)
+                t_max = max(t_max, len(idxs))
+                shard_plans.append((idxs, ws))
+            plans.append(shard_plans)
+        T = bucket or next_bucket(t_max)
+        S = self.n_shards
+        tile_idx = np.zeros((S, B, T), np.int32)
+        tile_w = np.zeros((S, B, T), np.float32)
+        tile_v = np.zeros((S, B, T), bool)
+        for si in range(S):
+            for bi, (idxs, ws) in enumerate(plans[si]):
+                t = len(idxs)
+                tile_idx[si, bi, :t] = idxs
+                tile_w[si, bi, :t] = ws
+                tile_v[si, bi, :t] = True
+        msm = np.ones(B, np.int32)
+        if operators is not None:
+            for bi, op in enumerate(operators):
+                if op == "and":
+                    msm[bi] = len(term_lists[bi])
+        return tile_idx, tile_w, tile_v, msm
+
+
+def build_sharded_bm25_step(index: ShardedIndex, k: int):
+    """Jitted SPMD search step: per-shard score+top-k, ICI merge.
+
+    fn(tile_idx[S,B,T], tile_w, tile_v, msm[B]) -> ShardedTopK with the
+    query batch B sharded over the ``data`` axis and postings over
+    ``shards``; the returned top-k is replicated over ``shards`` and
+    sharded over ``data``.
+    """
+    mesh = index.mesh
+    n_docs = index.n_docs_max
+
+    def body(doc_ids, tfs, inv_norm, doc_base, tile_idx, tile_w, tile_v, msm):
+        # block shapes: doc_ids[1,T_all,128], tile_idx[1,Bd,T], msm[Bd]
+        doc_ids = doc_ids[0]
+        tfs = tfs[0]
+        inv_norm = inv_norm[0]
+        base = doc_base[0]
+        rows_doc = doc_ids[tile_idx[0]]  # [Bd, T, 128]
+        rows_tf = tfs[tile_idx[0]]
+
+        def one(rd, rt, w, v, m):
+            scores, cnt = _score_tiles_inner(rd, rt, w, v, inv_norm, n_docs)
+            mask = cnt >= jnp.maximum(m, 1)
+            masked = jnp.where(mask, scores, -jnp.inf)
+            s, d = jax.lax.top_k(masked, min(k, n_docs))
+            return s, d, mask.sum().astype(jnp.int32)
+
+        s, d, t = jax.vmap(one)(
+            rows_doc, rows_tf, tile_w[0], tile_v[0], msm
+        )  # [Bd,k'] [Bd,k'] [Bd]
+        kk = s.shape[1]
+        gdoc = jnp.where(s > -jnp.inf, d + base, -1)
+        # ---- shard merge over ICI (the coordinator reduce) ----
+        gs = jax.lax.all_gather(s, SHARD_AXIS)  # [S, Bd, k']
+        gd = jax.lax.all_gather(gdoc, SHARD_AXIS)
+        S_ = gs.shape[0]
+        gs = jnp.transpose(gs, (1, 0, 2)).reshape(-1, S_ * kk)  # [Bd, S*k']
+        gd = jnp.transpose(gd, (1, 0, 2)).reshape(-1, S_ * kk)
+        ms, mi = jax.lax.top_k(gs, min(k, S_ * kk))
+        md = jnp.take_along_axis(gd, mi, axis=1)
+        totals = jax.lax.psum(t, SHARD_AXIS)
+        return ms, md, totals
+
+    p_post3 = P(SHARD_AXIS, None, None)
+    p_post2 = P(SHARD_AXIS, None)
+    p_q = P(SHARD_AXIS, DATA_AXIS, None)
+    p_out = P(DATA_AXIS, None)
+
+    fn = shard_map(
+        body,
+        mesh=mesh,
+        in_specs=(p_post3, p_post3, p_post2, P(SHARD_AXIS), p_q, p_q, p_q, P(DATA_AXIS)),
+        out_specs=(p_out, p_out, P(DATA_AXIS)),
+        check_vma=False,
+    )
+
+    @jax.jit
+    def step(tile_idx, tile_w, tile_v, msm):
+        s, d, t = fn(
+            index.doc_ids,
+            index.tfs,
+            index.inv_norm,
+            index.doc_base,
+            tile_idx,
+            tile_w,
+            tile_v,
+            msm,
+        )
+        return ShardedTopK(s, d, t)
+
+    return step
+
+
+def build_sharded_knn_step(index: ShardedIndex, k: int, similarity: str = "cosine"):
+    """SPMD brute-force kNN: per-shard MXU matmul + top-k, ICI merge.
+
+    fn(queries[B, d]) -> ShardedTopK. Queries sharded over ``data`` and
+    replicated over ``shards``; one (B/d × d)·(d × N) matmul per chip —
+    the reference's `KnnFloatVectorQuery` DFS round (SURVEY.md §3.4)
+    without the graph walk.
+    """
+    if index.vectors is None:
+        raise ValueError(f"index has no vector field [{index.vector_field}]")
+    mesh = index.mesh
+
+    def body(vectors, exists, doc_base, queries):
+        vectors = vectors[0]  # [N, dims]
+        exists = exists[0]
+        base = doc_base[0]
+        q = queries
+        if similarity == "cosine":
+            qn = jnp.linalg.norm(q, axis=1, keepdims=True)
+            q = q / jnp.where(qn == 0, 1.0, qn)
+        dots = q @ vectors.T  # [Bd, N] — MXU
+        if similarity in ("cosine", "dot_product"):
+            scores = (1.0 + dots) / 2.0
+        elif similarity == "l2_norm":
+            q2 = jnp.sum(q * q, axis=1, keepdims=True)
+            v2 = jnp.sum(vectors * vectors, axis=1)[None, :]
+            scores = 1.0 / (1.0 + jnp.maximum(q2 + v2 - 2.0 * dots, 0.0))
+        elif similarity == "max_inner_product":
+            scores = jnp.where(dots < 0, 1.0 / (1.0 - dots), dots + 1.0)
+        else:
+            raise ValueError(f"unknown similarity [{similarity}]")
+        scores = jnp.where(exists[None, :], scores.astype(jnp.float32), -jnp.inf)
+        kk = min(k, scores.shape[1])
+        s, d = jax.lax.top_k(scores, kk)
+        gdoc = jnp.where(s > -jnp.inf, d + base, -1)
+        gs = jax.lax.all_gather(s, SHARD_AXIS)
+        gd = jax.lax.all_gather(gdoc, SHARD_AXIS)
+        S_ = gs.shape[0]
+        gs = jnp.transpose(gs, (1, 0, 2)).reshape(-1, S_ * kk)
+        gd = jnp.transpose(gd, (1, 0, 2)).reshape(-1, S_ * kk)
+        ms, mi = jax.lax.top_k(gs, min(k, S_ * kk))
+        md = jnp.take_along_axis(gd, mi, axis=1)
+        totals = jax.lax.psum(
+            jnp.sum(exists).astype(jnp.int32) * jnp.ones(s.shape[0], jnp.int32),
+            SHARD_AXIS,
+        )
+        return ms, md, totals
+
+    fn = shard_map(
+        body,
+        mesh=mesh,
+        in_specs=(
+            P(SHARD_AXIS, None, None),
+            P(SHARD_AXIS, None),
+            P(SHARD_AXIS),
+            P(DATA_AXIS, None),
+        ),
+        out_specs=(P(DATA_AXIS, None), P(DATA_AXIS, None), P(DATA_AXIS)),
+        check_vma=False,
+    )
+
+    @jax.jit
+    def step(queries):
+        s, d, t = fn(index.vectors, index.vec_exists, index.doc_base, queries)
+        return ShardedTopK(s, d, t)
+
+    return step
+
+
+def rrf_fuse(
+    lex: ShardedTopK, vec: ShardedTopK, k: int, rank_constant: int = 60
+) -> Tuple[jax.Array, jax.Array]:
+    """Reciprocal-rank fusion of two ranked lists (x-pack rank-rrf:
+    `RRFQueryPhaseRankCoordinatorContext`, score = Σ 1/(rank_constant+rank)).
+
+    Device-side: builds sparse rank maps by comparing global doc ids, no
+    host round-trip. Returns (scores[B,k], global_docs[B,k]).
+    """
+
+    @jax.jit
+    def fuse(ls, ld, vs, vd):
+        B, kl = ld.shape
+        kv = vd.shape[1]
+        ranks_l = jnp.arange(1, kl + 1, dtype=jnp.float32)[None, :]
+        ranks_v = jnp.arange(1, kv + 1, dtype=jnp.float32)[None, :]
+        contrib_l = jnp.where(ld >= 0, 1.0 / (rank_constant + ranks_l), 0.0)
+        contrib_v = jnp.where(vd >= 0, 1.0 / (rank_constant + ranks_v), 0.0)
+        # candidate set = union of both lists (dedup via pairwise compare)
+        docs = jnp.concatenate([ld, vd], axis=1)  # [B, kl+kv]
+        scr_l = jnp.where(
+            (docs[:, :, None] == ld[:, None, :]) & (ld[:, None, :] >= 0),
+            contrib_l[:, None, :],
+            0.0,
+        ).sum(-1)
+        scr_v = jnp.where(
+            (docs[:, :, None] == vd[:, None, :]) & (vd[:, None, :] >= 0),
+            contrib_v[:, None, :],
+            0.0,
+        ).sum(-1)
+        fused = jnp.where(docs >= 0, scr_l + scr_v, -jnp.inf)
+        # dedup: keep first occurrence of each doc
+        first = (docs[:, :, None] == docs[:, None, :]) & (
+            jnp.arange(docs.shape[1])[None, None, :]
+            < jnp.arange(docs.shape[1])[None, :, None]
+        )
+        fused = jnp.where(first.any(-1), -jnp.inf, fused)
+        s, i = jax.lax.top_k(fused, min(k, fused.shape[1]))
+        d = jnp.take_along_axis(docs, i, axis=1)
+        return s, jnp.where(s > -jnp.inf, d, -1)
+
+    return fuse(lex.scores, lex.global_docs, vec.scores, vec.global_docs)

@@ -48,7 +48,6 @@ class DevicePostings:
     def __init__(self, pf, device=None):
         self.doc_ids = jax.device_put(pf.doc_ids, device)
         self.tfs = jax.device_put(pf.tfs, device)
-        self.norms = jax.device_put(pf.norms.astype(np.int32), device)
 
 
 class DeviceSegment:
@@ -149,25 +148,43 @@ class JaxExecutor:
                 mask = mask & (scores >= jnp.float32(min_score))
             per_segment.append((np.asarray(mask), np.asarray(scores)))
 
-        # global collection (same as oracle): score desc, (segment, doc) asc
+        # global collection (same ordering as the oracle): score desc,
+        # (segment, doc) asc — vectorized over the matching docs only
         total = int(sum(m.sum() for m, _ in per_segment))
-        entries = []
+        cand_scores: List[np.ndarray] = []
+        cand_seg: List[np.ndarray] = []
+        cand_doc: List[np.ndarray] = []
         for si, (mask, scores) in enumerate(per_segment):
             idx = np.nonzero(mask)[0]
-            for i in idx:
-                entries.append((-float(scores[i]), si, int(i)))
-        entries.sort()
-        top = entries[from_ : from_ + size]
+            if len(idx):
+                cand_scores.append(scores[idx].astype(np.float64))
+                cand_seg.append(np.full(len(idx), si, np.int64))
+                cand_doc.append(idx.astype(np.int64))
+        if not cand_scores:
+            return TopDocs(total=total, hits=[], max_score=None)
+        s = np.concatenate(cand_scores)
+        sg = np.concatenate(cand_seg)
+        dc = np.concatenate(cand_doc)
+        need = from_ + size
+        if need < len(s):
+            part = np.argpartition(-s, need)[: need + 1]
+            # keep enough candidates to break ties deterministically: take
+            # everything scoring >= the partition's lowest kept score
+            thresh = s[part].min()
+            keep = np.nonzero(s >= thresh)[0]
+            s, sg, dc = s[keep], sg[keep], dc[keep]
+        order = np.lexsort((dc, sg, -s))
+        max_score = float(s[order[0]])
+        top = order[from_ : from_ + size]
         hits = [
             Hit(
-                score=-negs,
-                segment=si,
-                local_doc=doc,
-                doc_id=self.reader.segments[si].doc_ids[doc],
+                score=float(s[i]),
+                segment=int(sg[i]),
+                local_doc=int(dc[i]),
+                doc_id=self.reader.segments[int(sg[i])].doc_ids[int(dc[i])],
             )
-            for negs, si, doc in top
+            for i in top
         ]
-        max_score = -entries[0][0] if entries else None
         return TopDocs(total=total, hits=hits, max_score=max_score)
 
     # ---- node dispatch ----
@@ -184,11 +201,7 @@ class JaxExecutor:
         if isinstance(q, TermQuery):
             return self._exec_term(q, si)
         if isinstance(q, TermsQuery):
-            m = jnp.zeros(n, bool)
-            for v in q.values:
-                tm, _ = self._exec_term(TermQuery(field=q.field, value=v), si)
-                m = m | tm
-            return m, jnp.where(m, jnp.float32(q.boost), 0.0)
+            return self._exec_terms(q, si)
         if isinstance(q, RangeQuery):
             return self._exec_range(q, si)
         if isinstance(q, ExistsQuery):
@@ -292,6 +305,36 @@ class JaxExecutor:
         target = _coerce_numeric(mf.type, q.value)
         mask = exists & (values == target)
         return mask, jnp.where(mask, jnp.float32(q.boost), 0.0)
+
+    def _exec_terms(self, q: TermsQuery, si: int) -> Tuple[jax.Array, jax.Array]:
+        seg = self.reader.segments[si]
+        n = seg.num_docs
+        mf = self.reader.mappings.get(q.field)
+        if q.field != "_id" and mf is not None and mf.type in (TEXT, KEYWORD):
+            # one combined kernel launch for all values (constant-score,
+            # so only the match counts matter)
+            vals = [
+                ("true" if v else "false") if isinstance(v, bool) else str(v)
+                for v in q.values
+            ]
+            _, cnt = self._field_terms_scored(si, q.field, vals, 1.0)
+            mask = cnt >= 1
+            return mask, jnp.where(mask, jnp.float32(q.boost), 0.0)
+        if q.field != "_id" and mf is not None:
+            dn = self.device_segments[si].numerics.get(q.field)
+            if dn is None:
+                return jnp.zeros(n, bool), jnp.zeros(n, jnp.float32)
+            values, exists = dn
+            targets = np.array(
+                [_coerce_numeric(mf.type, v) for v in q.values], np.float64
+            )
+            mask = exists & jnp.isin(values, jnp.asarray(targets))
+            return mask, jnp.where(mask, jnp.float32(q.boost), 0.0)
+        m = jnp.zeros(n, bool)
+        for v in q.values:
+            tm, _ = self._exec_term(TermQuery(field=q.field, value=v), si)
+            m = m | tm
+        return m, jnp.where(m, jnp.float32(q.boost), 0.0)
 
     def _exec_range(self, q: RangeQuery, si: int) -> Tuple[jax.Array, jax.Array]:
         seg = self.reader.segments[si]

@@ -7,12 +7,20 @@ only via bench.py / the driver.
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# Force CPU even if the ambient env points JAX at a real accelerator
+# (e.g. JAX_PLATFORMS=axon): tests must see 8 virtual devices. The env
+# var alone is not enough — a sitecustomize may register an accelerator
+# platform and override jax.config, so set the config explicitly too.
+os.environ["JAX_PLATFORMS"] = "cpu"
 xla_flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in xla_flags:
     os.environ["XLA_FLAGS"] = (
         xla_flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
 
 import numpy as np
 import pytest
