@@ -1,0 +1,296 @@
+"""IndexService: the shard set of one index, with ES routing semantics.
+
+Reference analogs: org.elasticsearch.index.IndexService (per-index shard
+registry, created by IndicesService from IndexMetadata),
+OperationRouting.shardId = floorMod(murmur3(routing), num_shards)
+(cluster/routing/IndexRouting), and the coordinator search fan-out
+(TransportSearchAction scatter + SearchPhaseController merge) collapsed
+to in-process calls — shards here are engine instances on one node; the
+mesh-distributed path lives in parallel/sharded.py.
+"""
+
+from __future__ import annotations
+
+import os
+import time
+from typing import Any, Dict, List, Optional
+
+from ..analysis import AnalysisRegistry
+from ..index.engine import OpResult, ShardEngine
+from ..index.mapping import Mappings
+from ..search import dsl
+from ..search.coordinator import merge_top_docs
+from ..search.executor import NumpyExecutor, ShardReader
+from ..utils.murmur3 import shard_id as route_shard_id
+
+DEFAULT_SETTINGS = {
+    "number_of_shards": 1,
+    "number_of_replicas": 1,
+    "refresh_interval": "1s",
+    "search.backend": "numpy",  # numpy | jax (the north-star selector)
+}
+
+
+class IndexService:
+    def __init__(
+        self,
+        name: str,
+        settings: Optional[dict] = None,
+        mappings_json: Optional[dict] = None,
+        analysis: Optional[AnalysisRegistry] = None,
+        base_path: Optional[str] = None,
+    ):
+        self.name = name
+        self.settings = dict(DEFAULT_SETTINGS)
+        if settings:
+            self.settings.update(_flatten_settings(settings))
+        self.creation_date = int(time.time() * 1000)
+        self.uuid = _index_uuid(name, self.creation_date)
+        self.mappings = Mappings(mappings_json or {})
+        self.analysis = analysis or AnalysisRegistry()
+        self.base_path = base_path
+        n = int(self.settings["number_of_shards"])
+        if n < 1:
+            raise ValueError("number_of_shards must be >= 1")
+        self.shards: List[ShardEngine] = []
+        for s in range(n):
+            shard_path = (
+                os.path.join(base_path, str(s)) if base_path is not None else None
+            )
+            self.shards.append(
+                ShardEngine(self.mappings, self.analysis, path=shard_path, shard_id=s)
+            )
+        # executor cache: shard id → (change_generation, executor)
+        self._executors: Dict[int, tuple] = {}
+
+    # ---- routing ----
+
+    def shard_for(self, doc_id: str, routing: Optional[str] = None) -> ShardEngine:
+        sid = route_shard_id(routing if routing is not None else doc_id, len(self.shards))
+        return self.shards[sid]
+
+    # ---- document ops ----
+
+    def index_doc(
+        self,
+        doc_id: str,
+        source: dict,
+        op_type: str = "index",
+        routing: Optional[str] = None,
+        **kwargs,
+    ) -> OpResult:
+        return self.shard_for(doc_id, routing).index(doc_id, source, op_type, **kwargs)
+
+    def delete_doc(
+        self, doc_id: str, routing: Optional[str] = None, **kwargs
+    ) -> OpResult:
+        return self.shard_for(doc_id, routing).delete(doc_id, **kwargs)
+
+    def get_doc(self, doc_id: str, routing: Optional[str] = None) -> Optional[dict]:
+        return self.shard_for(doc_id, routing).get(doc_id)
+
+    def refresh(self) -> None:
+        for s in self.shards:
+            s.refresh()
+
+    def flush(self) -> None:
+        for s in self.shards:
+            s.flush()
+        self._persist_meta()
+
+    def _persist_meta(self) -> None:
+        """Durable index metadata, including dynamically-added mappings —
+        the IndexMetadata persistence that in ES rides every dynamic
+        mapping update through the master (SURVEY.md §3.2)."""
+        if self.base_path is None:
+            return
+        import json
+
+        os.makedirs(self.base_path, exist_ok=True)
+        meta = {
+            "settings": {k: v for k, v in self.settings.items()},
+            "mappings": self.mappings.to_json(),
+            "uuid": self.uuid,
+            "creation_date": self.creation_date,
+        }
+        tmp = os.path.join(self.base_path, "_meta.json.tmp")
+        with open(tmp, "w", encoding="utf-8") as f:
+            json.dump(meta, f)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, os.path.join(self.base_path, "_meta.json"))
+
+    @classmethod
+    def load_meta(cls, base_path: str) -> Optional[dict]:
+        import json
+
+        try:
+            with open(os.path.join(base_path, "_meta.json"), encoding="utf-8") as f:
+                return json.load(f)
+        except (FileNotFoundError, json.JSONDecodeError):
+            return None
+
+    def close(self) -> None:
+        # flushAndClose semantics (InternalEngine.close): make everything
+        # durable, trim the WAL, persist metadata
+        self.flush()
+        for s in self.shards:
+            s.close()
+
+    # ---- search (coordinator fan-out over local shards) ----
+
+    def _executor(self, shard: ShardEngine):
+        cached = self._executors.get(shard.shard_id)
+        if cached is not None and cached[0] == shard.change_generation:
+            return cached[1]
+        reader = shard.reader()
+        backend = str(self.settings.get("search.backend", "numpy"))
+        if backend == "jax":
+            from ..search.executor_jax import JaxExecutor
+
+            ex = JaxExecutor(reader)
+        else:
+            ex = NumpyExecutor(reader)
+        self._executors[shard.shard_id] = (shard.change_generation, ex)
+        return ex
+
+    def search(self, body: Optional[dict] = None) -> dict:
+        body = body or {}
+        t0 = time.perf_counter()
+        size = int(body.get("size", 10))
+        from_ = int(body.get("from", 0))
+        min_score = body.get("min_score")
+        query = dsl.parse_query(body["query"]) if "query" in body else None
+        knn_body = body.get("knn")
+        knn = None
+        if knn_body is not None:
+            knn = [
+                dsl.parse_knn(k)
+                for k in (knn_body if isinstance(knn_body, list) else [knn_body])
+            ]
+        shard_results = []
+        executors = []  # pinned per-request so a concurrent refresh can't
+        # swap the reader between scoring and source fetch
+        for shard in self.shards:
+            ex = self._executor(shard)
+            executors.append(ex)
+            # each shard returns the full global page's worth of hits
+            td = ex.search(
+                query, size=from_ + size, from_=0, knn=knn, min_score=min_score
+            )
+            shard_results.append(td)
+        total, max_score, hits = merge_top_docs(shard_results, from_, size)
+        out_hits = []
+        for h in hits:
+            reader = executors[h.shard].reader
+            src = reader.segments[h.segment].sources[h.local_doc]
+            out_hits.append(
+                {
+                    "_index": self.name,
+                    "_id": h.doc_id,
+                    "_score": h.score,
+                    "_source": src,
+                }
+            )
+        took = int((time.perf_counter() - t0) * 1000)
+        resp = {
+            "took": took,
+            "timed_out": False,
+            "_shards": {
+                "total": len(self.shards),
+                "successful": len(self.shards),
+                "skipped": 0,
+                "failed": 0,
+            },
+            "hits": {
+                "total": {"value": total, "relation": "eq"},
+                "max_score": max_score,
+                "hits": out_hits,
+            },
+        }
+        return resp
+
+    def count(self, body: Optional[dict] = None) -> dict:
+        body = body or {}
+        query = dsl.parse_query(body["query"]) if "query" in body else None
+        total = 0
+        for shard in self.shards:
+            ex = self._executor(shard)
+            td = ex.search(query, size=0)
+            total += td.total
+        return {
+            "count": total,
+            "_shards": {
+                "total": len(self.shards),
+                "successful": len(self.shards),
+                "skipped": 0,
+                "failed": 0,
+            },
+        }
+
+    # ---- metadata ----
+
+    @property
+    def num_docs(self) -> int:
+        return sum(s.num_docs for s in self.shards)
+
+    def stats(self) -> dict:
+        store_bytes = 0
+        if self.base_path and os.path.isdir(self.base_path):
+            for root, _, files in os.walk(self.base_path):
+                for f in files:
+                    try:
+                        store_bytes += os.path.getsize(os.path.join(root, f))
+                    except OSError:
+                        pass
+        return {
+            "uuid": self.uuid,
+            "primaries": {
+                "docs": {"count": self.num_docs, "deleted": 0},
+                "store": {"size_in_bytes": store_bytes},
+                "segments": {"count": sum(len(s.segments) for s in self.shards)},
+            },
+            "total": {
+                "docs": {"count": self.num_docs, "deleted": 0},
+                "store": {"size_in_bytes": store_bytes},
+            },
+        }
+
+    def metadata(self) -> dict:
+        return {
+            "settings": {
+                "index": {
+                    **{k: str(v) for k, v in self.settings.items()},
+                    "uuid": self.uuid,
+                    "creation_date": str(self.creation_date),
+                    "provided_name": self.name,
+                }
+            },
+            "mappings": self.mappings.to_json(),
+        }
+
+
+def _flatten_settings(settings: dict) -> dict:
+    """Accepts both {"index": {"number_of_shards": 2}} and flat
+    {"index.number_of_shards": 2} / {"number_of_shards": 2} forms."""
+    out: Dict[str, Any] = {}
+
+    def walk(prefix: str, node: Any):
+        if isinstance(node, dict):
+            for k, v in node.items():
+                walk(f"{prefix}.{k}" if prefix else k, v)
+        else:
+            key = prefix
+            if key.startswith("index."):
+                key = key[len("index.") :]
+            out[key] = node
+
+    walk("", settings)
+    return out
+
+
+def _index_uuid(name: str, creation_date: int) -> str:
+    import hashlib
+
+    h = hashlib.sha1(f"{name}:{creation_date}".encode()).hexdigest()
+    return h[:22]

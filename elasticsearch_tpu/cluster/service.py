@@ -1,0 +1,256 @@
+"""ClusterService: node-level index registry + persisted cluster state.
+
+Reference analogs: org.elasticsearch.cluster.service (MasterService's
+serialized state-update queue + ClusterApplierService), IndicesService
+(creates IndexService per metadata change), and GatewayMetaState /
+PersistedClusterStateService (durable cluster metadata, SURVEY.md §5
+"Checkpoint / resume"). Single-node in round 1: this process is the
+master; state updates are applied under one lock and persisted as an
+atomically-replaced JSON document, versioned like ClusterState.version.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from typing import Dict, Optional
+
+from ..analysis import AnalysisRegistry
+from ..index.mapping import MappingParseError
+from .indices import IndexService, _flatten_settings
+
+
+class ClusterError(Exception):
+    def __init__(self, status: int, reason: str, err_type: str = "illegal_argument_exception"):
+        super().__init__(reason)
+        self.status = status
+        self.reason = reason
+        self.err_type = err_type
+
+
+class IndexNotFoundError(ClusterError):
+    def __init__(self, name: str):
+        super().__init__(404, f"no such index [{name}]", "index_not_found_exception")
+
+
+class ClusterService:
+    def __init__(
+        self,
+        data_path: Optional[str] = None,
+        cluster_name: str = "elasticsearch-tpu",
+        node_name: str = "node-0",
+    ):
+        self.cluster_name = cluster_name
+        self.node_name = node_name
+        self.data_path = data_path
+        self.version = 0
+        self.indices: Dict[str, IndexService] = {}
+        self._lock = threading.RLock()
+        self._started_at = time.time()
+        if data_path is not None:
+            os.makedirs(data_path, exist_ok=True)
+            self._recover()
+
+    # ------------------------------------------------------------------
+    # state persistence (PersistedClusterStateService analog)
+    # ------------------------------------------------------------------
+
+    def _state_path(self) -> str:
+        assert self.data_path is not None
+        return os.path.join(self.data_path, "cluster_state.json")
+
+    def _persist(self) -> None:
+        if self.data_path is None:
+            return
+        state = {
+            "version": self.version,
+            "cluster_name": self.cluster_name,
+            "indices": {
+                name: {
+                    "settings": {k: v for k, v in idx.settings.items()},
+                    "mappings": idx.mappings.to_json(),
+                    "uuid": idx.uuid,
+                    "creation_date": idx.creation_date,
+                }
+                for name, idx in self.indices.items()
+            },
+        }
+        tmp = self._state_path() + ".tmp"
+        with open(tmp, "w", encoding="utf-8") as f:
+            json.dump(state, f)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, self._state_path())
+
+    def _recover(self) -> None:
+        try:
+            with open(self._state_path(), encoding="utf-8") as f:
+                state = json.load(f)
+        except (FileNotFoundError, json.JSONDecodeError):
+            return
+        self.version = state.get("version", 0)
+        for name, meta in state.get("indices", {}).items():
+            path = self._index_path(name)
+            # prefer the per-index _meta.json written at flush — it carries
+            # dynamic-mapping updates newer than the cluster-state snapshot
+            disk_meta = IndexService.load_meta(path) if path else None
+            if disk_meta is not None:
+                meta = disk_meta
+            idx = IndexService(
+                name,
+                settings=meta.get("settings"),
+                mappings_json=meta.get("mappings"),
+                base_path=path,
+            )
+            idx.uuid = meta.get("uuid", idx.uuid)
+            idx.creation_date = meta.get("creation_date", idx.creation_date)
+            self.indices[name] = idx
+
+    def _index_path(self, name: str) -> Optional[str]:
+        if self.data_path is None:
+            return None
+        return os.path.join(self.data_path, "indices", name)
+
+    # ------------------------------------------------------------------
+    # index CRUD (MetadataCreateIndexService analogs)
+    # ------------------------------------------------------------------
+
+    def create_index(self, name: str, body: Optional[dict] = None) -> dict:
+        with self._lock:
+            _validate_index_name(name)
+            if name in self.indices:
+                raise ClusterError(
+                    400,
+                    f"index [{name}] already exists",
+                    "resource_already_exists_exception",
+                )
+            body = body or {}
+            try:
+                idx = IndexService(
+                    name,
+                    settings=body.get("settings"),
+                    mappings_json=body.get("mappings"),
+                    base_path=self._index_path(name),
+                )
+            except (MappingParseError, ValueError) as e:
+                raise ClusterError(400, str(e), "mapper_parsing_exception")
+            self.indices[name] = idx
+            self.version += 1
+            self._persist()
+            idx._persist_meta()
+            return {"acknowledged": True, "shards_acknowledged": True, "index": name}
+
+    def delete_index(self, name: str) -> dict:
+        with self._lock:
+            idx = self.indices.pop(name, None)
+            if idx is None:
+                raise IndexNotFoundError(name)
+            idx.close()
+            path = self._index_path(name)
+            if path and os.path.isdir(path):
+                import shutil
+
+                shutil.rmtree(path, ignore_errors=True)
+            self.version += 1
+            self._persist()
+            return {"acknowledged": True}
+
+    def get_index(self, name: str) -> IndexService:
+        idx = self.indices.get(name)
+        if idx is None:
+            raise IndexNotFoundError(name)
+        return idx
+
+    def get_or_autocreate(self, name: str) -> IndexService:
+        """Auto-create on first document op (action.auto_create_index)."""
+        with self._lock:
+            idx = self.indices.get(name)
+            if idx is None:
+                self.create_index(name)
+                idx = self.indices[name]
+            return idx
+
+    def put_mapping(self, name: str, body: dict) -> dict:
+        with self._lock:
+            idx = self.get_index(name)
+            try:
+                idx.mappings.merge(body)
+            except MappingParseError as e:
+                raise ClusterError(400, str(e), "illegal_argument_exception")
+            self.version += 1
+            self._persist()
+            idx._persist_meta()  # keep _meta.json ≥ cluster-state freshness
+            return {"acknowledged": True}
+
+    def update_settings(self, name: str, body: dict) -> dict:
+        with self._lock:
+            idx = self.get_index(name)
+            flat = _flatten_settings(body)
+            static = {"number_of_shards"}
+            for k in flat:
+                if k in static:
+                    raise ClusterError(
+                        400,
+                        f"final {name} setting [index.{k}], not updateable",
+                        "illegal_argument_exception",
+                    )
+            idx.settings.update(flat)
+            self.version += 1
+            self._persist()
+            idx._persist_meta()
+            return {"acknowledged": True}
+
+    # ------------------------------------------------------------------
+    # cluster-level APIs
+    # ------------------------------------------------------------------
+
+    def health(self) -> dict:
+        n_primaries = sum(len(i.shards) for i in self.indices.values())
+        n_replicas = sum(
+            len(i.shards) * int(i.settings.get("number_of_replicas", 1))
+            for i in self.indices.values()
+        )
+        status = "yellow" if n_replicas > 0 else "green"
+        if not self.indices:
+            status = "green"
+        return {
+            "cluster_name": self.cluster_name,
+            "status": status,
+            "timed_out": False,
+            "number_of_nodes": 1,
+            "number_of_data_nodes": 1,
+            "active_primary_shards": n_primaries,
+            "active_shards": n_primaries,
+            "relocating_shards": 0,
+            "initializing_shards": 0,
+            "unassigned_shards": n_replicas,
+            "delayed_unassigned_shards": 0,
+            "number_of_pending_tasks": 0,
+            "number_of_in_flight_fetch": 0,
+            "task_max_waiting_in_queue_millis": 0,
+            "active_shards_percent_as_number": 100.0 if n_primaries else 100.0,
+        }
+
+    def flush_all(self) -> None:
+        for idx in self.indices.values():
+            idx.flush()
+
+    def close(self) -> None:
+        for idx in self.indices.values():
+            idx.close()
+
+
+def _validate_index_name(name: str) -> None:
+    if not name or name != name.lower() or name.startswith(("_", "-", "+")):
+        raise ClusterError(
+            400, f"invalid index name [{name}]", "invalid_index_name_exception"
+        )
+    for ch in ' "*\\<|,>/?':
+        if ch in name:
+            raise ClusterError(
+                400,
+                f"invalid index name [{name}], must not contain [{ch}]",
+                "invalid_index_name_exception",
+            )

@@ -184,21 +184,36 @@ class Mappings:
 
     def to_json(self) -> dict:
         props: dict = {}
+        mf_children = {
+            f"{p}.{s}" for p, subs in self.multi_fields.items() for s in subs
+        }
         for name, f in sorted(self.fields.items()):
+            if name in mf_children:
+                continue  # rendered under the parent's "fields"
             parts = name.split(".")
-            # reconstruct nested properties; multi-fields are flattened here
-            # (fidelity-enough for GET _mapping round-trips in round 1)
             node = props
             for p in parts[:-1]:
-                node = node.setdefault(p, {"properties": {}})["properties"]
-            entry: dict = {"type": f.type}
-            if f.type == TEXT and f.analyzer != "standard":
-                entry["analyzer"] = f.analyzer
-            if f.type == DENSE_VECTOR:
-                entry["dims"] = f.dims
-                entry["similarity"] = f.similarity
+                parent = node.setdefault(p, {"properties": {}})
+                node = parent.setdefault("properties", {})
+            entry = self._field_json(f)
+            for sub in self.multi_fields.get(name, []):
+                subf = self.fields.get(f"{name}.{sub}")
+                if subf is not None:
+                    entry.setdefault("fields", {})[sub] = self._field_json(subf)
             node[parts[-1]] = entry
         return {"properties": props}
+
+    @staticmethod
+    def _field_json(f: "MappedField") -> dict:
+        entry: dict = {"type": f.type}
+        if f.type == TEXT and f.analyzer != "standard":
+            entry["analyzer"] = f.analyzer
+        if f.type == DENSE_VECTOR:
+            entry["dims"] = f.dims
+            entry["similarity"] = f.similarity
+        if f.ignore_above is not None:
+            entry["ignore_above"] = f.ignore_above
+        return entry
 
 
 @dataclass

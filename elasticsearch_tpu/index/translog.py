@@ -1,0 +1,146 @@
+"""Per-shard durable write-ahead log (the translog).
+
+Reference analog: org.elasticsearch.index.translog — `Translog.add`
+appends every accepted operation before it is acknowledged,
+`index.translog.durability` selects fsync-per-request vs async,
+generations roll at flush and are trimmed once a Lucene commit covers
+their sequence numbers, and an atomic `Checkpoint` file records the
+durable state (server/.../index/translog/Translog.java, Checkpoint.java).
+
+TPU-native redesign notes: ops are JSON-lines (host-side durability is
+CPU work; there is no device involvement), one file per generation
+(``translog-<gen>.log``), with an atomically-replaced ``translog.ckp``
+holding {generation, min_retained_seq_no}. Recovery replays every op
+with seq_no > the commit's max_seq_no (InternalEngine#recoverFromTranslog
+analog in engine.py).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Iterator, Optional
+
+DURABILITY_REQUEST = "request"  # fsync before ack (default)
+DURABILITY_ASYNC = "async"  # fsync on a schedule / at close
+
+
+class Translog:
+    def __init__(self, path: str, durability: str = DURABILITY_REQUEST):
+        self.dir = path
+        self.durability = durability
+        os.makedirs(path, exist_ok=True)
+        ckp = self._read_checkpoint()
+        self.generation = ckp.get("generation", 1)
+        self.min_retained_seq_no = ckp.get("min_retained_seq_no", 0)
+        self._file = open(self._gen_path(self.generation), "a", encoding="utf-8")
+        self._ops_in_gen = 0
+
+    # ---- paths ----
+
+    def _gen_path(self, gen: int) -> str:
+        return os.path.join(self.dir, f"translog-{gen}.log")
+
+    def _ckp_path(self) -> str:
+        return os.path.join(self.dir, "translog.ckp")
+
+    def _read_checkpoint(self) -> dict:
+        try:
+            with open(self._ckp_path(), encoding="utf-8") as f:
+                return json.load(f)
+        except (FileNotFoundError, json.JSONDecodeError):
+            return {}
+
+    def _write_checkpoint(self) -> None:
+        tmp = self._ckp_path() + ".tmp"
+        with open(tmp, "w", encoding="utf-8") as f:
+            json.dump(
+                {
+                    "generation": self.generation,
+                    "min_retained_seq_no": self.min_retained_seq_no,
+                },
+                f,
+            )
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, self._ckp_path())
+
+    # ---- write path ----
+
+    def add(self, op: dict) -> None:
+        """Appends one operation (must carry ``seq_no``)."""
+        self._file.write(json.dumps(op, separators=(",", ":")) + "\n")
+        if self.durability == DURABILITY_REQUEST:
+            self._file.flush()
+            os.fsync(self._file.fileno())
+        self._ops_in_gen += 1
+
+    def sync(self) -> None:
+        self._file.flush()
+        os.fsync(self._file.fileno())
+
+    # ---- generations ----
+
+    def roll_generation(self) -> None:
+        """Starts a new generation (called by flush before commit)."""
+        self.sync()
+        self._file.close()
+        self.generation += 1
+        self._file = open(self._gen_path(self.generation), "a", encoding="utf-8")
+        self._ops_in_gen = 0
+        self._write_checkpoint()
+
+    def trim_unreferenced(self, committed_seq_no: int) -> None:
+        """Deletes generations whose ops are all covered by the commit."""
+        self.min_retained_seq_no = committed_seq_no + 1
+        self._write_checkpoint()
+        for fname in os.listdir(self.dir):
+            if not fname.startswith("translog-"):
+                continue
+            gen = int(fname[len("translog-") : -len(".log")])
+            if gen >= self.generation:
+                continue
+            path = os.path.join(self.dir, fname)
+            keep = False
+            for op in self._read_ops(path):
+                if op.get("seq_no", -1) > committed_seq_no:
+                    keep = True
+                    break
+            if not keep:
+                os.remove(path)
+
+    # ---- recovery ----
+
+    @staticmethod
+    def _read_ops(path: str) -> Iterator[dict]:
+        try:
+            with open(path, encoding="utf-8") as f:
+                for line in f:
+                    line = line.strip()
+                    if not line:
+                        continue
+                    try:
+                        yield json.loads(line)
+                    except json.JSONDecodeError:
+                        return  # torn tail write: stop at corruption
+        except FileNotFoundError:
+            return
+
+    def read_ops_after(self, seq_no: int) -> Iterator[dict]:
+        """All ops with seq_no > the given value, in log order."""
+        gens = sorted(
+            int(f[len("translog-") : -len(".log")])
+            for f in os.listdir(self.dir)
+            if f.startswith("translog-")
+        )
+        self.sync()
+        for gen in gens:
+            for op in self._read_ops(self._gen_path(gen)):
+                if op.get("seq_no", -1) > seq_no:
+                    yield op
+
+    def close(self) -> None:
+        try:
+            self.sync()
+        finally:
+            self._file.close()

@@ -1,0 +1,597 @@
+"""REST action handlers: the ES API surface bound to ClusterService.
+
+Reference analogs (server/.../rest/action/): RestSearchAction,
+RestBulkAction, RestIndexAction/RestGetAction/RestDeleteAction (document
+CRUD), RestCreateIndexAction/RestDeleteIndexAction/RestGetMappingAction/
+RestPutMappingAction/RestUpdateSettingsAction (admin/indices),
+RestClusterHealthAction, RestNodesStatsAction, cat handlers
+(RestIndicesAction). Response JSON mirrors the reference shapes so
+existing clients can point at this server unchanged.
+"""
+
+from __future__ import annotations
+
+import base64
+import json
+import threading
+import time
+import uuid
+from typing import Any, Dict, List, Optional, Tuple
+
+from ..cluster import ClusterError, ClusterService
+from ..index.engine import VersionConflictError
+from ..search.dsl import QueryParseError
+from .router import Router, error_body
+
+ES_VERSION = "8.15.0"  # wire-compat generation this API surface mirrors
+
+
+def _auto_id() -> str:
+    """Time-based flake id, URL-safe base64 — RestIndexAction auto-id
+    shape (UUIDs.base64UUID)."""
+    return (
+        base64.urlsafe_b64encode(uuid.uuid4().bytes).decode().rstrip("=")
+    )
+
+
+class RestActions:
+    def __init__(self, cluster: ClusterService):
+        self.cluster = cluster
+        self.router = Router()
+        self.started_at = time.time()
+        self._register()
+
+    # ------------------------------------------------------------------
+
+    def _register(self):
+        add = self.router.add
+        # root & cluster
+        add("GET", "/", self.root)
+        add("GET", "/_cluster/health", self.cluster_health)
+        add("GET", "/_cluster/state", self.cluster_state)
+        add("GET", "/_nodes/stats", self.nodes_stats)
+        add("GET", "/_stats", self.all_stats)
+        add("GET", "/_cat/indices", self.cat_indices)
+        add("GET", "/_cat/health", self.cat_health)
+        add("POST", "/_bulk", self.bulk)
+        add("POST", "/_refresh", self.refresh_all)
+        add("POST", "/_flush", self.flush_all)
+        add("POST", "/_msearch", self.msearch)
+        # index admin
+        add("PUT", "/{index}", self.create_index)
+        add("DELETE", "/{index}", self.delete_index)
+        add("GET", "/{index}", self.get_index_meta)
+        add("GET", "/{index}/_mapping", self.get_mapping)
+        add("PUT", "/{index}/_mapping", self.put_mapping)
+        add("GET", "/{index}/_settings", self.get_settings)
+        add("PUT", "/{index}/_settings", self.put_settings)
+        add("GET", "/{index}/_stats", self.index_stats)
+        add("POST", "/{index}/_refresh", self.refresh_index)
+        add("GET", "/{index}/_refresh", self.refresh_index)
+        add("POST", "/{index}/_flush", self.flush_index)
+        add("POST", "/{index}/_forcemerge", self.forcemerge)
+        # search
+        add("POST", "/{index}/_search", self.search)
+        add("GET", "/{index}/_search", self.search)
+        add("POST", "/{index}/_count", self.count)
+        add("GET", "/{index}/_count", self.count)
+        add("POST", "/{index}/_msearch", self.msearch)
+        add("POST", "/{index}/_bulk", self.bulk)
+        # documents
+        add("POST", "/{index}/_doc", self.index_doc_auto)
+        add("PUT", "/{index}/_doc/{id}", self.index_doc)
+        add("POST", "/{index}/_doc/{id}", self.index_doc)
+        add("GET", "/{index}/_doc/{id}", self.get_doc)
+        add("DELETE", "/{index}/_doc/{id}", self.delete_doc)
+        add("PUT", "/{index}/_create/{id}", self.create_doc)
+        add("POST", "/{index}/_create/{id}", self.create_doc)
+        add("GET", "/{index}/_source/{id}", self.get_source)
+        add("POST", "/{index}/_update/{id}", self.update_doc)
+        add("POST", "/{index}/_mget", self.mget)
+        add("POST", "/_mget", self.mget)
+
+    # ------------------------------------------------------------------
+    # root / cluster
+    # ------------------------------------------------------------------
+
+    def root(self, body, params, qs):
+        return 200, {
+            "name": self.cluster.node_name,
+            "cluster_name": self.cluster.cluster_name,
+            "cluster_uuid": "tpu-native",
+            "version": {
+                "number": ES_VERSION,
+                "build_flavor": "tpu-native",
+                "lucene_version": "none (JAX/XLA columnar engine)",
+            },
+            "tagline": "You Know, for Search",
+        }
+
+    def cluster_health(self, body, params, qs):
+        return 200, self.cluster.health()
+
+    def cluster_state(self, body, params, qs):
+        return 200, {
+            "cluster_name": self.cluster.cluster_name,
+            "version": self.cluster.version,
+            "metadata": {
+                "indices": {
+                    name: idx.metadata()
+                    for name, idx in self.cluster.indices.items()
+                }
+            },
+        }
+
+    def nodes_stats(self, body, params, qs):
+        import resource
+
+        ru = resource.getrusage(resource.RUSAGE_SELF)
+        total_docs = sum(i.num_docs for i in self.cluster.indices.values())
+        return 200, {
+            "cluster_name": self.cluster.cluster_name,
+            "nodes": {
+                "node-0": {
+                    "name": self.cluster.node_name,
+                    "roles": ["master", "data", "ingest"],
+                    "indices": {"docs": {"count": total_docs}},
+                    "jvm": {  # shape parity; values are process RSS
+                        "mem": {"heap_used_in_bytes": ru.ru_maxrss * 1024}
+                    },
+                    "os": {"cpu": {"percent": 0}},
+                    "process": {
+                        "open_file_descriptors": 0,
+                        "max_file_descriptors": 0,
+                    },
+                    "uptime_in_millis": int(
+                        (time.time() - self.started_at) * 1000
+                    ),
+                }
+            },
+        }
+
+    def all_stats(self, body, params, qs):
+        indices = {
+            name: idx.stats() for name, idx in self.cluster.indices.items()
+        }
+        total_docs = sum(i.num_docs for i in self.cluster.indices.values())
+        return 200, {
+            "_all": {"primaries": {"docs": {"count": total_docs}}},
+            "indices": indices,
+        }
+
+    def cat_indices(self, body, params, qs):
+        rows = []
+        for name, idx in sorted(self.cluster.indices.items()):
+            rows.append(
+                {
+                    "health": "green"
+                    if int(idx.settings.get("number_of_replicas", 1)) == 0
+                    else "yellow",
+                    "status": "open",
+                    "index": name,
+                    "uuid": idx.uuid,
+                    "pri": str(len(idx.shards)),
+                    "rep": str(idx.settings.get("number_of_replicas", 1)),
+                    "docs.count": str(idx.num_docs),
+                    "docs.deleted": "0",
+                    "store.size": f"{idx.stats()['primaries']['store']['size_in_bytes']}b",
+                    "pri.store.size": f"{idx.stats()['primaries']['store']['size_in_bytes']}b",
+                }
+            )
+        if qs.get("format") == ["json"]:
+            return 200, rows
+        header = "health status index uuid pri rep docs.count docs.deleted store.size pri.store.size"
+        lines = [header] if "v" in qs else []
+        for r in rows:
+            lines.append(
+                f"{r['health']} {r['status']} {r['index']} {r['uuid']} "
+                f"{r['pri']} {r['rep']} {r['docs.count']} {r['docs.deleted']} "
+                f"{r['store.size']} {r['pri.store.size']}"
+            )
+        return 200, "\n".join(lines) + "\n"
+
+    def cat_health(self, body, params, qs):
+        h = self.cluster.health()
+        return 200, f"{int(time.time())} {h['cluster_name']} {h['status']}\n"
+
+    # ------------------------------------------------------------------
+    # index admin
+    # ------------------------------------------------------------------
+
+    def create_index(self, body, params, qs):
+        return 200, self.cluster.create_index(params["index"], body)
+
+    def delete_index(self, body, params, qs):
+        return 200, self.cluster.delete_index(params["index"])
+
+    def get_index_meta(self, body, params, qs):
+        idx = self.cluster.get_index(params["index"])
+        return 200, {params["index"]: idx.metadata()}
+
+    def get_mapping(self, body, params, qs):
+        idx = self.cluster.get_index(params["index"])
+        return 200, {params["index"]: {"mappings": idx.mappings.to_json()}}
+
+    def put_mapping(self, body, params, qs):
+        return 200, self.cluster.put_mapping(params["index"], body or {})
+
+    def get_settings(self, body, params, qs):
+        idx = self.cluster.get_index(params["index"])
+        return 200, {params["index"]: idx.metadata()["settings"] | {}}
+
+    def put_settings(self, body, params, qs):
+        return 200, self.cluster.update_settings(params["index"], body or {})
+
+    def index_stats(self, body, params, qs):
+        idx = self.cluster.get_index(params["index"])
+        return 200, {
+            "_shards": {
+                "total": len(idx.shards),
+                "successful": len(idx.shards),
+                "failed": 0,
+            },
+            "_all": idx.stats(),
+            "indices": {params["index"]: idx.stats()},
+        }
+
+    def refresh_index(self, body, params, qs):
+        idx = self.cluster.get_index(params["index"])
+        idx.refresh()
+        n = len(idx.shards)
+        return 200, {"_shards": {"total": n, "successful": n, "failed": 0}}
+
+    def refresh_all(self, body, params, qs):
+        n = 0
+        for idx in self.cluster.indices.values():
+            idx.refresh()
+            n += len(idx.shards)
+        return 200, {"_shards": {"total": n, "successful": n, "failed": 0}}
+
+    def flush_index(self, body, params, qs):
+        idx = self.cluster.get_index(params["index"])
+        idx.flush()
+        n = len(idx.shards)
+        return 200, {"_shards": {"total": n, "successful": n, "failed": 0}}
+
+    def flush_all(self, body, params, qs):
+        self.cluster.flush_all()
+        return 200, {"_shards": {"total": 0, "successful": 0, "failed": 0}}
+
+    def forcemerge(self, body, params, qs):
+        idx = self.cluster.get_index(params["index"])
+        max_seg = int(qs.get("max_num_segments", ["1"])[0])
+        for s in idx.shards:
+            s.maybe_merge(max_segments=max_seg)
+        n = len(idx.shards)
+        return 200, {"_shards": {"total": n, "successful": n, "failed": 0}}
+
+    # ------------------------------------------------------------------
+    # documents
+    # ------------------------------------------------------------------
+
+    def _doc_response(self, index: str, r, shards: int) -> dict:
+        return {
+            "_index": index,
+            "_id": r.doc_id,
+            "_version": r.version,
+            "result": r.result,
+            "_shards": {"total": 1, "successful": 1, "failed": 0},
+            "_seq_no": r.seq_no,
+            "_primary_term": r.primary_term,
+        }
+
+    def _maybe_refresh(self, idx, qs):
+        refresh = qs.get("refresh", [None])[0]
+        if refresh in ("true", "", "wait_for"):
+            idx.refresh()
+
+    def index_doc(self, body, params, qs, op_type=None):
+        idx = self.cluster.get_or_autocreate(params["index"])
+        routing = qs.get("routing", [None])[0]
+        op = op_type or qs.get("op_type", ["index"])[0]
+        kwargs = {}
+        if "if_seq_no" in qs:
+            kwargs["if_seq_no"] = int(qs["if_seq_no"][0])
+        if "if_primary_term" in qs:
+            kwargs["if_primary_term"] = int(qs["if_primary_term"][0])
+        r = idx.index_doc(
+            params["id"], body or {}, op_type=op, routing=routing, **kwargs
+        )
+        self._maybe_refresh(idx, qs)
+        return (201 if r.result == "created" else 200), self._doc_response(
+            params["index"], r, len(idx.shards)
+        )
+
+    def index_doc_auto(self, body, params, qs):
+        params = dict(params, id=_auto_id())
+        return self.index_doc(body, params, qs, op_type="create")
+
+    def create_doc(self, body, params, qs):
+        return self.index_doc(body, params, qs, op_type="create")
+
+    def get_doc(self, body, params, qs):
+        idx = self.cluster.get_index(params["index"])
+        routing = qs.get("routing", [None])[0]
+        doc = idx.get_doc(params["id"], routing=routing)
+        if doc is None:
+            return 404, {
+                "_index": params["index"],
+                "_id": params["id"],
+                "found": False,
+            }
+        return 200, {
+            "_index": params["index"],
+            **doc,
+            "found": True,
+        }
+
+    def get_source(self, body, params, qs):
+        idx = self.cluster.get_index(params["index"])
+        doc = idx.get_doc(params["id"], routing=qs.get("routing", [None])[0])
+        if doc is None:
+            return 404, error_body(
+                404,
+                "resource_not_found_exception",
+                f"Document not found [{params['index']}]/[{params['id']}]",
+            )
+        return 200, doc["_source"]
+
+    def delete_doc(self, body, params, qs):
+        idx = self.cluster.get_index(params["index"])
+        routing = qs.get("routing", [None])[0]
+        kwargs = {}
+        if "if_seq_no" in qs:
+            kwargs["if_seq_no"] = int(qs["if_seq_no"][0])
+        if "if_primary_term" in qs:
+            kwargs["if_primary_term"] = int(qs["if_primary_term"][0])
+        r = idx.delete_doc(params["id"], routing=routing, **kwargs)
+        self._maybe_refresh(idx, qs)
+        status = 200 if r.result == "deleted" else 404
+        return status, self._doc_response(params["index"], r, len(idx.shards))
+
+    def update_doc(self, body, params, qs):
+        """_update: partial doc merge / doc_as_upsert / scripted noop
+        detection (TransportUpdateAction subset: doc merge only)."""
+        idx = self.cluster.get_index(params["index"])
+        routing = qs.get("routing", [None])[0]
+        body = body or {}
+        doc_part = body.get("doc")
+        if doc_part is None:
+            return 400, error_body(
+                400,
+                "action_request_validation_exception",
+                "script or doc is missing",
+            )
+        existing = idx.get_doc(params["id"], routing=routing)
+        if existing is None:
+            if body.get("doc_as_upsert") or "upsert" in body:
+                base = body.get("upsert", doc_part if body.get("doc_as_upsert") else {})
+                merged = _deep_merge(dict(base), doc_part)
+                r = idx.index_doc(params["id"], merged, routing=routing)
+                self._maybe_refresh(idx, qs)
+                return 201, self._doc_response(params["index"], r, len(idx.shards))
+            return 404, error_body(
+                404,
+                "document_missing_exception",
+                f"[{params['id']}]: document missing",
+            )
+        merged = _deep_merge(dict(existing["_source"]), doc_part)
+        if merged == existing["_source"] and body.get("detect_noop", True):
+            return 200, {
+                "_index": params["index"],
+                "_id": params["id"],
+                "_version": existing["_version"],
+                "result": "noop",
+                "_shards": {"total": 0, "successful": 0, "failed": 0},
+                "_seq_no": existing["_seq_no"],
+                "_primary_term": existing["_primary_term"],
+            }
+        r = idx.index_doc(params["id"], merged, routing=routing)
+        self._maybe_refresh(idx, qs)
+        return 200, self._doc_response(params["index"], r, len(idx.shards))
+
+    def mget(self, body, params, qs):
+        body = body or {}
+        docs_spec = body.get("docs")
+        out = []
+        if docs_spec is None and "ids" in body and "index" in params:
+            docs_spec = [{"_id": i} for i in body["ids"]]
+        for spec in docs_spec or []:
+            index = spec.get("_index", params.get("index"))
+            try:
+                idx = self.cluster.get_index(index)
+                doc = idx.get_doc(spec["_id"], routing=spec.get("routing"))
+            except ClusterError:
+                doc = None
+            if doc is None:
+                out.append({"_index": index, "_id": spec["_id"], "found": False})
+            else:
+                out.append({"_index": index, **doc, "found": True})
+        return 200, {"docs": out}
+
+    # ------------------------------------------------------------------
+    # search
+    # ------------------------------------------------------------------
+
+    def search(self, body, params, qs):
+        idx = self.cluster.get_index(params["index"])
+        body = dict(body or {})
+        if "size" in qs:
+            body["size"] = int(qs["size"][0])
+        if "from" in qs:
+            body["from"] = int(qs["from"][0])
+        if "q" in qs:
+            # query_string lite: field:value or plain terms on all text fields
+            body["query"] = _parse_q_param(qs["q"][0])
+        return 200, idx.search(body)
+
+    def count(self, body, params, qs):
+        idx = self.cluster.get_index(params["index"])
+        return 200, idx.count(body)
+
+    def msearch(self, body, params, qs):
+        # body arrives pre-split as a list of (header, body) dicts
+        responses = []
+        for header, sub in body:
+            index = header.get("index", params.get("index"))
+            try:
+                idx = self.cluster.get_index(index)
+                resp = idx.search(sub)
+                resp["status"] = 200
+            except (ClusterError, QueryParseError) as e:
+                status = e.status if isinstance(e, ClusterError) else 400
+                resp = error_body(status, "search_phase_execution_exception", str(e))
+            responses.append(resp)
+        return 200, {"took": 0, "responses": responses}
+
+    # ------------------------------------------------------------------
+    # bulk (NDJSON)
+    # ------------------------------------------------------------------
+
+    def bulk(self, body, params, qs):
+        """body: list of parsed NDJSON lines (RestBulkAction →
+        TransportBulkAction: per-item routing + independent failures)."""
+        items: List[dict] = []
+        errors = False
+        t0 = time.perf_counter()
+        i = 0
+        lines = body
+        default_index = params.get("index")
+        touched = set()
+        while i < len(lines):
+            action_line = lines[i]
+            i += 1
+            if not isinstance(action_line, dict) or len(action_line) != 1:
+                return 400, error_body(
+                    400,
+                    "illegal_argument_exception",
+                    "Malformed action/metadata line",
+                )
+            action, meta = next(iter(action_line.items()))
+            if action not in ("index", "create", "delete", "update"):
+                return 400, error_body(
+                    400,
+                    "illegal_argument_exception",
+                    f"Unknown action [{action}]",
+                )
+            index = meta.get("_index", default_index)
+            doc_id = meta.get("_id")
+            routing = meta.get("routing")
+            doc = None
+            if action in ("index", "create", "update"):
+                if i >= len(lines):
+                    return 400, error_body(
+                        400,
+                        "illegal_argument_exception",
+                        "Validation Failed: 1: no requests added;",
+                    )
+                doc = lines[i]
+                i += 1
+            if index is None or (doc_id is None and action in ("delete", "update")):
+                items.append(
+                    {
+                        action: {
+                            "_id": doc_id,
+                            "status": 400,
+                            "error": {
+                                "type": "action_request_validation_exception",
+                                "reason": "index is missing"
+                                if index is None
+                                else "id is missing",
+                            },
+                        }
+                    }
+                )
+                errors = True
+                continue
+            try:
+                idx = self.cluster.get_or_autocreate(index)
+                touched.add(index)
+                if action == "delete":
+                    r = idx.delete_doc(doc_id, routing=routing)
+                    items.append(
+                        {
+                            "delete": {
+                                **self._doc_response(index, r, len(idx.shards)),
+                                "status": 200 if r.result == "deleted" else 404,
+                            }
+                        }
+                    )
+                elif action == "update":
+                    sub_qs = {"routing": [routing]} if routing is not None else {}
+                    status, resp = self.update_doc(
+                        doc, {"index": index, "id": doc_id}, sub_qs
+                    )
+                    if status >= 400:
+                        errors = True
+                        items.append(
+                            {
+                                "update": {
+                                    "_index": index,
+                                    "_id": doc_id,
+                                    "status": status,
+                                    "error": resp.get("error", resp),
+                                }
+                            }
+                        )
+                    else:
+                        items.append({"update": {**resp, "status": status}})
+                else:
+                    if doc_id is None:
+                        doc_id = _auto_id()
+                    op = "create" if action == "create" else "index"
+                    r = idx.index_doc(doc_id, doc or {}, op_type=op, routing=routing)
+                    items.append(
+                        {
+                            action: {
+                                **self._doc_response(index, r, len(idx.shards)),
+                                "status": 201 if r.result == "created" else 200,
+                            }
+                        }
+                    )
+            except (VersionConflictError, ClusterError, QueryParseError) as e:
+                errors = True
+                if isinstance(e, VersionConflictError):
+                    status, etype = 409, "version_conflict_engine_exception"
+                elif isinstance(e, ClusterError):
+                    status, etype = e.status, e.err_type
+                else:
+                    status, etype = 400, "parsing_exception"
+                items.append(
+                    {
+                        action: {
+                            "_index": index,
+                            "_id": doc_id,
+                            "status": status,
+                            "error": {"type": etype, "reason": str(e)},
+                        }
+                    }
+                )
+        refresh = qs.get("refresh", [None])[0]
+        if refresh in ("true", "", "wait_for"):
+            for name in touched:
+                try:
+                    self.cluster.get_index(name).refresh()
+                except ClusterError:
+                    pass
+        took = int((time.perf_counter() - t0) * 1000)
+        return 200, {"took": took, "errors": errors, "items": items}
+
+
+def _deep_merge(base: dict, patch: dict) -> dict:
+    for k, v in patch.items():
+        if isinstance(v, dict) and isinstance(base.get(k), dict):
+            base[k] = _deep_merge(dict(base[k]), v)
+        else:
+            base[k] = v
+    return base
+
+
+def _parse_q_param(q: str) -> dict:
+    """?q= mini query_string: ``field:value`` or free text (match on the
+    catch-all would need _all; we use multi_match over * fields via
+    query_string subset — round 1: single field or match on 'body')."""
+    if ":" in q:
+        field, _, value = q.partition(":")
+        return {"match": {field: value}}
+    return {"multi_match": {"query": q, "fields": ["*"]}}

@@ -1,0 +1,55 @@
+"""Coordinator-side merge of per-shard results.
+
+Reference analog: SearchPhaseController.reducedQueryPhase /
+QueryPhaseResultConsumer (server/.../action/search/) — merge-sort the
+per-shard top-k by (score desc, shard asc, doc asc), sum totals, keep
+max_score. The device-side equivalent for mesh-resident shards is the
+all_gather merge in parallel/sharded.py; this host-side version serves
+the engine/REST path where each shard produced a TopDocs via its
+executor.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import List, Optional, Sequence
+
+from .executor import Hit, TopDocs
+
+
+@dataclass
+class ShardHit:
+    score: float
+    shard: int
+    segment: int
+    local_doc: int
+    doc_id: str
+
+
+def merge_top_docs(
+    shard_results: Sequence[TopDocs], from_: int = 0, size: int = 10
+) -> tuple:
+    """Returns (total, max_score, List[ShardHit]) for the global page."""
+    total = sum(td.total for td in shard_results)
+    max_score: Optional[float] = None
+    entries: List[tuple] = []
+    for si, td in enumerate(shard_results):
+        if td.max_score is not None:
+            max_score = (
+                td.max_score if max_score is None else max(max_score, td.max_score)
+            )
+        for h in td.hits:
+            entries.append((-h.score, si, h.segment, h.local_doc, h))
+    entries.sort(key=lambda e: e[:4])
+    page = entries[from_ : from_ + size]
+    hits = [
+        ShardHit(
+            score=h.score,
+            shard=si,
+            segment=h.segment,
+            local_doc=h.local_doc,
+            doc_id=h.doc_id,
+        )
+        for _, si, _, _, h in page
+    ]
+    return total, max_score, hits

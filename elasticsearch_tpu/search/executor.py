@@ -519,13 +519,7 @@ class NumpyExecutor:
 
     def _exec_multi_match(self, q: MultiMatchQuery, seg: Segment) -> Tuple[np.ndarray, np.ndarray]:
         n = seg.num_docs
-        fields: List[Tuple[str, float]] = []
-        for f in q.fields:
-            if "^" in f:
-                name, _, b = f.partition("^")
-                fields.append((name, float(b)))
-            else:
-                fields.append((f, 1.0))
+        fields = expand_match_fields(self.reader.mappings, q.fields)
         if not fields:
             return np.zeros(n, bool), np.zeros(n, np.float32)
         per_field: List[Tuple[np.ndarray, np.ndarray]] = []
@@ -584,6 +578,31 @@ class NumpyExecutor:
 
 
 # ---- helpers ----
+
+def expand_match_fields(mappings, patterns) -> List[Tuple[str, float]]:
+    """Expands multi_match field patterns (``title^2``, ``body``, ``*``,
+    ``name.*``) against the mapping's text/keyword fields — the
+    QueryParserHelper.resolveMappingFields analog."""
+    import fnmatch
+
+    from ..index.mapping import KEYWORD as _KW, TEXT as _TX
+
+    out: List[Tuple[str, float]] = []
+    for f in patterns:
+        boost = 1.0
+        name = f
+        if "^" in f:
+            name, _, b = f.partition("^")
+            boost = float(b)
+        if "*" in name or "?" in name:
+            # snapshot: concurrent dynamic mapping may grow the dict
+            for fname, mf in sorted(list(mappings.fields.items())):
+                if mf.type in (_TX, _KW) and fnmatch.fnmatch(fname, name):
+                    out.append((fname, boost))
+        else:
+            out.append((name, boost))
+    return out
+
 
 def _extract_field(src: dict, path: str):
     node = src

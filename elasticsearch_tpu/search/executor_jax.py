@@ -397,15 +397,11 @@ class JaxExecutor:
         return mask, jnp.where(mask, scores, 0.0)
 
     def _exec_multi_match(self, q: MultiMatchQuery, si: int) -> Tuple[jax.Array, jax.Array]:
+        from .executor import expand_match_fields
+
         seg = self.reader.segments[si]
         n = seg.num_docs
-        fields: List[Tuple[str, float]] = []
-        for f in q.fields:
-            if "^" in f:
-                name, _, b = f.partition("^")
-                fields.append((name, float(b)))
-            else:
-                fields.append((f, 1.0))
+        fields = expand_match_fields(self.reader.mappings, q.fields)
         if not fields:
             return jnp.zeros(n, bool), jnp.zeros(n, jnp.float32)
         per_field = [

@@ -1,0 +1,321 @@
+"""Engine/write-path tests: versioned CAS, refresh, flush, WAL recovery,
+merges, routing, and the cluster service — the InternalEngine /
+IndexShard / IndicesService behavior contract (SURVEY.md §3.2)."""
+
+import os
+
+import numpy as np
+import pytest
+
+from elasticsearch_tpu.analysis import AnalysisRegistry
+from elasticsearch_tpu.cluster import ClusterError, ClusterService, IndexService
+from elasticsearch_tpu.index.engine import ShardEngine, VersionConflictError
+from elasticsearch_tpu.index.mapping import Mappings
+from elasticsearch_tpu.search import dsl
+from elasticsearch_tpu.search.executor import NumpyExecutor
+
+MAPPING = {
+    "properties": {
+        "body": {"type": "text"},
+        "tag": {"type": "keyword"},
+        "n": {"type": "integer"},
+    }
+}
+
+
+def make_engine(path=None):
+    return ShardEngine(Mappings(MAPPING), AnalysisRegistry(), path=path)
+
+
+def search_ids(engine, query_json, size=10):
+    ex = NumpyExecutor(engine.reader())
+    td = ex.search(dsl.parse_query(query_json), size=size)
+    return [h.doc_id for h in td.hits], td.total
+
+
+class TestVersioning:
+    def test_create_update_delete_versions(self):
+        e = make_engine()
+        r1 = e.index("1", {"body": "hello world"})
+        assert (r1.result, r1.version, r1.seq_no) == ("created", 1, 0)
+        r2 = e.index("1", {"body": "hello again"})
+        assert (r2.result, r2.version, r2.seq_no) == ("updated", 2, 1)
+        r3 = e.delete("1")
+        assert (r3.result, r3.version) == ("deleted", 3)
+        assert e.get("1") is None
+        r4 = e.index("1", {"body": "back"})
+        assert (r4.result, r4.version) == ("created", 4)
+
+    def test_op_type_create_conflict(self):
+        e = make_engine()
+        e.index("1", {"body": "x"})
+        with pytest.raises(VersionConflictError):
+            e.index("1", {"body": "y"}, op_type="create")
+        # create after delete succeeds
+        e.delete("1")
+        r = e.index("1", {"body": "z"}, op_type="create")
+        assert r.result == "created"
+
+    def test_if_seq_no_cas(self):
+        e = make_engine()
+        r1 = e.index("1", {"body": "x"})
+        with pytest.raises(VersionConflictError):
+            e.index("1", {"body": "y"}, if_seq_no=r1.seq_no + 5, if_primary_term=1)
+        r2 = e.index("1", {"body": "y"}, if_seq_no=r1.seq_no, if_primary_term=1)
+        assert r2.result == "updated"
+        with pytest.raises(VersionConflictError):
+            e.delete("1", if_seq_no=r1.seq_no)  # stale
+        assert e.delete("1", if_seq_no=r2.seq_no).result == "deleted"
+
+    def test_delete_missing(self):
+        e = make_engine()
+        assert e.delete("nope").result == "not_found"
+
+    def test_realtime_get_before_refresh(self):
+        e = make_engine()
+        e.index("1", {"body": "unrefreshed"})
+        doc = e.get("1")
+        assert doc["_source"]["body"] == "unrefreshed"
+        assert doc["_version"] == 1
+
+
+class TestRefresh:
+    def test_search_visibility(self):
+        e = make_engine()
+        e.index("1", {"body": "quick fox"})
+        ids, total = search_ids(e, {"match": {"body": "fox"}})
+        assert total == 0  # not yet refreshed
+        e.refresh()
+        ids, total = search_ids(e, {"match": {"body": "fox"}})
+        assert ids == ["1"]
+
+    def test_update_supersedes_old_segment(self):
+        e = make_engine()
+        e.index("1", {"body": "apple banana"})
+        e.refresh()
+        e.index("1", {"body": "cherry"})
+        e.refresh()
+        ids, total = search_ids(e, {"match": {"body": "apple"}})
+        assert total == 0
+        ids, total = search_ids(e, {"match": {"body": "cherry"}})
+        assert ids == ["1"]
+        assert e.num_docs == 1
+
+    def test_delete_applies_to_old_segment(self):
+        e = make_engine()
+        e.index("1", {"body": "doomed doc"})
+        e.index("2", {"body": "survivor doc"})
+        e.refresh()
+        e.delete("1")
+        e.refresh()
+        ids, total = search_ids(e, {"match": {"body": "doc"}})
+        assert ids == ["2"]
+        assert e.num_docs == 1
+
+    def test_buffer_update_before_refresh_counts_once(self):
+        e = make_engine()
+        e.index("1", {"body": "v one"})
+        e.index("1", {"body": "v two"})
+        e.refresh()
+        assert e.num_docs == 1
+        doc = e.get("1")
+        assert doc["_source"]["body"] == "v two"
+        assert doc["_version"] == 2
+
+
+class TestDurability:
+    def test_flush_and_reopen(self, tmp_path):
+        p = str(tmp_path / "shard0")
+        e = make_engine(p)
+        e.index("1", {"body": "persisted fox", "n": 1})
+        e.index("2", {"body": "persisted dog", "n": 2})
+        e.refresh()
+        e.delete("2")
+        e.flush()
+        e.close()
+
+        e2 = make_engine(p)
+        assert e2.num_docs == 1
+        ids, _ = search_ids(e2, {"match": {"body": "persisted"}})
+        assert ids == ["1"]
+        doc = e2.get("1")
+        assert doc["_source"]["n"] == 1
+        # seq/version state restored
+        r = e2.index("1", {"body": "updated", "n": 3})
+        assert r.version == 2
+        assert r.seq_no > 2
+
+    def test_translog_replay_without_flush(self, tmp_path):
+        p = str(tmp_path / "shard1")
+        e = make_engine(p)
+        e.index("1", {"body": "wal one"})
+        e.flush()
+        # ops after the flush live only in the WAL
+        e.index("2", {"body": "wal two"})
+        e.index("1", {"body": "wal one updated"})
+        e.delete("2")
+        e.index("3", {"body": "wal three"})
+        e.close()
+
+        e2 = make_engine(p)
+        assert e2.num_docs == 2
+        assert e2.get("1")["_source"]["body"] == "wal one updated"
+        assert e2.get("1")["_version"] == 2
+        assert e2.get("2") is None
+        assert e2.get("3")["_source"]["body"] == "wal three"
+        ids, _ = search_ids(e2, {"match": {"body": "wal"}})
+        assert set(ids) == {"1", "3"}
+
+    def test_crash_before_any_flush(self, tmp_path):
+        p = str(tmp_path / "shard2")
+        e = make_engine(p)
+        e.index("a", {"body": "never flushed"})
+        e.close()
+        e2 = make_engine(p)
+        assert e2.get("a")["_source"]["body"] == "never flushed"
+
+    def test_translog_trimmed_after_flush(self, tmp_path):
+        p = str(tmp_path / "shard3")
+        e = make_engine(p)
+        for i in range(5):
+            e.index(str(i), {"body": f"doc {i}"})
+        e.flush()
+        tl_dir = os.path.join(p, "translog")
+        logs = [f for f in os.listdir(tl_dir) if f.startswith("translog-")]
+        # old generation trimmed; only the fresh one remains
+        assert len(logs) == 1
+        e.close()
+
+
+class TestMerge:
+    def test_merge_collapses_segments(self):
+        e = make_engine()
+        for i in range(10):
+            e.index(str(i), {"body": f"common word{i}"})
+            e.refresh()
+        e.delete("3")
+        e.refresh()
+        assert len(e.segments) == 10
+        assert e.maybe_merge(max_segments=4)
+        assert len(e.segments) == 1
+        assert e.num_docs == 9
+        ids, total = search_ids(e, {"match": {"body": "common"}})
+        assert total == 9
+        assert "3" not in ids
+        # engine still writable after merge
+        e.index("new", {"body": "common fresh"})
+        e.refresh()
+        _, total = search_ids(e, {"match": {"body": "common"}})
+        assert total == 10
+
+
+class TestIndexService:
+    def test_routing_spreads_and_search_merges(self):
+        idx = IndexService("test", settings={"number_of_shards": 4, "number_of_replicas": 0})
+        for i in range(40):
+            idx.index_doc(f"id-{i}", {"body": f"doc number {i}", "n": i})
+        idx.refresh()
+        used = [s.num_docs for s in idx.shards]
+        assert sum(used) == 40
+        assert sum(1 for u in used if u > 0) >= 2  # murmur3 spreads
+        resp = idx.search({"query": {"match": {"body": "doc"}}, "size": 40})
+        assert resp["hits"]["total"]["value"] == 40
+        assert len(resp["hits"]["hits"]) == 40
+        assert resp["_shards"]["total"] == 4
+
+    def test_routing_param_pins_shard(self):
+        idx = IndexService("test", settings={"number_of_shards": 4})
+        for i in range(10):
+            idx.index_doc(f"id-{i}", {"body": "pinned"}, routing="fixed")
+        idx.refresh()
+        used = [s.num_docs for s in idx.shards]
+        assert sorted(used) == [0, 0, 0, 10]
+        assert idx.get_doc("id-3", routing="fixed")["_source"]["body"] == "pinned"
+
+    def test_sorting_and_pagination_across_shards(self):
+        idx = IndexService("test", settings={"number_of_shards": 3})
+        for i in range(30):
+            # repeat "fox" i times to vary scores is overkill; vary tf via text
+            idx.index_doc(str(i), {"body": "fox " * (1 + i % 5)})
+        idx.refresh()
+        r1 = idx.search({"query": {"match": {"body": "fox"}}, "size": 10})
+        r2 = idx.search({"query": {"match": {"body": "fox"}}, "size": 10, "from": 10})
+        ids1 = [h["_id"] for h in r1["hits"]["hits"]]
+        ids2 = [h["_id"] for h in r2["hits"]["hits"]]
+        assert not set(ids1) & set(ids2)
+        scores1 = [h["_score"] for h in r1["hits"]["hits"]]
+        scores2 = [h["_score"] for h in r2["hits"]["hits"]]
+        assert scores1 == sorted(scores1, reverse=True)
+        assert scores1[-1] >= scores2[0]
+
+    def test_count(self):
+        idx = IndexService("test")
+        for i in range(7):
+            idx.index_doc(str(i), {"body": "x", "n": i})
+        idx.refresh()
+        assert idx.count({"query": {"range": {"n": {"gte": 3}}}})["count"] == 4
+
+
+class TestClusterService:
+    def test_create_search_delete(self):
+        cs = ClusterService()
+        cs.create_index("books", {"mappings": MAPPING, "settings": {"number_of_shards": 2}})
+        idx = cs.get_index("books")
+        idx.index_doc("1", {"body": "war and peace"})
+        idx.refresh()
+        resp = idx.search({"query": {"match": {"body": "war"}}})
+        assert resp["hits"]["total"]["value"] == 1
+        cs.delete_index("books")
+        with pytest.raises(ClusterError):
+            cs.get_index("books")
+
+    def test_duplicate_and_invalid_names(self):
+        cs = ClusterService()
+        cs.create_index("ok-index")
+        with pytest.raises(ClusterError) as ei:
+            cs.create_index("ok-index")
+        assert ei.value.status == 400
+        for bad in ["UPPER", "_underscore", "has space", "a*b"]:
+            with pytest.raises(ClusterError):
+                cs.create_index(bad)
+
+    def test_persistence_roundtrip(self, tmp_path):
+        p = str(tmp_path / "node")
+        cs = ClusterService(data_path=p)
+        cs.create_index(
+            "persisted",
+            {"mappings": MAPPING, "settings": {"number_of_shards": 2}},
+        )
+        idx = cs.get_index("persisted")
+        for i in range(6):
+            idx.index_doc(str(i), {"body": f"stored doc {i}"})
+        idx.refresh()
+        idx.flush()
+        cs.close()
+
+        cs2 = ClusterService(data_path=p)
+        idx2 = cs2.get_index("persisted")
+        assert len(idx2.shards) == 2
+        assert idx2.num_docs == 6
+        resp = idx2.search({"query": {"match": {"body": "stored"}}})
+        assert resp["hits"]["total"]["value"] == 6
+
+    def test_health_and_settings(self):
+        cs = ClusterService()
+        assert cs.health()["status"] == "green"
+        cs.create_index("idx", {"settings": {"number_of_replicas": 1}})
+        assert cs.health()["status"] == "yellow"
+        with pytest.raises(ClusterError):
+            cs.update_settings("idx", {"index": {"number_of_shards": 9}})
+        cs.update_settings("idx", {"index": {"refresh_interval": "5s"}})
+        assert cs.get_index("idx").settings["refresh_interval"] == "5s"
+
+    def test_put_mapping_merge(self):
+        cs = ClusterService()
+        cs.create_index("idx", {"mappings": {"properties": {"a": {"type": "text"}}}})
+        cs.put_mapping("idx", {"properties": {"b": {"type": "keyword"}}})
+        m = cs.get_index("idx").mappings
+        assert m.get("a").type == "text"
+        assert m.get("b").type == "keyword"
+        with pytest.raises(ClusterError):
+            cs.put_mapping("idx", {"properties": {"a": {"type": "long"}}})
