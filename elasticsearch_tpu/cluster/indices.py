@@ -168,17 +168,32 @@ class IndexService:
                 dsl.parse_knn(k)
                 for k in (knn_body if isinstance(knn_body, list) else [knn_body])
             ]
+        aggs_body = body.get("aggs") or body.get("aggregations")
+        agg_nodes = None
+        if aggs_body is not None:
+            from ..search.aggs import parse_aggs
+
+            agg_nodes = parse_aggs(aggs_body)
         shard_results = []
         executors = []  # pinned per-request so a concurrent refresh can't
         # swap the reader between scoring and source fetch
+        agg_partials = []
         for shard in self.shards:
             ex = self._executor(shard)
             executors.append(ex)
-            # each shard returns the full global page's worth of hits
-            td = ex.search(
+            # each shard returns the full global page's worth of hits;
+            # the same execution's masks feed the agg phase (no re-run)
+            td, masks = ex.execute(
                 query, size=from_ + size, from_=0, knn=knn, min_score=min_score
             )
             shard_results.append(td)
+            if agg_nodes is not None:
+                from ..search.aggs import AggCollector
+
+                oracle = ex if isinstance(ex, NumpyExecutor) else ex._oracle
+                agg_partials.append(
+                    AggCollector(oracle).collect(agg_nodes, masks)
+                )
         total, max_score, hits = merge_top_docs(shard_results, from_, size)
         out_hits = []
         for h in hits:
@@ -208,6 +223,10 @@ class IndexService:
                 "hits": out_hits,
             },
         }
+        if agg_nodes is not None:
+            from ..search.aggs import reduce_aggs
+
+            resp["aggregations"] = reduce_aggs(agg_nodes, agg_partials)
         return resp
 
     def count(self, body: Optional[dict] = None) -> dict:

@@ -22,6 +22,7 @@ from urllib.parse import parse_qs, urlparse
 from ..cluster import ClusterError, ClusterService
 from ..index.engine import EngineError, VersionConflictError
 from ..index.mapping import MappingParseError
+from ..search.aggs import AggParseError
 from ..search.dsl import QueryParseError
 from .actions import RestActions
 from .router import error_body
@@ -96,7 +97,7 @@ class ElasticHandler(BaseHTTPRequestHandler):
             status, payload = 409, error_body(
                 409, "version_conflict_engine_exception", str(e)
             )
-        except (QueryParseError, MappingParseError) as e:
+        except (QueryParseError, MappingParseError, AggParseError) as e:
             status, payload = 400, error_body(400, "parsing_exception", str(e))
         except EngineError as e:
             status, payload = 500, error_body(500, "engine_exception", str(e))

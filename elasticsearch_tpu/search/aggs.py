@@ -1,0 +1,831 @@
+"""Aggregations: vectorized bucketing + metrics over columnar doc values.
+
+Reference analog: org.elasticsearch.search.aggregations (SURVEY.md §2.1)
+— AggregatorFactories parses the "aggs" tree, per-shard Aggregator
+collectors run during the query phase, and InternalAggregation.reduce
+merges shard partials at the coordinator. The TPU-native redesign drops
+doc-at-a-time Collector callbacks entirely: a query produces a dense
+per-segment match mask, every bucketing rule is a vectorized transform
+of the doc-value columns (np.bincount / searchsorted — the MXU/VPU-ready
+formulation), and sub-aggregations recurse with bucket-refined masks.
+
+Collect/reduce split mirrors the reference: ``collect(shard) → partial``
+(InternalAggregation), ``reduce([partials]) → response JSON``; the terms
+agg keeps per-shard top ``shard_size`` buckets and reduces like
+`InternalTerms.reduce` (sum_other_doc_count accounting included).
+
+Supported (round 1): metrics avg/sum/min/max/value_count/stats/
+cardinality/percentiles; buckets terms (keyword/numeric/boolean),
+histogram, date_histogram (fixed + calendar), range, date_range,
+filter, filters, missing — all with arbitrary sub-agg nesting.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field as dc_field
+from datetime import datetime, timezone
+from typing import Any, Dict, List, Optional, Sequence
+
+import numpy as np
+
+from ..index.mapping import BOOLEAN, DATE, KEYWORD, TEXT, parse_date_millis
+from . import dsl
+
+METRIC_TYPES = {
+    "avg",
+    "sum",
+    "min",
+    "max",
+    "value_count",
+    "stats",
+    "cardinality",
+    "percentiles",
+}
+BUCKET_TYPES = {
+    "terms",
+    "histogram",
+    "date_histogram",
+    "range",
+    "date_range",
+    "filter",
+    "filters",
+    "missing",
+}
+
+
+class AggParseError(ValueError):
+    pass
+
+
+@dataclass
+class AggNode:
+    name: str
+    type: str
+    params: dict
+    subs: List["AggNode"] = dc_field(default_factory=list)
+
+
+def parse_aggs(body: Any) -> List[AggNode]:
+    """Parses the request's "aggs"/"aggregations" object into a tree."""
+    if not isinstance(body, dict):
+        raise AggParseError("aggs must be an object")
+    nodes = []
+    for name, spec in body.items():
+        if not isinstance(spec, dict):
+            raise AggParseError(f"agg [{name}] must be an object")
+        subs: List[AggNode] = []
+        agg_type = None
+        params: dict = {}
+        for key, value in spec.items():
+            if key in ("aggs", "aggregations"):
+                subs = parse_aggs(value)
+            elif key == "meta":
+                continue
+            else:
+                if agg_type is not None:
+                    raise AggParseError(
+                        f"agg [{name}] defines multiple types "
+                        f"[{agg_type}, {key}]"
+                    )
+                agg_type = key
+                params = value if isinstance(value, dict) else {}
+        if agg_type is None:
+            raise AggParseError(f"agg [{name}] has no type")
+        if agg_type not in METRIC_TYPES | BUCKET_TYPES:
+            raise AggParseError(f"unknown aggregation type [{agg_type}]")
+        if subs and agg_type in METRIC_TYPES:
+            raise AggParseError(
+                f"metric agg [{name}] cannot have sub-aggregations"
+            )
+        nodes.append(AggNode(name, agg_type, params, subs))
+    return nodes
+
+
+# ----------------------------------------------------------------------
+# per-shard collection
+# ----------------------------------------------------------------------
+
+
+class AggCollector:
+    """Runs an agg tree over one shard (all its segments) given the
+    query's per-segment match masks. Uses the executor for filter
+    sub-queries so filter/filters buckets see identical query semantics."""
+
+    def __init__(self, executor):
+        self.ex = executor  # NumpyExecutor (oracle semantics)
+        self.reader = executor.reader
+        self._entry_docs_cache: Dict[tuple, np.ndarray] = {}
+        self._ord_index_cache: Dict[tuple, Dict[str, int]] = {}
+
+    # ---- doc-value access helpers ----
+
+    def _numeric_values(self, si: int, field: str):
+        seg = self.reader.segments[si]
+        nf = seg.numerics.get(field)
+        if nf is None:
+            n = seg.num_docs
+            return np.zeros(n), np.zeros(n, bool)
+        return nf.values, nf.exists
+
+    def _keyword_ords(self, si: int, field: str):
+        seg = self.reader.segments[si]
+        of = seg.ordinals.get(field)
+        if of is None:
+            return None
+        return of
+
+    def _live_mask(self, si: int, mask: np.ndarray) -> np.ndarray:
+        live = self.reader.live_docs[si]
+        if live is not None:
+            return mask & live
+        return mask
+
+    # ---- entry ----
+
+    def collect(self, nodes: Sequence[AggNode], masks: List[np.ndarray]) -> dict:
+        """masks: per-segment boolean match arrays (query+live filtered)."""
+        return {n.name: self._collect_node(n, masks) for n in nodes}
+
+    def _collect_node(self, node: AggNode, masks: List[np.ndarray]) -> dict:
+        fn = getattr(self, f"_collect_{node.type}", None)
+        if fn is None:
+            raise AggParseError(f"unknown aggregation type [{node.type}]")
+        return fn(node, masks)
+
+    def _entry_docs(self, si: int, of) -> np.ndarray:
+        """doc index per multi-value ordinal entry, cached per segment."""
+        key = (si, id(of))
+        cached = self._entry_docs_cache.get(key)
+        if cached is None:
+            n = self.reader.segments[si].num_docs
+            cached = np.repeat(np.arange(n), np.diff(of.mv_offsets))
+            self._entry_docs_cache[key] = cached
+        return cached
+
+    # ---- metrics ----
+
+    def _metric_values(
+        self, node: AggNode, masks, numeric_only: bool = True
+    ) -> np.ndarray:
+        f = node.params.get("field")
+        if f is None:
+            if "script" in node.params:
+                raise AggParseError("scripts not supported in this build")
+            raise AggParseError(f"agg [{node.name}] requires a field")
+        mf = self.reader.mappings.get(f)
+        vals = []
+        for si, mask in enumerate(masks):
+            if mf is not None and mf.type in (KEYWORD, TEXT):
+                if numeric_only:
+                    raise AggParseError(
+                        f"Field [{f}] of type [{mf.type}] is not supported "
+                        f"for aggregation [{node.type}]"
+                    )
+                of = self._keyword_ords(si, f)
+                if of is None:
+                    continue
+                sel = mask[self._entry_docs(si, of)]
+                vals.append(of.mv_ords[sel].astype(np.float64))  # count only
+            else:
+                v, e = self._numeric_values(si, f)
+                m = mask & e
+                vals.append(v[m])
+        return np.concatenate(vals) if vals else np.zeros(0)
+
+    def _collect_avg(self, node, masks):
+        v = self._metric_values(node, masks)
+        return {"t": "avg", "sum": float(v.sum()), "count": int(len(v))}
+
+    def _collect_sum(self, node, masks):
+        v = self._metric_values(node, masks)
+        return {"t": "sum", "sum": float(v.sum())}
+
+    def _collect_min(self, node, masks):
+        v = self._metric_values(node, masks)
+        return {"t": "min", "min": float(v.min()) if len(v) else None}
+
+    def _collect_max(self, node, masks):
+        v = self._metric_values(node, masks)
+        return {"t": "max", "max": float(v.max()) if len(v) else None}
+
+    def _collect_value_count(self, node, masks):
+        v = self._metric_values(node, masks, numeric_only=False)
+        return {"t": "value_count", "count": int(len(v))}
+
+    def _collect_stats(self, node, masks):
+        v = self._metric_values(node, masks)
+        return {
+            "t": "stats",
+            "count": int(len(v)),
+            "sum": float(v.sum()),
+            "min": float(v.min()) if len(v) else None,
+            "max": float(v.max()) if len(v) else None,
+        }
+
+    def _collect_cardinality(self, node, masks):
+        f = node.params.get("field")
+        if f is None:
+            raise AggParseError(f"agg [{node.name}] requires a field")
+        mf = self.reader.mappings.get(f)
+        uniq: set = set()
+        for si, mask in enumerate(masks):
+            if mf is not None and mf.type in (KEYWORD, TEXT):
+                of = self._keyword_ords(si, f)
+                if of is None:
+                    continue
+                sel_ords = np.unique(of.mv_ords[mask[self._entry_docs(si, of)]])
+                uniq.update(of.ord_terms[o] for o in sel_ords)
+            else:
+                v, e = self._numeric_values(si, f)
+                uniq.update(np.unique(v[mask & e]).tolist())
+        return {"t": "cardinality", "values": sorted(uniq, key=str)}
+
+    def _collect_percentiles(self, node, masks):
+        v = self._metric_values(node, masks)
+        return {
+            "t": "percentiles",
+            "values": v.tolist(),
+            "percents": node.params.get(
+                "percents", [1, 5, 25, 50, 75, 95, 99]
+            ),
+        }
+
+    # ---- bucket helpers ----
+
+    def _bucket_result(self, doc_count: int, sub_partial: dict) -> dict:
+        return {"doc_count": doc_count, "subs": sub_partial}
+
+    def _sub_collect(self, node: AggNode, bucket_masks: List[np.ndarray]) -> dict:
+        if not node.subs:
+            return {}
+        return self.collect(node.subs, bucket_masks)
+
+    # ---- terms ----
+
+    def _collect_terms(self, node, masks):
+        f = node.params.get("field")
+        if f is None:
+            raise AggParseError("terms agg requires a field")
+        size = int(node.params.get("size", 10))
+        shard_size = int(
+            node.params.get("shard_size", max(int(size * 1.5) + 10, size))
+        )
+        mf = self.reader.mappings.get(f)
+        if mf is not None and mf.type == TEXT:
+            raise AggParseError(
+                f"Text fields are not optimised for aggregations [{f}]; "
+                "use a keyword sub-field"
+            )
+        counts: Dict[Any, int] = {}
+        is_keyword = mf is not None and mf.type == KEYWORD
+        for si, mask in enumerate(masks):
+            if is_keyword:
+                of = self._keyword_ords(si, f)
+                if of is None:
+                    continue
+                sel = of.mv_ords[mask[self._entry_docs(si, of)]]
+                bc = np.bincount(sel, minlength=len(of.ord_terms))
+                for o in np.nonzero(bc)[0]:
+                    key = of.ord_terms[o]
+                    counts[key] = counts.get(key, 0) + int(bc[o])
+            else:
+                v, e = self._numeric_values(si, f)
+                m = mask & e
+                u, c = np.unique(v[m], return_counts=True)
+                for key, cnt in zip(u.tolist(), c.tolist()):
+                    if mf is not None and mf.type == BOOLEAN:
+                        key = bool(key)
+                    elif mf is not None and mf.type in ("integer", "long", "short", "byte", DATE):
+                        key = int(key)
+                    counts[key] = counts.get(key, 0) + cnt
+        total = sum(counts.values())
+        order = node.params.get("order", {"_count": "desc"})
+        top = _order_buckets(counts, order)[:shard_size]
+        buckets = {}
+        for key, cnt in top:
+            subs = {}
+            if node.subs:  # bucket masks only needed for sub-aggs
+                bucket_masks = [
+                    self._term_bucket_mask(si, f, key, mask, is_keyword)
+                    for si, mask in enumerate(masks)
+                ]
+                subs = self._sub_collect(node, bucket_masks)
+            buckets[_bkey(key)] = {"key": key, "doc_count": cnt, "subs": subs}
+        return {
+            "t": "terms",
+            "buckets": buckets,
+            "sum_docs": total,
+            "size": size,
+            "order": order,
+        }
+
+    def _term_bucket_mask(self, si, f, key, mask, is_keyword) -> np.ndarray:
+        seg = self.reader.segments[si]
+        n = seg.num_docs
+        if is_keyword:
+            of = self._keyword_ords(si, f)
+            if of is None:
+                return np.zeros(n, bool)
+            ord_index = self._ord_index_cache.get((si, f))
+            if ord_index is None:
+                ord_index = {t: i for i, t in enumerate(of.ord_terms)}
+                self._ord_index_cache[(si, f)] = ord_index
+            o = ord_index.get(key)
+            if o is None:
+                return np.zeros(n, bool)
+            entry_docs = self._entry_docs(si, of)
+            has = np.zeros(n, bool)
+            has[entry_docs[of.mv_ords == o]] = True
+            return mask & has
+        v, e = self._numeric_values(si, f)
+        return mask & e & (v == float(key))
+
+    # ---- histogram family ----
+
+    def _collect_histogram(self, node, masks):
+        f = _req(node, "field")
+        interval = float(_req(node, "interval"))
+        if interval <= 0:
+            raise AggParseError("interval must be > 0")
+        offset = float(node.params.get("offset", 0))
+        counts: Dict[float, int] = {}
+        per_seg_keys = []
+        for si, mask in enumerate(masks):
+            v, e = self._numeric_values(si, f)
+            keys = np.floor((v - offset) / interval) * interval + offset
+            per_seg_keys.append(keys)
+            m = mask & e
+            u, c = np.unique(keys[m], return_counts=True)
+            for k, cnt in zip(u.tolist(), c.tolist()):
+                counts[k] = counts.get(k, 0) + cnt
+        buckets = {}
+        for k in sorted(counts):
+            subs = {}
+            if node.subs:
+                bucket_masks = []
+                for si, mask in enumerate(masks):
+                    _, e = self._numeric_values(si, f)
+                    bucket_masks.append(mask & e & (per_seg_keys[si] == k))
+                subs = self._sub_collect(node, bucket_masks)
+            buckets[k] = {"key": k, "doc_count": counts[k], "subs": subs}
+        return {"t": "histogram", "buckets": buckets}
+
+    def _collect_date_histogram(self, node, masks):
+        f = _req(node, "field")
+        interval_ms, calendar_unit = _parse_dh_interval(node.params)
+        counts: Dict[int, int] = {}
+        per_seg_keys = []
+        for si, mask in enumerate(masks):
+            v, e = self._numeric_values(si, f)
+            keys = _date_bucket_keys(v, calendar_unit, interval_ms)
+            per_seg_keys.append(keys)
+            m = mask & e
+            u, c = np.unique(keys[m], return_counts=True)
+            for k, cnt in zip(u.tolist(), c.tolist()):
+                counts[int(k)] = counts.get(int(k), 0) + cnt
+        buckets = {}
+        for k in sorted(counts):
+            subs = {}
+            if node.subs:
+                bucket_masks = []
+                for si, mask in enumerate(masks):
+                    _, e = self._numeric_values(si, f)
+                    bucket_masks.append(mask & e & (per_seg_keys[si] == k))
+                subs = self._sub_collect(node, bucket_masks)
+            buckets[k] = {"key": k, "doc_count": counts[k], "subs": subs}
+        return {"t": "date_histogram", "buckets": buckets}
+
+    # ---- range family ----
+
+    def _collect_range(self, node, masks, is_date=False):
+        f = _req(node, "field")
+        ranges = node.params.get("ranges", [])
+        out = []
+        for r in ranges:
+            frm = r.get("from")
+            to = r.get("to")
+            if is_date:
+                frm = parse_date_millis(frm) if frm is not None else None
+                to = parse_date_millis(to) if to is not None else None
+            else:
+                frm = float(frm) if frm is not None else None
+                to = float(to) if to is not None else None
+            bucket_masks = []
+            cnt = 0
+            for si, mask in enumerate(masks):
+                v, e = self._numeric_values(si, f)
+                m = mask & e
+                if frm is not None:
+                    m = m & (v >= frm)
+                if to is not None:
+                    m = m & (v < to)
+                bucket_masks.append(m)
+                cnt += int(m.sum())
+            key = r.get("key")
+            if key is None:
+                fs = _range_key_part(r.get("from"), is_date, frm)
+                ts = _range_key_part(r.get("to"), is_date, to)
+                key = f"{fs}-{ts}"
+            entry = {
+                "key": key,
+                "doc_count": cnt,
+                "subs": self._sub_collect(node, bucket_masks),
+            }
+            if frm is not None:
+                entry["from"] = frm
+            if to is not None:
+                entry["to"] = to
+            out.append(entry)
+        return {"t": "range", "buckets": out, "keyed": node.params.get("keyed", False)}
+
+    def _collect_date_range(self, node, masks):
+        r = self._collect_range(node, masks, is_date=True)
+        r["t"] = "date_range"
+        return r
+
+    # ---- filter / filters / missing ----
+
+    def _query_masks(self, query_json: dict, masks) -> List[np.ndarray]:
+        q = dsl.parse_query(query_json)
+        out = []
+        for si, mask in enumerate(masks):
+            m, _ = self.ex._exec(q, self.reader.segments[si])
+            out.append(mask & m)
+        return out
+
+    def _collect_filter(self, node, masks):
+        # the filter *is* the params object itself ({"term": ...})
+        fmasks = self._query_masks(node.params, masks)
+        return {
+            "t": "filter",
+            "doc_count": int(sum(m.sum() for m in fmasks)),
+            "subs": self._sub_collect(node, fmasks),
+        }
+
+    def _collect_filters(self, node, masks):
+        specs = node.params.get("filters", {})
+        buckets = {}
+        if isinstance(specs, dict):
+            items = specs.items()
+            keyed = True
+        else:
+            items = ((str(i), s) for i, s in enumerate(specs))
+            keyed = False
+        for key, qjson in items:
+            fmasks = self._query_masks(qjson, masks)
+            buckets[key] = {
+                "key": key,
+                "doc_count": int(sum(m.sum() for m in fmasks)),
+                "subs": self._sub_collect(node, fmasks),
+            }
+        return {"t": "filters", "buckets": buckets, "keyed": keyed}
+
+    def _collect_missing(self, node, masks):
+        f = node.params.get("field")
+        mf = self.reader.mappings.get(f) if f else None
+        mmasks = []
+        for si, mask in enumerate(masks):
+            seg = self.reader.segments[si]
+            n = seg.num_docs
+            if mf is not None and mf.type in (KEYWORD, TEXT):
+                of = self._keyword_ords(si, f)
+                if of is None:
+                    have = np.zeros(n, bool)
+                else:
+                    have = of.ords >= 0
+            else:
+                _, have = self._numeric_values(si, f)
+            mmasks.append(mask & ~have)
+        return {
+            "t": "missing",
+            "doc_count": int(sum(m.sum() for m in mmasks)),
+            "subs": self._sub_collect(node, mmasks),
+        }
+
+
+# ----------------------------------------------------------------------
+# coordinator reduce (InternalAggregation.reduce analog)
+# ----------------------------------------------------------------------
+
+
+def reduce_aggs(nodes: Sequence[AggNode], shard_partials: List[dict]) -> dict:
+    out = {}
+    for node in nodes:
+        parts = [p[node.name] for p in shard_partials if node.name in p]
+        out[node.name] = _reduce_node(node, parts)
+    return out
+
+
+def _reduce_node(node: AggNode, parts: List[dict]) -> dict:
+    t = node.type
+    if t == "avg":
+        s = sum(p["sum"] for p in parts)
+        c = sum(p["count"] for p in parts)
+        return {"value": (s / c) if c else None}
+    if t == "sum":
+        return {"value": sum(p["sum"] for p in parts)}
+    if t == "min":
+        vals = [p["min"] for p in parts if p["min"] is not None]
+        return {"value": min(vals) if vals else None}
+    if t == "max":
+        vals = [p["max"] for p in parts if p["max"] is not None]
+        return {"value": max(vals) if vals else None}
+    if t == "value_count":
+        return {"value": sum(p["count"] for p in parts)}
+    if t == "stats":
+        c = sum(p["count"] for p in parts)
+        s = sum(p["sum"] for p in parts)
+        mins = [p["min"] for p in parts if p["min"] is not None]
+        maxs = [p["max"] for p in parts if p["max"] is not None]
+        return {
+            "count": c,
+            "min": min(mins) if mins else None,
+            "max": max(maxs) if maxs else None,
+            "avg": (s / c) if c else None,
+            "sum": s,
+        }
+    if t == "cardinality":
+        uniq: set = set()
+        for p in parts:
+            uniq.update(p["values"])
+        return {"value": len(uniq)}
+    if t == "percentiles":
+        vals = np.concatenate([np.asarray(p["values"]) for p in parts]) if parts else np.zeros(0)
+        percents = parts[0]["percents"] if parts else [1, 5, 25, 50, 75, 95, 99]
+        values = {}
+        for pc in percents:
+            values[f"{float(pc)}"] = (
+                float(np.percentile(vals, pc)) if len(vals) else None
+            )
+        return {"values": values}
+    if t == "terms":
+        merged: Dict[Any, dict] = {}
+        total = 0
+        size = int(node.params.get("size", 10))
+        for p in parts:
+            total += p["sum_docs"]
+            for bk, b in p["buckets"].items():
+                cur = merged.get(bk)
+                if cur is None:
+                    merged[bk] = {
+                        "key": b["key"],
+                        "doc_count": b["doc_count"],
+                        "subs": [b["subs"]],
+                    }
+                else:
+                    cur["doc_count"] += b["doc_count"]
+                    cur["subs"].append(b["subs"])
+        order = node.params.get("order", {"_count": "desc"})
+        counts = {b["key"]: b["doc_count"] for b in merged.values()}
+        ordered = _order_buckets(counts, order)[:size]
+        buckets = []
+        top_total = 0
+        for key, cnt in ordered:
+            b = merged[_bkey(key)]
+            top_total += cnt
+            entry = {"key": key, "doc_count": cnt}
+            if isinstance(key, bool):
+                entry["key"] = int(key)
+                entry["key_as_string"] = "true" if key else "false"
+            entry.update(_reduce_subs(node, b["subs"]))
+            buckets.append(entry)
+        return {
+            "doc_count_error_upper_bound": 0,
+            "sum_other_doc_count": max(total - top_total, 0),
+            "buckets": buckets,
+        }
+    if t in ("histogram", "date_histogram"):
+        merged = {}
+        for p in parts:
+            for bk, b in p["buckets"].items():
+                cur = merged.get(bk)
+                if cur is None:
+                    merged[bk] = {
+                        "key": b["key"],
+                        "doc_count": b["doc_count"],
+                        "subs": [b["subs"]],
+                    }
+                else:
+                    cur["doc_count"] += b["doc_count"]
+                    cur["subs"].append(b["subs"])
+        # min_doc_count applies post-merge (a bucket may clear the bar
+        # only once all shards' counts are summed)
+        min_count = int(node.params.get("min_doc_count", 0))
+        buckets = []
+        for bk in sorted(merged):
+            b = merged[bk]
+            if b["doc_count"] < min_count:
+                continue
+            entry = {"key": b["key"], "doc_count": b["doc_count"]}
+            if t == "date_histogram":
+                entry["key_as_string"] = _millis_iso(b["key"])
+            entry.update(_reduce_subs(node, b["subs"]))
+            buckets.append(entry)
+        return {"buckets": buckets}
+    if t in ("range", "date_range"):
+        keyed = parts[0]["keyed"] if parts else False
+        by_key: Dict[str, dict] = {}
+        order: List[str] = []
+        for p in parts:
+            for b in p["buckets"]:
+                cur = by_key.get(b["key"])
+                if cur is None:
+                    by_key[b["key"]] = {
+                        **{k: v for k, v in b.items() if k != "subs"},
+                        "subs": [b["subs"]],
+                    }
+                    order.append(b["key"])
+                else:
+                    cur["doc_count"] += b["doc_count"]
+                    cur["subs"].append(b["subs"])
+        buckets = []
+        for key in order:
+            b = by_key[key]
+            entry = {k: v for k, v in b.items() if k != "subs"}
+            if t == "date_range":
+                if "from" in entry:
+                    entry["from_as_string"] = _millis_iso(entry["from"])
+                if "to" in entry:
+                    entry["to_as_string"] = _millis_iso(entry["to"])
+            entry.update(_reduce_subs(node, b["subs"]))
+            buckets.append(entry)
+        if keyed:
+            return {
+                "buckets": {
+                    b["key"]: {k: v for k, v in b.items() if k != "key"}
+                    for b in buckets
+                }
+            }
+        return {"buckets": buckets}
+    if t == "filter" or t == "missing":
+        return {
+            "doc_count": sum(p["doc_count"] for p in parts),
+            **_reduce_subs(node, [p["subs"] for p in parts]),
+        }
+    if t == "filters":
+        keyed = parts[0]["keyed"] if parts else True
+        merged = {}
+        for p in parts:
+            for key, b in p["buckets"].items():
+                cur = merged.get(key)
+                if cur is None:
+                    merged[key] = {
+                        "key": b["key"],
+                        "doc_count": b["doc_count"],
+                        "subs": [b["subs"]],
+                    }
+                else:
+                    cur["doc_count"] += b["doc_count"]
+                    cur["subs"].append(b["subs"])
+        if keyed:
+            return {
+                "buckets": {
+                    key: {
+                        "doc_count": m["doc_count"],
+                        **_reduce_subs(node, m["subs"]),
+                    }
+                    for key, m in merged.items()
+                }
+            }
+        return {
+            "buckets": [
+                {"doc_count": m["doc_count"], **_reduce_subs(node, m["subs"])}
+                for _, m in sorted(merged.items(), key=lambda kv: int(kv[0]))
+            ]
+        }
+    raise AggParseError(f"unknown aggregation type [{t}]")
+
+
+def _reduce_subs(node: AggNode, sub_partials: List[dict]) -> dict:
+    if not node.subs:
+        return {}
+    return reduce_aggs(node.subs, [p for p in sub_partials if p])
+
+
+# ----------------------------------------------------------------------
+# shared helpers
+# ----------------------------------------------------------------------
+
+
+def _bkey(key: Any) -> str:
+    return f"{type(key).__name__}:{key}"
+
+
+def _order_buckets(counts: Dict[Any, int], order: dict) -> List[tuple]:
+    (okey, direction), *_ = list(order.items()) or [("_count", "desc")]
+    if okey not in ("_count", "_key"):
+        raise AggParseError(
+            f"ordering by [{okey}] is not supported "
+            "(only _count and _key in this build)"
+        )
+    reverse = direction == "desc"
+    items = list(counts.items())
+    if okey == "_key":
+        items.sort(key=lambda kv: _sort_key(kv[0]), reverse=reverse)
+    else:  # _count, tie-break key asc (Lucene order)
+        items.sort(key=lambda kv: _sort_key(kv[0]))
+        items.sort(key=lambda kv: kv[1], reverse=reverse)
+    return items
+
+
+def _req(node: AggNode, name: str):
+    v = node.params.get(name)
+    if v is None:
+        raise AggParseError(f"[{node.type}] agg [{node.name}] requires [{name}]")
+    return v
+
+
+def _sort_key(k: Any):
+    # normalize mixed bool/int/float keys; strings sort as strings
+    if isinstance(k, bool):
+        return (0, int(k))
+    if isinstance(k, (int, float)):
+        return (0, float(k))
+    return (1, str(k))
+
+
+_CAL_UNITS = {
+    "minute": 60_000,
+    "1m": 60_000,
+    "hour": 3_600_000,
+    "1h": 3_600_000,
+    "day": 86_400_000,
+    "1d": 86_400_000,
+    "week": 7 * 86_400_000,
+    "1w": 7 * 86_400_000,
+}
+_FIXED_SUFFIX = {
+    "ms": 1,
+    "s": 1000,
+    "m": 60_000,
+    "h": 3_600_000,
+    "d": 86_400_000,
+}
+
+
+def _parse_dh_interval(params: dict):
+    """Returns (interval_ms or None, calendar_unit or None)."""
+    cal = params.get("calendar_interval")
+    if cal is not None:
+        if cal in ("month", "1M"):
+            return None, "month"
+        if cal in ("quarter", "1q"):
+            return None, "quarter"
+        if cal in ("year", "1y"):
+            return None, "year"
+        if cal in _CAL_UNITS:
+            return _CAL_UNITS[cal], None
+        raise AggParseError(f"unknown calendar interval [{cal}]")
+    fixed = params.get("fixed_interval") or params.get("interval")
+    if fixed is None:
+        raise AggParseError("date_histogram requires an interval")
+    s = str(fixed)
+    for suffix in ("ms", "s", "m", "h", "d"):
+        if s.endswith(suffix):
+            try:
+                return int(s[: -len(suffix)]) * _FIXED_SUFFIX[suffix], None
+            except ValueError:
+                break
+    raise AggParseError(f"unparsable interval [{fixed}]")
+
+
+def _date_bucket_keys(
+    millis: np.ndarray, calendar_unit: Optional[str], interval_ms: Optional[int]
+) -> np.ndarray:
+    if calendar_unit is None:
+        assert interval_ms is not None
+        return (np.floor(millis / interval_ms) * interval_ms).astype(np.int64)
+    # calendar month/quarter/year: bucket start at UTC boundary
+    out = np.zeros(len(millis), np.int64)
+    for i, ms in enumerate(millis):
+        dt = datetime.fromtimestamp(ms / 1000.0, tz=timezone.utc)
+        if calendar_unit == "month":
+            b = dt.replace(day=1, hour=0, minute=0, second=0, microsecond=0)
+        elif calendar_unit == "quarter":
+            b = dt.replace(
+                month=((dt.month - 1) // 3) * 3 + 1,
+                day=1,
+                hour=0,
+                minute=0,
+                second=0,
+                microsecond=0,
+            )
+        else:  # year
+            b = dt.replace(
+                month=1, day=1, hour=0, minute=0, second=0, microsecond=0
+            )
+        out[i] = int(b.timestamp() * 1000)
+    return out
+
+
+def _millis_iso(ms: float) -> str:
+    dt = datetime.fromtimestamp(ms / 1000.0, tz=timezone.utc)
+    return dt.strftime("%Y-%m-%dT%H:%M:%S.") + f"{dt.microsecond // 1000:03d}Z"
+
+
+def _range_key_part(raw, is_date: bool, parsed) -> str:
+    if raw is None:
+        return "*"
+    if is_date:
+        return str(raw)
+    return f"{float(parsed)}"

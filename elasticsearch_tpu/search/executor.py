@@ -167,6 +167,18 @@ class NumpyExecutor:
         knn: Optional[List[KnnSection]] = None,
         min_score: Optional[float] = None,
     ) -> TopDocs:
+        return self.execute(query, size, from_, knn, min_score)[0]
+
+    def execute(
+        self,
+        query: Optional[Query],
+        size: int = 10,
+        from_: int = 0,
+        knn: Optional[List[KnnSection]] = None,
+        min_score: Optional[float] = None,
+    ) -> Tuple[TopDocs, List[np.ndarray]]:
+        """(TopDocs, per-segment match masks) — masks feed the agg phase
+        so query execution isn't paid twice."""
         # knn sections: per-segment candidates, then a *global* top-k cut
         # across segments (SearchPhaseController.mergeKnnResults semantics)
         knn_sets = [self._knn_topk_global(sec) for sec in (knn or [])]
@@ -207,7 +219,30 @@ class NumpyExecutor:
             for i in top
         ]
         max_score = float(flat_scores.max()) if len(flat_scores) else None
-        return TopDocs(total=total, hits=hits, max_score=max_score)
+        return (
+            TopDocs(total=total, hits=hits, max_score=max_score),
+            [m for m, _ in per_segment],
+        )
+
+    def match_masks(
+        self,
+        query: Optional[Query],
+        knn: Optional[List[KnnSection]] = None,
+        min_score: Optional[float] = None,
+    ) -> List[np.ndarray]:
+        """Per-segment dense match masks (query+live+min_score applied) —
+        the aggregation phase's document set (Aggregator's collect scope)."""
+        knn_sets = [self._knn_topk_global(sec) for sec in (knn or [])]
+        masks = []
+        for si, seg in enumerate(self.reader.segments):
+            mask, scores = self._execute_root(query, knn_sets, si, seg)
+            live = self.reader.live_docs[si]
+            if live is not None:
+                mask = mask & live
+            if min_score is not None:
+                mask = mask & (scores >= min_score)
+            masks.append(mask)
+        return masks
 
     def _execute_root(
         self,

@@ -124,6 +124,16 @@ class JaxExecutor:
         knn: Optional[List[KnnSection]] = None,
         min_score: Optional[float] = None,
     ) -> TopDocs:
+        return self.execute(query, size, from_, knn, min_score)[0]
+
+    def execute(
+        self,
+        query: Optional[Query],
+        size: int = 10,
+        from_: int = 0,
+        knn: Optional[List[KnnSection]] = None,
+        min_score: Optional[float] = None,
+    ) -> Tuple[TopDocs, List[np.ndarray]]:
         knn_sets = [self._knn_topk_global(sec) for sec in (knn or [])]
         per_segment: List[Tuple[np.ndarray, np.ndarray]] = []
         for si, seg in enumerate(self.reader.segments):
@@ -160,8 +170,9 @@ class JaxExecutor:
                 cand_scores.append(scores[idx].astype(np.float64))
                 cand_seg.append(np.full(len(idx), si, np.int64))
                 cand_doc.append(idx.astype(np.int64))
+        masks = [m for m, _ in per_segment]
         if not cand_scores:
-            return TopDocs(total=total, hits=[], max_score=None)
+            return TopDocs(total=total, hits=[], max_score=None), masks
         s = np.concatenate(cand_scores)
         sg = np.concatenate(cand_seg)
         dc = np.concatenate(cand_doc)
@@ -185,7 +196,7 @@ class JaxExecutor:
             )
             for i in top
         ]
-        return TopDocs(total=total, hits=hits, max_score=max_score)
+        return TopDocs(total=total, hits=hits, max_score=max_score), masks
 
     # ---- node dispatch ----
 

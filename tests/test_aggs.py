@@ -1,0 +1,392 @@
+"""Aggregation tests: metrics, buckets, nesting, cross-shard reduce.
+
+Reference analog: AggregatorTestCase-style unit coverage (SURVEY.md §4)
+plus multi-shard reduce checks (InternalAggregation.reduce semantics).
+Expected values are computed independently from the raw docs in the
+tests themselves."""
+
+import numpy as np
+import pytest
+
+from elasticsearch_tpu.cluster import IndexService
+
+DOCS = [
+    {"cat": "a", "price": 10, "qty": 1, "tags": ["x", "y"], "day": "2024-01-01T10:00:00Z"},
+    {"cat": "a", "price": 20, "qty": 2, "tags": ["x"], "day": "2024-01-01T16:00:00Z"},
+    {"cat": "b", "price": 30, "qty": 3, "tags": ["y"], "day": "2024-01-02T09:00:00Z"},
+    {"cat": "b", "price": 40, "qty": 4, "tags": ["z"], "day": "2024-02-03T12:00:00Z"},
+    {"cat": "c", "price": 50, "qty": 5, "tags": [], "day": "2024-02-10T00:00:00Z"},
+    {"cat": "a", "price": 60, "qty": 6, "day": "2024-03-15T08:30:00Z"},
+    {"price": 70, "qty": 7, "tags": ["x"], "day": "2024-03-20T23:59:59Z"},
+]
+
+MAPPING = {
+    "properties": {
+        "cat": {"type": "keyword"},
+        "tags": {"type": "keyword"},
+        "price": {"type": "double"},
+        "qty": {"type": "integer"},
+        "day": {"type": "date"},
+    }
+}
+
+
+def build_index(n_shards=1):
+    idx = IndexService(
+        "aggtest",
+        settings={"number_of_shards": n_shards, "number_of_replicas": 0},
+        mappings_json=MAPPING,
+    )
+    for i, d in enumerate(DOCS):
+        idx.index_doc(str(i), d)
+    idx.refresh()
+    return idx
+
+
+@pytest.fixture(params=[1, 3], ids=["1shard", "3shards"])
+def idx(request):
+    return build_index(request.param)
+
+
+def agg(idx, aggs, query=None, size=0):
+    body = {"aggs": aggs, "size": size}
+    if query:
+        body["query"] = query
+    return idx.search(body)["aggregations"]
+
+
+class TestMetrics:
+    def test_basic_metrics(self, idx):
+        out = agg(
+            idx,
+            {
+                "p_avg": {"avg": {"field": "price"}},
+                "p_sum": {"sum": {"field": "price"}},
+                "p_min": {"min": {"field": "price"}},
+                "p_max": {"max": {"field": "price"}},
+                "p_count": {"value_count": {"field": "price"}},
+                "p_stats": {"stats": {"field": "price"}},
+            },
+        )
+        prices = [d["price"] for d in DOCS]
+        assert out["p_avg"]["value"] == pytest.approx(np.mean(prices))
+        assert out["p_sum"]["value"] == pytest.approx(sum(prices))
+        assert out["p_min"]["value"] == 10
+        assert out["p_max"]["value"] == 70
+        assert out["p_count"]["value"] == 7
+        st = out["p_stats"]
+        assert st["count"] == 7 and st["sum"] == sum(prices)
+        assert st["avg"] == pytest.approx(np.mean(prices))
+
+    def test_metrics_respect_query(self, idx):
+        out = agg(
+            idx,
+            {"s": {"sum": {"field": "price"}}},
+            query={"term": {"cat": "a"}},
+        )
+        assert out["s"]["value"] == 10 + 20 + 60
+
+    def test_cardinality(self, idx):
+        out = agg(
+            idx,
+            {
+                "cats": {"cardinality": {"field": "cat"}},
+                "tags": {"cardinality": {"field": "tags"}},
+                "prices": {"cardinality": {"field": "price"}},
+            },
+        )
+        assert out["cats"]["value"] == 3
+        assert out["tags"]["value"] == 3
+        assert out["prices"]["value"] == 7
+
+    def test_numeric_metric_on_keyword_rejected(self, idx):
+        from elasticsearch_tpu.search.aggs import AggParseError
+
+        with pytest.raises(AggParseError):
+            agg(idx, {"bad": {"avg": {"field": "cat"}}})
+        # value_count on keyword is fine (counts values)
+        out = agg(idx, {"c": {"value_count": {"field": "tags"}}})
+        assert out["c"]["value"] == 6
+
+    def test_histogram_min_doc_count(self, idx):
+        out = agg(
+            idx,
+            {
+                "h": {
+                    "histogram": {
+                        "field": "price",
+                        "interval": 25,
+                        "min_doc_count": 3,
+                    }
+                }
+            },
+        )
+        assert [(b["key"], b["doc_count"]) for b in out["h"]["buckets"]] == [
+            (50.0, 3)
+        ]
+
+    def test_unsupported_order_rejected(self, idx):
+        from elasticsearch_tpu.search.aggs import AggParseError
+
+        with pytest.raises(AggParseError):
+            agg(
+                idx,
+                {"t": {"terms": {"field": "cat", "order": {"sub_agg": "desc"}}}},
+            )
+
+    def test_percentiles(self, idx):
+        out = agg(idx, {"p": {"percentiles": {"field": "price", "percents": [50]}}})
+        assert out["p"]["values"]["50.0"] == pytest.approx(40.0)
+
+    def test_empty_result_metrics(self, idx):
+        out = agg(
+            idx,
+            {"a": {"avg": {"field": "price"}}, "m": {"min": {"field": "price"}}},
+            query={"term": {"cat": "nope"}},
+        )
+        assert out["a"]["value"] is None
+        assert out["m"]["value"] is None
+
+
+class TestTerms:
+    def test_keyword_terms_order_and_counts(self, idx):
+        out = agg(idx, {"cats": {"terms": {"field": "cat"}}})
+        buckets = out["cats"]["buckets"]
+        assert [(b["key"], b["doc_count"]) for b in buckets] == [
+            ("a", 3),
+            ("b", 2),
+            ("c", 1),
+        ]
+        assert out["cats"]["sum_other_doc_count"] == 0
+
+    def test_multivalue_keyword(self, idx):
+        out = agg(idx, {"tags": {"terms": {"field": "tags"}}})
+        counts = {b["key"]: b["doc_count"] for b in out["tags"]["buckets"]}
+        assert counts == {"x": 3, "y": 2, "z": 1}
+
+    def test_numeric_terms(self, idx):
+        out = agg(idx, {"q": {"terms": {"field": "qty", "size": 3}}})
+        buckets = out["q"]["buckets"]
+        assert len(buckets) == 3
+        # all counts 1 → key asc tiebreak
+        assert [b["key"] for b in buckets] == [1, 2, 3]
+        assert out["q"]["sum_other_doc_count"] == 4
+
+    def test_size_and_other_count(self, idx):
+        out = agg(idx, {"cats": {"terms": {"field": "cat", "size": 1}}})
+        assert len(out["cats"]["buckets"]) == 1
+        assert out["cats"]["buckets"][0]["key"] == "a"
+        assert out["cats"]["sum_other_doc_count"] == 3
+
+    def test_order_by_key(self, idx):
+        out = agg(
+            idx, {"cats": {"terms": {"field": "cat", "order": {"_key": "desc"}}}}
+        )
+        assert [b["key"] for b in out["cats"]["buckets"]] == ["c", "b", "a"]
+
+    def test_terms_on_text_rejected(self, idx):
+        from elasticsearch_tpu.search.aggs import AggParseError
+
+        with pytest.raises(AggParseError):
+            # dynamic-mapped text field (no explicit keyword)
+            idx.index_doc("t", {"freetext": "hello world"})
+            idx.refresh()
+            agg(idx, {"x": {"terms": {"field": "freetext"}}})
+
+    def test_terms_with_sub_metric(self, idx):
+        out = agg(
+            idx,
+            {
+                "cats": {
+                    "terms": {"field": "cat"},
+                    "aggs": {"avg_price": {"avg": {"field": "price"}}},
+                }
+            },
+        )
+        by_key = {b["key"]: b for b in out["cats"]["buckets"]}
+        assert by_key["a"]["avg_price"]["value"] == pytest.approx((10 + 20 + 60) / 3)
+        assert by_key["b"]["avg_price"]["value"] == pytest.approx(35.0)
+        assert by_key["c"]["avg_price"]["value"] == 50
+
+
+class TestHistogram:
+    def test_histogram(self, idx):
+        out = agg(idx, {"h": {"histogram": {"field": "price", "interval": 25}}})
+        buckets = {b["key"]: b["doc_count"] for b in out["h"]["buckets"]}
+        # prices 10,20 → 0; 30,40 → 25; 50,60,70 → 50
+        assert buckets == {0.0: 2, 25.0: 2, 50.0: 3}
+
+    def test_histogram_sub_aggs(self, idx):
+        out = agg(
+            idx,
+            {
+                "h": {
+                    "histogram": {"field": "qty", "interval": 3},
+                    "aggs": {"mx": {"max": {"field": "price"}}},
+                }
+            },
+        )
+        by_key = {b["key"]: b for b in out["h"]["buckets"]}
+        # qty 1,2 → 0; 3,4,5 → 3; 6,7 → 6
+        assert by_key[0.0]["mx"]["value"] == 20
+        assert by_key[3.0]["mx"]["value"] == 50
+        assert by_key[6.0]["mx"]["value"] == 70
+
+    def test_date_histogram_month(self, idx):
+        out = agg(
+            idx,
+            {"m": {"date_histogram": {"field": "day", "calendar_interval": "month"}}},
+        )
+        buckets = out["m"]["buckets"]
+        assert [b["key_as_string"][:7] for b in buckets] == [
+            "2024-01",
+            "2024-02",
+            "2024-03",
+        ]
+        assert [b["doc_count"] for b in buckets] == [3, 2, 2]
+
+    def test_date_histogram_fixed_day(self, idx):
+        out = agg(
+            idx,
+            {"d": {"date_histogram": {"field": "day", "fixed_interval": "1d"}}},
+        )
+        counts = {b["key_as_string"][:10]: b["doc_count"] for b in out["d"]["buckets"]}
+        assert counts["2024-01-01"] == 2
+        assert counts["2024-01-02"] == 1
+
+
+class TestRangeFiltersMissing:
+    def test_range(self, idx):
+        out = agg(
+            idx,
+            {
+                "r": {
+                    "range": {
+                        "field": "price",
+                        "ranges": [
+                            {"to": 25},
+                            {"from": 25, "to": 55},
+                            {"from": 55, "key": "high"},
+                        ],
+                    }
+                }
+            },
+        )
+        buckets = out["r"]["buckets"]
+        assert [b["doc_count"] for b in buckets] == [2, 3, 2]
+        assert buckets[2]["key"] == "high"
+
+    def test_date_range(self, idx):
+        out = agg(
+            idx,
+            {
+                "r": {
+                    "date_range": {
+                        "field": "day",
+                        "ranges": [{"from": "2024-02-01T00:00:00Z"}],
+                    }
+                }
+            },
+        )
+        assert out["r"]["buckets"][0]["doc_count"] == 4
+
+    def test_filter_and_filters(self, idx):
+        out = agg(
+            idx,
+            {
+                "cheap": {
+                    "filter": {"range": {"price": {"lt": 35}}},
+                    "aggs": {"avg": {"avg": {"field": "price"}}},
+                },
+                "groups": {
+                    "filters": {
+                        "filters": {
+                            "a_cat": {"term": {"cat": "a"}},
+                            "tag_x": {"term": {"tags": "x"}},
+                        }
+                    }
+                },
+            },
+        )
+        assert out["cheap"]["doc_count"] == 3
+        assert out["cheap"]["avg"]["value"] == pytest.approx(20.0)
+        assert out["groups"]["buckets"]["a_cat"]["doc_count"] == 3
+        assert out["groups"]["buckets"]["tag_x"]["doc_count"] == 3
+
+    def test_missing(self, idx):
+        out = agg(
+            idx,
+            {
+                "no_cat": {"missing": {"field": "cat"}},
+                "no_tags": {"missing": {"field": "tags"}},
+            },
+        )
+        assert out["no_cat"]["doc_count"] == 1
+        # doc 4 has tags: [] and doc 5 has no tags key at all
+        assert out["no_tags"]["doc_count"] == 2
+
+    def test_deep_nesting(self, idx):
+        out = agg(
+            idx,
+            {
+                "cats": {
+                    "terms": {"field": "cat"},
+                    "aggs": {
+                        "tags": {
+                            "terms": {"field": "tags"},
+                            "aggs": {"mx": {"max": {"field": "qty"}}},
+                        }
+                    },
+                }
+            },
+        )
+        a = {b["key"]: b for b in out["cats"]["buckets"]}["a"]
+        a_tags = {b["key"]: b for b in a["tags"]["buckets"]}
+        assert a_tags["x"]["doc_count"] == 2
+        assert a_tags["x"]["mx"]["value"] == 2
+        assert a_tags["y"]["doc_count"] == 1
+
+
+class TestRestAggs:
+    def test_aggs_over_http(self):
+        import json
+        import urllib.request
+
+        from elasticsearch_tpu.rest.server import ElasticsearchTpuServer
+
+        srv = ElasticsearchTpuServer(port=0)
+        srv.start_background()
+        base = f"http://127.0.0.1:{srv.port}"
+        try:
+
+            def call(method, path, body):
+                req = urllib.request.Request(
+                    base + path,
+                    data=json.dumps(body).encode(),
+                    method=method,
+                    headers={"Content-Type": "application/json"},
+                )
+                with urllib.request.urlopen(req) as r:
+                    return json.loads(r.read())
+
+            call("PUT", "/shop", {"mappings": MAPPING})
+            for i, d in enumerate(DOCS):
+                call("PUT", f"/shop/_doc/{i}?refresh=true", d)
+            resp = call(
+                "POST",
+                "/shop/_search",
+                {
+                    "size": 0,
+                    "aggs": {
+                        "cats": {
+                            "terms": {"field": "cat"},
+                            "aggs": {"avg_p": {"avg": {"field": "price"}}},
+                        }
+                    },
+                },
+            )
+            buckets = resp["aggregations"]["cats"]["buckets"]
+            assert buckets[0]["key"] == "a" and buckets[0]["doc_count"] == 3
+            assert buckets[0]["avg_p"]["value"] == pytest.approx(30.0)
+        finally:
+            srv.close()
