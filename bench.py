@@ -199,6 +199,9 @@ def main():
     )
     queries = make_queries(seg)
     qps, results = device_bench(seg, queries)
+    # NOTE: the block-max WAND scorer (ops/wand.py) is exact but only
+    # pays off when n_doc_blocks >> k (million-doc corpora); at this
+    # corpus size the dense scorer wins, so it is not in the hot path.
     base_qps, mism = cpu_baseline(reader, queries, results, seg)
     # parity gates throughput (BASELINE.md): a mismatched ranking must not
     # be reported as a valid speedup

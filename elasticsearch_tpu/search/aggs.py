@@ -134,12 +134,6 @@ class AggCollector:
             return None
         return of
 
-    def _live_mask(self, si: int, mask: np.ndarray) -> np.ndarray:
-        live = self.reader.live_docs[si]
-        if live is not None:
-            return mask & live
-        return mask
-
     # ---- entry ----
 
     def collect(self, nodes: Sequence[AggNode], masks: List[np.ndarray]) -> dict:
@@ -223,28 +217,43 @@ class AggCollector:
         }
 
     def _collect_cardinality(self, node, masks):
+        """Exact distinct count; partials are numpy arrays (keyword terms
+        hash to uint64 so cross-segment/shard union needs no boxing).
+        Round 2: HLL++ sketch for true sublinear partials."""
         f = node.params.get("field")
         if f is None:
             raise AggParseError(f"agg [{node.name}] requires a field")
         mf = self.reader.mappings.get(f)
-        uniq: set = set()
+        parts = []
         for si, mask in enumerate(masks):
             if mf is not None and mf.type in (KEYWORD, TEXT):
                 of = self._keyword_ords(si, f)
                 if of is None:
                     continue
                 sel_ords = np.unique(of.mv_ords[mask[self._entry_docs(si, of)]])
-                uniq.update(of.ord_terms[o] for o in sel_ords)
+                # hash terms so segments with different ord spaces merge
+                parts.append(
+                    np.fromiter(
+                        (hash(of.ord_terms[o]) for o in sel_ords),
+                        np.int64,
+                        count=len(sel_ords),
+                    )
+                )
             else:
                 v, e = self._numeric_values(si, f)
-                uniq.update(np.unique(v[mask & e]).tolist())
-        return {"t": "cardinality", "values": sorted(uniq, key=str)}
+                parts.append(np.unique(v[mask & e]).view(np.int64))
+        vals = (
+            np.unique(np.concatenate(parts)) if parts else np.zeros(0, np.int64)
+        )
+        return {"t": "cardinality", "values": vals}
 
     def _collect_percentiles(self, node, masks):
+        # exact percentiles: the partial keeps matched values as one numpy
+        # array (no boxing); t-digest sketching is the round-2 upgrade
         v = self._metric_values(node, masks)
         return {
             "t": "percentiles",
-            "values": v.tolist(),
+            "values": v,
             "percents": node.params.get(
                 "percents", [1, 5, 25, 50, 75, 95, 99]
             ),
@@ -266,10 +275,8 @@ class AggCollector:
         f = node.params.get("field")
         if f is None:
             raise AggParseError("terms agg requires a field")
-        size = int(node.params.get("size", 10))
-        shard_size = int(
-            node.params.get("shard_size", max(int(size * 1.5) + 10, size))
-        )
+        size = _int_param(node, "size", 10)
+        shard_size = _int_param(node, "shard_size", max(int(size * 1.5) + 10, size))
         mf = self.reader.mappings.get(f)
         if mf is not None and mf.type == TEXT:
             raise AggParseError(
@@ -299,8 +306,11 @@ class AggCollector:
                         key = int(key)
                     counts[key] = counts.get(key, 0) + cnt
         total = sum(counts.values())
-        order = node.params.get("order", {"_count": "desc"})
+        order = _norm_order(node.params.get("order", {"_count": "desc"}))
         top = _order_buckets(counts, order)[:shard_size]
+        # this shard's contribution to doc_count_error_upper_bound: the
+        # last kept bucket's count if we truncated, else 0 (InternalTerms)
+        shard_error = top[-1][1] if len(counts) > shard_size and top else 0
         buckets = {}
         for key, cnt in top:
             subs = {}
@@ -317,6 +327,7 @@ class AggCollector:
             "sum_docs": total,
             "size": size,
             "order": order,
+            "shard_error": shard_error,
         }
 
     def _term_bucket_mask(self, si, f, key, mask, is_keyword) -> np.ndarray:
@@ -344,10 +355,10 @@ class AggCollector:
 
     def _collect_histogram(self, node, masks):
         f = _req(node, "field")
-        interval = float(_req(node, "interval"))
+        interval = _float_param(_req(node, "interval"), node, "interval")
         if interval <= 0:
             raise AggParseError("interval must be > 0")
-        offset = float(node.params.get("offset", 0))
+        offset = _float_param(node.params.get("offset", 0), node, "offset")
         counts: Dict[float, int] = {}
         per_seg_keys = []
         for si, mask in enumerate(masks):
@@ -545,10 +556,9 @@ def _reduce_node(node: AggNode, parts: List[dict]) -> dict:
             "sum": s,
         }
     if t == "cardinality":
-        uniq: set = set()
-        for p in parts:
-            uniq.update(p["values"])
-        return {"value": len(uniq)}
+        arrays = [np.asarray(p["values"]) for p in parts if len(p["values"])]
+        n = len(np.unique(np.concatenate(arrays))) if arrays else 0
+        return {"value": n}
     if t == "percentiles":
         vals = np.concatenate([np.asarray(p["values"]) for p in parts]) if parts else np.zeros(0)
         percents = parts[0]["percents"] if parts else [1, 5, 25, 50, 75, 95, 99]
@@ -561,9 +571,11 @@ def _reduce_node(node: AggNode, parts: List[dict]) -> dict:
     if t == "terms":
         merged: Dict[Any, dict] = {}
         total = 0
-        size = int(node.params.get("size", 10))
+        size = _int_param(node, "size", 10)
+        error_bound = 0
         for p in parts:
             total += p["sum_docs"]
+            error_bound += p.get("shard_error", 0)
             for bk, b in p["buckets"].items():
                 cur = merged.get(bk)
                 if cur is None:
@@ -575,7 +587,7 @@ def _reduce_node(node: AggNode, parts: List[dict]) -> dict:
                 else:
                     cur["doc_count"] += b["doc_count"]
                     cur["subs"].append(b["subs"])
-        order = node.params.get("order", {"_count": "desc"})
+        order = _norm_order(node.params.get("order", {"_count": "desc"}))
         counts = {b["key"]: b["doc_count"] for b in merged.values()}
         ordered = _order_buckets(counts, order)[:size]
         buckets = []
@@ -590,7 +602,7 @@ def _reduce_node(node: AggNode, parts: List[dict]) -> dict:
             entry.update(_reduce_subs(node, b["subs"]))
             buckets.append(entry)
         return {
-            "doc_count_error_upper_bound": 0,
+            "doc_count_error_upper_bound": error_bound,
             "sum_other_doc_count": max(total - top_total, 0),
             "buckets": buckets,
         }
@@ -733,6 +745,37 @@ def _req(node: AggNode, name: str):
     if v is None:
         raise AggParseError(f"[{node.type}] agg [{node.name}] requires [{name}]")
     return v
+
+
+def _int_param(node: AggNode, name: str, default: int) -> int:
+    try:
+        return int(node.params.get(name, default))
+    except (TypeError, ValueError):
+        raise AggParseError(
+            f"[{node.type}] agg [{node.name}]: [{name}] must be an integer"
+        )
+
+
+def _float_param(value, node: AggNode, name: str) -> float:
+    try:
+        return float(value)
+    except (TypeError, ValueError):
+        raise AggParseError(
+            f"[{node.type}] agg [{node.name}]: [{name}] must be numeric"
+        )
+
+
+def _norm_order(order) -> dict:
+    """ES accepts both {"_count": "desc"} and [{"_count": "desc"}, ...];
+    multi-criteria lists use the first criterion (tie-breaks beyond it
+    are fixed: key asc)."""
+    if isinstance(order, list):
+        if not order or not isinstance(order[0], dict):
+            raise AggParseError("order list must contain objects")
+        return order[0]
+    if not isinstance(order, dict):
+        raise AggParseError("order must be an object or list of objects")
+    return order
 
 
 def _sort_key(k: Any):

@@ -224,26 +224,6 @@ class NumpyExecutor:
             [m for m, _ in per_segment],
         )
 
-    def match_masks(
-        self,
-        query: Optional[Query],
-        knn: Optional[List[KnnSection]] = None,
-        min_score: Optional[float] = None,
-    ) -> List[np.ndarray]:
-        """Per-segment dense match masks (query+live+min_score applied) —
-        the aggregation phase's document set (Aggregator's collect scope)."""
-        knn_sets = [self._knn_topk_global(sec) for sec in (knn or [])]
-        masks = []
-        for si, seg in enumerate(self.reader.segments):
-            mask, scores = self._execute_root(query, knn_sets, si, seg)
-            live = self.reader.live_docs[si]
-            if live is not None:
-                mask = mask & live
-            if min_score is not None:
-                mask = mask & (scores >= min_score)
-            masks.append(mask)
-        return masks
-
     def _execute_root(
         self,
         query: Optional[Query],

@@ -1,0 +1,171 @@
+"""Block-max WAND exactness + Pallas int8 kNN kernel tests."""
+
+import numpy as np
+import pytest
+
+from elasticsearch_tpu.analysis import AnalysisRegistry
+from elasticsearch_tpu.index.mapping import DocumentParser, Mappings
+from elasticsearch_tpu.index.segment import SegmentBuilder
+from elasticsearch_tpu.models import bm25
+from elasticsearch_tpu.ops.pallas_knn import QuantizedVectors, quantize_int8
+from elasticsearch_tpu.ops.scoring import make_batched_bm25_scorer, next_bucket
+from elasticsearch_tpu.ops.wand import BlockMaxIndex, BlockMaxScorer
+
+
+def build_segment(n_docs=3000, vocab=300, seed=11):
+    """Zipf corpus big enough that frequent terms go doc-block aligned."""
+    rng = np.random.default_rng(seed)
+    probs = 1.0 / np.arange(1, vocab + 1)
+    probs /= probs.sum()
+    words = np.array([f"w{i}" for i in range(vocab)])
+    mappings = Mappings({"properties": {"body": {"type": "text"}}})
+    analysis = AnalysisRegistry()
+    parser = DocumentParser(mappings, analysis)
+    builder = SegmentBuilder(mappings)
+    for i in range(n_docs):
+        n = int(rng.integers(5, 25))
+        text = " ".join(words[rng.choice(vocab, size=n, p=probs)])
+        builder.add(parser.parse(str(i), {"body": text}))
+    return builder.build()
+
+
+@pytest.fixture(scope="module")
+def seg():
+    return build_segment()
+
+
+def dense_reference(seg, term_lists, k):
+    pf = seg.postings["body"]
+    st = pf.stats
+    avgdl = bm25.avg_field_length(st.sum_total_term_freq, st.doc_count or 1)
+    cache = bm25.norm_inverse_cache(avgdl)
+    inv_norm = cache[pf.norms.astype(np.int64)].astype(np.float32)
+    weights = {
+        t: float(bm25.idf(st.doc_count, int(pf.term_df[i])))
+        for i, t in enumerate(pf.terms)
+    }
+    scorer = make_batched_bm25_scorer(pf.doc_ids, pf.tfs, inv_norm, seg.num_docs, k)
+    B = len(term_lists)
+    t_max = 1
+    plans = []
+    for terms in term_lists:
+        idxs, ws = [], []
+        for t in terms:
+            tid = pf.term_id(t)
+            if tid < 0:
+                continue
+            s0 = int(pf.term_tile_start[tid])
+            c = int(pf.term_tile_count[tid])
+            idxs.extend(range(s0, s0 + c))
+            ws.extend([weights[t]] * c)
+        plans.append((idxs, ws))
+        t_max = max(t_max, len(idxs))
+    T = next_bucket(t_max)
+    ti = np.zeros((B, T), np.int32)
+    tw = np.zeros((B, T), np.float32)
+    tv = np.zeros((B, T), bool)
+    for bi, (idxs, ws) in enumerate(plans):
+        ti[bi, : len(idxs)] = idxs
+        tw[bi, : len(ws)] = ws
+        tv[bi, : len(idxs)] = True
+    out = scorer(ti, tw, tv, np.ones(B, np.int32))
+    return np.asarray(out.scores), np.asarray(out.docs), np.asarray(out.totals)
+
+
+class TestBlockMaxWand:
+    def test_exact_topk_vs_dense(self, seg):
+        k = 10
+        idx = BlockMaxIndex(
+            seg.postings["body"], seg.num_docs, block_size=512,
+            hot_min_postings_per_block=8,
+        )
+        assert any(t.hot for t in idx.terms), "corpus should have hot terms"
+        scorer = BlockMaxScorer(idx, k=k)
+        rng = np.random.default_rng(5)
+        pf = seg.postings["body"]
+        queries = []
+        for _ in range(16):
+            n = int(rng.integers(1, 4))
+            # mix of hot (common, low index) and rare terms
+            terms = [f"w{int(rng.integers(0, 10))}"] + [
+                f"w{int(rng.integers(10, 300))}" for _ in range(n)
+            ]
+            queries.append([t for t in terms if pf.term_id(t) >= 0])
+        s, d, tot, stats = scorer.search_batch(queries)
+        rs, rd, rtot = dense_reference(seg, queries, k)
+        for bi in range(len(queries)):
+            n_hits = int((rs[bi] > -np.inf).sum())
+            nn = min(n_hits, k)
+            np.testing.assert_allclose(
+                s[bi][:nn], rs[bi][:nn], rtol=1e-5,
+                err_msg=f"query {bi} scores",
+            )
+            np.testing.assert_array_equal(d[bi][:nn], rd[bi][:nn])
+            # pruned totals are a lower bound (track_total_hits: gte)
+            assert tot[bi] <= rtot[bi]
+
+    def test_pruning_happens(self, seg):
+        idx = BlockMaxIndex(
+            seg.postings["body"], seg.num_docs, block_size=512,
+            hot_min_postings_per_block=8,
+        )
+        scorer = BlockMaxScorer(idx, k=5)
+        # rare term + very common term: common term's tiles should prune
+        queries = [["w200", "w0"]] * 4
+        s, d, tot, stats = scorer.search_batch(queries)
+        assert stats["hot_tiles_total"] > 0
+        assert stats["phase_b_tiles"] < stats["hot_tiles_total"]
+
+    def test_pure_rare_query_no_phase_b(self, seg):
+        idx = BlockMaxIndex(
+            seg.postings["body"], seg.num_docs, block_size=512,
+            hot_min_postings_per_block=8,
+        )
+        scorer = BlockMaxScorer(idx, k=5)
+        s, d, tot, stats = scorer.search_batch([["w250"], ["w299"]])
+        assert stats["hot_tiles_total"] == 0
+
+
+class TestInt8Quantization:
+    def test_quantize_roundtrip_error(self):
+        rng = np.random.default_rng(0)
+        v = rng.standard_normal((100, 64)).astype(np.float32)
+        q, scales = quantize_int8(v)
+        assert q.shape == (100, 128)  # padded to lane
+        deq = q[:, :64].astype(np.float32) * scales[:, None]
+        err = np.abs(deq - v).max()
+        assert err <= scales.max() * 0.5 + 1e-6
+
+    def test_int8_search_recall_vs_exact(self):
+        rng = np.random.default_rng(1)
+        n, d, k = 2000, 96, 10
+        vectors = rng.standard_normal((n, d)).astype(np.float32)
+        qv = QuantizedVectors(vectors, similarity="cosine")
+        queries = rng.standard_normal((4, d)).astype(np.float32)
+        s, docs = qv.search(queries, k=k)
+        docs = np.asarray(docs)
+        # exact reference
+        vn = vectors / np.linalg.norm(vectors, axis=1, keepdims=True)
+        qn = queries / np.linalg.norm(queries, axis=1, keepdims=True)
+        exact = (1 + qn @ vn.T) / 2
+        for bi in range(4):
+            top_exact = set(np.argsort(-exact[bi])[:k].tolist())
+            recall = len(top_exact & set(docs[bi].tolist())) / k
+            assert recall >= 0.8, f"query {bi} recall {recall}"
+
+    def test_dot_product_and_mip(self):
+        rng = np.random.default_rng(2)
+        vectors = rng.standard_normal((600, 32)).astype(np.float32)
+        for sim in ("dot_product", "max_inner_product"):
+            qv = QuantizedVectors(vectors, similarity=sim)
+            s, docs = qv.search(rng.standard_normal((2, 32)), k=5)
+            s = np.asarray(s)
+            assert np.isfinite(s).all()
+            assert (np.diff(s, axis=1) <= 1e-6).all()
+
+    def test_padding_docs_excluded(self):
+        rng = np.random.default_rng(3)
+        vectors = rng.standard_normal((100, 16)).astype(np.float32)  # < DOC_BLOCK
+        qv = QuantizedVectors(vectors, similarity="cosine")
+        s, docs = qv.search(rng.standard_normal((1, 16)), k=50)
+        assert (np.asarray(docs) < 100).all()
