@@ -19,16 +19,13 @@ from ..analysis import AnalysisRegistry
 from ..index.engine import OpResult, ShardEngine
 from ..index.mapping import Mappings
 from ..search import dsl
-from ..search.coordinator import merge_top_docs
+from ..search.coordinator import merge_sorted, merge_top_docs
 from ..search.executor import NumpyExecutor, ShardReader
 from ..utils.murmur3 import shard_id as route_shard_id
 
-DEFAULT_SETTINGS = {
-    "number_of_shards": 1,
-    "number_of_replicas": 1,
-    "refresh_interval": "1s",
-    "search.backend": "numpy",  # numpy | jax (the north-star selector)
-}
+from ..common.settings import INDEX_SETTINGS, SettingsError, validate_index_settings
+
+DEFAULT_SETTINGS = {k: s.default for k, s in INDEX_SETTINGS.items()}
 
 
 class IndexService:
@@ -43,7 +40,11 @@ class IndexService:
         self.name = name
         self.settings = dict(DEFAULT_SETTINGS)
         if settings:
-            self.settings.update(_flatten_settings(settings))
+            flat = _flatten_settings(settings)
+            flat.pop("uuid", None)  # round-trip fields from metadata()
+            flat.pop("creation_date", None)
+            flat.pop("provided_name", None)
+            self.settings.update(validate_index_settings(flat, creating=True))
         self.creation_date = int(time.time() * 1000)
         self.uuid = _index_uuid(name, self.creation_date)
         self.mappings = Mappings(mappings_json or {})
@@ -62,6 +63,12 @@ class IndexService:
             )
         # executor cache: shard id → (change_generation, executor)
         self._executors: Dict[int, tuple] = {}
+        # SearchStats (per-index totals; query_current omitted)
+        self.search_stats = {
+            "query_total": 0,
+            "query_time_in_millis": 0,
+            "fetch_total": 0,
+        }
 
     # ---- routing ----
 
@@ -156,10 +163,20 @@ class IndexService:
 
     def search(self, body: Optional[dict] = None) -> dict:
         body = body or {}
+        if "retriever" in body:
+            return self._retriever_search(body)
         t0 = time.perf_counter()
         size = int(body.get("size", 10))
         from_ = int(body.get("from", 0))
         min_score = body.get("min_score")
+        source_spec = body.get("_source", True)
+        sort_specs = None
+        if "sort" in body:
+            from ..search.executor import parse_sort
+
+            sort_specs = parse_sort(body["sort"])
+            if [s["field"] for s in sort_specs] == ["_score"]:
+                sort_specs = None  # default relevance order
         query = dsl.parse_query(body["query"]) if "query" in body else None
         knn_body = body.get("knn")
         knn = None
@@ -178,14 +195,31 @@ class IndexService:
         executors = []  # pinned per-request so a concurrent refresh can't
         # swap the reader between scoring and source fetch
         agg_partials = []
+        shard_sort_values: List[List[List]] = []
+        profile = bool(body.get("profile"))
+        shard_profiles = []
         for shard in self.shards:
+            ts = time.perf_counter_ns()
             ex = self._executor(shard)
             executors.append(ex)
             # each shard returns the full global page's worth of hits;
             # the same execution's masks feed the agg phase (no re-run)
-            td, masks = ex.execute(
-                query, size=from_ + size, from_=0, knn=knn, min_score=min_score
-            )
+            if sort_specs is not None:
+                oracle = ex if isinstance(ex, NumpyExecutor) else ex._oracle
+                td, masks, svals = oracle.execute_sorted(
+                    query,
+                    sort_specs,
+                    size=from_ + size,
+                    from_=0,
+                    knn=knn,
+                    min_score=min_score,
+                )
+                shard_sort_values.append(svals)
+            else:
+                td, masks = ex.execute(
+                    query, size=from_ + size, from_=0, knn=knn, min_score=min_score
+                )
+                shard_sort_values.append([])
             shard_results.append(td)
             if agg_nodes is not None:
                 from ..search.aggs import AggCollector
@@ -194,20 +228,73 @@ class IndexService:
                 agg_partials.append(
                     AggCollector(oracle).collect(agg_nodes, masks)
                 )
-        total, max_score, hits = merge_top_docs(shard_results, from_, size)
+            if profile:
+                # per-shard query-phase breakdown ("profile": true —
+                # Profilers/QueryProfiler response shape, device+host time)
+                elapsed = time.perf_counter_ns() - ts
+                shard_profiles.append(
+                    {
+                        "id": f"[{self.uuid}][{self.name}][{shard.shard_id}]",
+                        "searches": [
+                            {
+                                "query": [
+                                    {
+                                        "type": type(query).__name__
+                                        if query is not None
+                                        else "MatchAllQuery",
+                                        "description": json_dumps_safe(
+                                            body.get("query", {"match_all": {}})
+                                        ),
+                                        "time_in_nanos": elapsed,
+                                        "breakdown": {
+                                            "score": elapsed,
+                                            "backend": str(
+                                                self.settings.get("search.backend")
+                                            ),
+                                        },
+                                    }
+                                ],
+                                "rewrite_time": 0,
+                                "collector": [
+                                    {
+                                        "name": "SimpleTopDocsCollector",
+                                        "reason": "search_top_hits",
+                                        "time_in_nanos": elapsed,
+                                    }
+                                ],
+                            }
+                        ],
+                        "aggregations": [],
+                    }
+                )
+        if sort_specs is not None:
+            total, max_score, hits, hit_sorts = merge_sorted(
+                shard_results, shard_sort_values, sort_specs, from_, size
+            )
+        else:
+            total, max_score, hits = merge_top_docs(shard_results, from_, size)
+            hit_sorts = None
+        from ..search.executor import filter_source
+
         out_hits = []
-        for h in hits:
+        for i, h in enumerate(hits):
             reader = executors[h.shard].reader
             src = reader.segments[h.segment].sources[h.local_doc]
-            out_hits.append(
-                {
-                    "_index": self.name,
-                    "_id": h.doc_id,
-                    "_score": h.score,
-                    "_source": src,
-                }
-            )
+            entry = {
+                "_index": self.name,
+                "_id": h.doc_id,
+                "_score": None if sort_specs is not None else h.score,
+            }
+            filtered = filter_source(src, source_spec)
+            if filtered is not None and source_spec is not False:
+                entry["_source"] = filtered
+            if hit_sorts is not None:
+                entry["sort"] = hit_sorts[i]
+            out_hits.append(entry)
         took = int((time.perf_counter() - t0) * 1000)
+        self.search_stats["query_total"] += 1
+        self.search_stats["query_time_in_millis"] += took
+        self.search_stats["fetch_total"] += 1
         resp = {
             "took": took,
             "timed_out": False,
@@ -227,7 +314,90 @@ class IndexService:
             from ..search.aggs import reduce_aggs
 
             resp["aggregations"] = reduce_aggs(agg_nodes, agg_partials)
+        if profile:
+            resp["profile"] = {"shards": shard_profiles}
         return resp
+
+    def _retriever_search(self, body: dict) -> dict:
+        """`retriever` tree: standard / knn / rrf (x-pack rank-rrf:
+        RRFRetrieverBuilder — score = Σ 1/(rank_constant + rank) over
+        child retrievers, exact-doc dedup, rank_window_size candidates)."""
+        t0 = time.perf_counter()
+        size = int(body.get("size", 10))
+        from_ = int(body.get("from", 0))
+        source_spec = body.get("_source", True)
+
+        def run(ret: dict, window: int) -> List[tuple]:
+            """ranked [(doc_id, score)] for one retriever node."""
+            if not isinstance(ret, dict) or len(ret) != 1:
+                raise dsl.QueryParseError("[retriever] malformed")
+            kind, params = next(iter(ret.items()))
+            if kind == "standard":
+                sub = {"size": window, "_source": False}
+                if "query" in params:
+                    sub["query"] = params["query"]
+                if "filter" in params:
+                    sub["query"] = {
+                        "bool": {
+                            "must": [sub.get("query", {"match_all": {}})],
+                            "filter": [params["filter"]],
+                        }
+                    }
+                resp = self.search(sub)
+                return [
+                    (h["_id"], h["_score"]) for h in resp["hits"]["hits"]
+                ]
+            if kind == "knn":
+                resp = self.search(
+                    {"knn": params, "size": window, "_source": False}
+                )
+                return [
+                    (h["_id"], h["_score"]) for h in resp["hits"]["hits"]
+                ]
+            if kind == "rrf":
+                rank_constant = int(params.get("rank_constant", 60))
+                window2 = int(params.get("rank_window_size", max(window, size)))
+                fused: Dict[str, float] = {}
+                for child in params.get("retrievers", []):
+                    ranked = run(child, window2)
+                    for rank, (doc_id, _) in enumerate(ranked, 1):
+                        fused[doc_id] = fused.get(doc_id, 0.0) + 1.0 / (
+                            rank_constant + rank
+                        )
+                ordered = sorted(fused.items(), key=lambda kv: (-kv[1], kv[0]))
+                return ordered[:window2]
+            raise dsl.QueryParseError(f"unknown retriever [{kind}]")
+
+        window = max(from_ + size, 10)
+        ranked = run(body["retriever"], window)
+        page = ranked[from_ : from_ + size]
+        from ..search.executor import filter_source
+
+        out_hits = []
+        for doc_id, score in page:
+            doc = self.get_doc(doc_id)
+            entry = {
+                "_index": self.name,
+                "_id": doc_id,
+                "_score": float(score),
+            }
+            if doc is not None and source_spec is not False:
+                filtered = filter_source(doc["_source"], source_spec)
+                if filtered is not None:
+                    entry["_source"] = filtered
+            out_hits.append(entry)
+        took = int((time.perf_counter() - t0) * 1000)
+        n = len(self.shards)
+        return {
+            "took": took,
+            "timed_out": False,
+            "_shards": {"total": n, "successful": n, "skipped": 0, "failed": 0},
+            "hits": {
+                "total": {"value": len(ranked), "relation": "eq"},
+                "max_score": max((s for _, s in page), default=None),
+                "hits": out_hits,
+            },
+        }
 
     def count(self, body: Optional[dict] = None) -> dict:
         body = body or {}
@@ -262,18 +432,30 @@ class IndexService:
                         store_bytes += os.path.getsize(os.path.join(root, f))
                     except OSError:
                         pass
-        return {
-            "uuid": self.uuid,
-            "primaries": {
-                "docs": {"count": self.num_docs, "deleted": 0},
-                "store": {"size_in_bytes": store_bytes},
-                "segments": {"count": sum(len(s.segments) for s in self.shards)},
-            },
-            "total": {
-                "docs": {"count": self.num_docs, "deleted": 0},
-                "store": {"size_in_bytes": store_bytes},
-            },
+        ops = {
+            k: sum(s.op_stats[k] for s in self.shards)
+            for k in self.shards[0].op_stats
         }
+        deleted = sum(
+            int((~l).sum()) if l is not None else 0
+            for s in self.shards
+            for l in s.live_docs
+        )
+        body = {
+            "docs": {"count": self.num_docs, "deleted": deleted},
+            "store": {"size_in_bytes": store_bytes},
+            "indexing": {
+                "index_total": ops["index_total"],
+                "index_time_in_millis": ops["index_time_in_nanos"] // 1_000_000,
+                "delete_total": ops["delete_total"],
+            },
+            "search": dict(self.search_stats),
+            "refresh": {"total": ops["refresh_total"]},
+            "flush": {"total": ops["flush_total"]},
+            "merges": {"total": ops["merge_total"]},
+            "segments": {"count": sum(len(s.segments) for s in self.shards)},
+        }
+        return {"uuid": self.uuid, "primaries": body, "total": body}
 
     def metadata(self) -> dict:
         return {
@@ -287,6 +469,15 @@ class IndexService:
             },
             "mappings": self.mappings.to_json(),
         }
+
+
+def json_dumps_safe(obj) -> str:
+    import json
+
+    try:
+        return json.dumps(obj)
+    except (TypeError, ValueError):
+        return str(obj)
 
 
 def _flatten_settings(settings: dict) -> dict:

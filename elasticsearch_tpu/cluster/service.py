@@ -18,6 +18,7 @@ import time
 from typing import Dict, Optional
 
 from ..analysis import AnalysisRegistry
+from ..common.settings import ClusterSettingsStore, SettingsError, validate_index_settings
 from ..index.mapping import MappingParseError
 from .indices import IndexService, _flatten_settings
 
@@ -47,6 +48,7 @@ class ClusterService:
         self.data_path = data_path
         self.version = 0
         self.indices: Dict[str, IndexService] = {}
+        self.cluster_settings = ClusterSettingsStore()
         self._lock = threading.RLock()
         self._started_at = time.time()
         if data_path is not None:
@@ -134,6 +136,8 @@ class ClusterService:
                     mappings_json=body.get("mappings"),
                     base_path=self._index_path(name),
                 )
+            except SettingsError as e:
+                raise ClusterError(400, str(e), "illegal_argument_exception")
             except (MappingParseError, ValueError) as e:
                 raise ClusterError(400, str(e), "mapper_parsing_exception")
             self.indices[name] = idx
@@ -168,6 +172,8 @@ class ClusterService:
         with self._lock:
             idx = self.indices.get(name)
             if idx is None:
+                if not self.cluster_settings.get("action.auto_create_index"):
+                    raise IndexNotFoundError(name)
                 self.create_index(name)
                 idx = self.indices[name]
             return idx
@@ -188,19 +194,21 @@ class ClusterService:
         with self._lock:
             idx = self.get_index(name)
             flat = _flatten_settings(body)
-            static = {"number_of_shards"}
-            for k in flat:
-                if k in static:
-                    raise ClusterError(
-                        400,
-                        f"final {name} setting [index.{k}], not updateable",
-                        "illegal_argument_exception",
-                    )
-            idx.settings.update(flat)
+            try:
+                validated = validate_index_settings(flat, creating=False)
+            except SettingsError as e:
+                raise ClusterError(400, str(e), "illegal_argument_exception")
+            idx.settings.update(validated)
             self.version += 1
             self._persist()
             idx._persist_meta()
             return {"acknowledged": True}
+
+    def update_cluster_settings(self, body: dict) -> dict:
+        try:
+            return self.cluster_settings.update(body or {})
+        except SettingsError as e:
+            raise ClusterError(400, str(e), "illegal_argument_exception")
 
     # ------------------------------------------------------------------
     # cluster-level APIs

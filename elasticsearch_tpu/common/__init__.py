@@ -1,0 +1,4 @@
+"""Shared infrastructure (settings registry, stats).
+
+Reference analog: org.elasticsearch.common.** leaf utilities.
+"""

@@ -1,0 +1,231 @@
+"""Typed settings registry with ES scope semantics.
+
+Reference analog: org.elasticsearch.common.settings — `Setting<T>` with
+`Property.{Dynamic,NodeScope,IndexScope,Final}` registered in
+`ClusterSettings` / `IndexScopedSettings`; dynamic updates dispatch to
+registered consumers (`addSettingsUpdateConsumer`), final settings
+reject updates, unknown settings are rejected on write (SURVEY.md §5
+"Config / flag system"). The north-star selector
+``index.search.backend`` is exactly an index-scoped static setting here.
+"""
+
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional
+
+NODE_SCOPE = "node"
+CLUSTER_SCOPE = "cluster"
+INDEX_SCOPE = "index"
+
+
+class SettingsError(ValueError):
+    pass
+
+
+def _parse_bool(v) -> bool:
+    if isinstance(v, bool):
+        return v
+    s = str(v).lower()
+    if s in ("true", "1"):
+        return True
+    if s in ("false", "0"):
+        return False
+    raise SettingsError(f"cannot parse boolean [{v}]")
+
+
+def _parse_time(v) -> str:
+    """TimeValue strings kept as-is but validated (e.g. '1s', '500ms')."""
+    s = str(v)
+    if s in ("-1",):
+        return s
+    for suffix in ("nanos", "micros", "ms", "s", "m", "h", "d"):
+        if s.endswith(suffix):
+            try:
+                float(s[: -len(suffix)])
+                return s
+            except ValueError:
+                break
+    raise SettingsError(f"failed to parse setting value [{v}] as a time value")
+
+
+@dataclass
+class Setting:
+    key: str
+    default: Any
+    scope: str = CLUSTER_SCOPE
+    dynamic: bool = True
+    final: bool = False
+    parser: Callable[[Any], Any] = str
+    validator: Optional[Callable[[Any], None]] = None
+
+    def parse(self, value: Any) -> Any:
+        try:
+            v = self.parser(value)
+        except SettingsError:
+            raise
+        except (TypeError, ValueError) as e:
+            raise SettingsError(
+                f"failed to parse value [{value}] for setting [{self.key}]: {e}"
+            )
+        if self.validator is not None:
+            self.validator(v)
+        return v
+
+
+def _positive(name):
+    def check(v):
+        if v < 1:
+            raise SettingsError(f"[{name}] must be >= 1")
+
+    return check
+
+
+def _non_negative(name):
+    def check(v):
+        if v < 0:
+            raise SettingsError(f"[{name}] must be >= 0")
+
+    return check
+
+
+# ---- index-scoped registry (IndexScopedSettings.BUILT_IN_INDEX_SETTINGS) ----
+
+INDEX_SETTINGS: Dict[str, Setting] = {
+    s.key: s
+    for s in [
+        Setting("number_of_shards", 1, INDEX_SCOPE, dynamic=False, final=True,
+                parser=int, validator=_positive("number_of_shards")),
+        Setting("number_of_replicas", 1, INDEX_SCOPE, parser=int,
+                validator=_non_negative("number_of_replicas")),
+        Setting("refresh_interval", "1s", INDEX_SCOPE, parser=_parse_time),
+        Setting("search.backend", "numpy", INDEX_SCOPE, dynamic=False),
+        Setting("max_result_window", 10000, INDEX_SCOPE, parser=int,
+                validator=_positive("max_result_window")),
+        Setting("translog.durability", "request", INDEX_SCOPE),
+        Setting("merge.policy.max_segments", 8, INDEX_SCOPE, parser=int,
+                validator=_positive("merge.policy.max_segments")),
+        Setting("knn.quantization", "none", INDEX_SCOPE),
+        Setting("hidden", False, INDEX_SCOPE, parser=_parse_bool),
+        Setting("codec", "default", INDEX_SCOPE, dynamic=False),
+    ]
+}
+
+# ---- cluster-scoped registry ----
+
+CLUSTER_SETTINGS: Dict[str, Setting] = {
+    s.key: s
+    for s in [
+        Setting("cluster.routing.allocation.enable", "all"),
+        Setting("action.auto_create_index", True, parser=_parse_bool),
+        Setting("search.default_search_timeout", "-1", parser=_parse_time),
+        Setting("search.max_buckets", 65536, parser=int,
+                validator=_positive("search.max_buckets")),
+        Setting("indices.recovery.max_bytes_per_sec", "40mb"),
+    ]
+}
+
+
+def validate_index_settings(flat: Dict[str, Any], creating: bool) -> Dict[str, Any]:
+    """Validates + parses a flat settings dict against the index registry.
+
+    Unknown settings are rejected (like IndexScopedSettings.validate);
+    on update (creating=False) final/static settings are rejected too.
+    """
+    out: Dict[str, Any] = {}
+    for key, value in flat.items():
+        setting = INDEX_SETTINGS.get(key)
+        if setting is None:
+            raise SettingsError(
+                f"unknown setting [index.{key}] please check that any required "
+                "plugins are installed, or check the breaking changes "
+                "documentation for removed settings"
+            )
+        if not creating and (setting.final or not setting.dynamic):
+            raise SettingsError(
+                f"final {INDEX_SCOPE} setting [index.{key}], not updateable"
+            )
+        out[key] = setting.parse(value)
+    return out
+
+
+class ClusterSettingsStore:
+    """Mutable cluster-wide settings: persistent + transient layers with
+    update-consumer dispatch (ClusterSettings.applySettings)."""
+
+    def __init__(self):
+        self.persistent: Dict[str, Any] = {}
+        self.transient: Dict[str, Any] = {}
+        self._consumers: Dict[str, List[Callable[[Any], None]]] = {}
+        self._lock = threading.Lock()
+
+    def get(self, key: str) -> Any:
+        if key in self.transient:
+            return self.transient[key]
+        if key in self.persistent:
+            return self.persistent[key]
+        s = CLUSTER_SETTINGS.get(key)
+        return s.default if s else None
+
+    def add_consumer(self, key: str, fn: Callable[[Any], None]) -> None:
+        self._consumers.setdefault(key, []).append(fn)
+
+    def update(self, body: dict) -> dict:
+        with self._lock:
+            changed: Dict[str, Any] = {}
+            for layer_name in ("persistent", "transient"):
+                layer_body = body.get(layer_name) or {}
+                layer = getattr(self, layer_name)
+                for key, value in _flatten(layer_body).items():
+                    setting = CLUSTER_SETTINGS.get(key)
+                    if setting is None:
+                        raise SettingsError(
+                            f"transient setting [{key}], not recognized"
+                            if layer_name == "transient"
+                            else f"persistent setting [{key}], not recognized"
+                        )
+                    if value is None:
+                        layer.pop(key, None)
+                        changed[key] = self.get(key)
+                    else:
+                        parsed = setting.parse(value)
+                        layer[key] = parsed
+                        changed[key] = parsed
+            for key, value in changed.items():
+                for fn in self._consumers.get(key, []):
+                    fn(value)
+            return {
+                "acknowledged": True,
+                "persistent": _unflatten(self.persistent),
+                "transient": _unflatten(self.transient),
+            }
+
+    def to_json(self) -> dict:
+        return {
+            "persistent": _unflatten(self.persistent),
+            "transient": _unflatten(self.transient),
+        }
+
+
+def _flatten(node: Any, prefix: str = "") -> Dict[str, Any]:
+    out: Dict[str, Any] = {}
+    if isinstance(node, dict):
+        for k, v in node.items():
+            key = f"{prefix}.{k}" if prefix else k
+            if isinstance(v, dict):
+                out.update(_flatten(v, key))
+            else:
+                out[key] = v
+    return out
+
+
+def _unflatten(flat: Dict[str, Any]) -> dict:
+    out: dict = {}
+    for key, v in flat.items():
+        node = out
+        parts = key.split(".")
+        for p in parts[:-1]:
+            node = node.setdefault(p, {})
+        node[parts[-1]] = v
+    return out

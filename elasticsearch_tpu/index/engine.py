@@ -22,6 +22,7 @@ from __future__ import annotations
 import os
 import shutil
 import threading
+import time as _time
 from dataclasses import dataclass
 from typing import Dict, List, Optional, Tuple
 
@@ -106,6 +107,15 @@ class ShardEngine:
         # bumped whenever the searchable state changes (refresh/merge) —
         # lets callers cache readers/executors per generation
         self.change_generation = 0
+        # IndexingStats / RefreshStats / FlushStats / MergeStats counters
+        self.op_stats = {
+            "index_total": 0,
+            "index_time_in_nanos": 0,
+            "delete_total": 0,
+            "refresh_total": 0,
+            "flush_total": 0,
+            "merge_total": 0,
+        }
         self.translog: Optional[Translog] = None
         if path is not None:
             os.makedirs(path, exist_ok=True)
@@ -151,6 +161,7 @@ class ShardEngine:
             # parse up front: mapping errors must reject the op, not poison
             # the next refresh — and refresh reuses the parse (analysis is
             # the write path's hot loop; don't pay it twice)
+            t0 = _time.perf_counter_ns()
             parsed = self.parser.parse(doc_id, source)
             version = (cur.version + 1) if cur is not None else 1
             seq_no = self._next_seq
@@ -168,6 +179,8 @@ class ShardEngine:
                         "version": version,
                     }
                 )
+            self.op_stats["index_total"] += 1
+            self.op_stats["index_time_in_nanos"] += _time.perf_counter_ns() - t0
             return OpResult(
                 doc_id,
                 "updated" if exists else "created",
@@ -206,6 +219,7 @@ class ShardEngine:
                 self.translog.add(
                     {"op": "delete", "id": doc_id, "seq_no": seq_no, "version": version}
                 )
+            self.op_stats["delete_total"] += 1
             return OpResult(doc_id, "deleted", version, seq_no, self.primary_term)
 
     # ------------------------------------------------------------------
@@ -293,6 +307,7 @@ class ShardEngine:
                 changed = True
             if changed:
                 self.change_generation += 1
+                self.op_stats["refresh_total"] += 1
             return changed
 
     # ------------------------------------------------------------------
@@ -304,6 +319,7 @@ class ShardEngine:
         trim (IndexShard.flush → Lucene commit + trimUnreferencedReaders)."""
         with self._lock:
             self.refresh()
+            self.op_stats["flush_total"] += 1
             if self.path is None:
                 return
             self.committed_generation += 1
@@ -389,6 +405,7 @@ class ShardEngine:
             self.seg_names = [f"seg_{self.committed_generation}_m0"]
             self._locations = new_locations
             self.change_generation += 1
+            self.op_stats["merge_total"] += 1
             return True
 
     # ------------------------------------------------------------------

@@ -49,6 +49,8 @@ class RestActions:
         add("GET", "/", self.root)
         add("GET", "/_cluster/health", self.cluster_health)
         add("GET", "/_cluster/state", self.cluster_state)
+        add("GET", "/_cluster/settings", self.get_cluster_settings)
+        add("PUT", "/_cluster/settings", self.put_cluster_settings)
         add("GET", "/_nodes/stats", self.nodes_stats)
         add("GET", "/_stats", self.all_stats)
         add("GET", "/_cat/indices", self.cat_indices)
@@ -121,6 +123,12 @@ class RestActions:
                 }
             },
         }
+
+    def get_cluster_settings(self, body, params, qs):
+        return 200, self.cluster.cluster_settings.to_json()
+
+    def put_cluster_settings(self, body, params, qs):
+        return 200, self.cluster.update_cluster_settings(body or {})
 
     def nodes_stats(self, body, params, qs):
         import resource

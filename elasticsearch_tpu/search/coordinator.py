@@ -26,6 +26,68 @@ class ShardHit:
     doc_id: str
 
 
+class _Rev:
+    """Reverses comparison for desc string columns in merge keys."""
+
+    __slots__ = ("v",)
+
+    def __init__(self, v):
+        self.v = v
+
+    def __lt__(self, other):
+        return other.v < self.v
+
+    def __eq__(self, other):
+        return other.v == self.v
+
+
+def _col_key(value, spec):
+    missing_rank = 1 if spec["missing"] == "_last" else -1
+    if value is None or (isinstance(value, float) and value != value):
+        return (missing_rank, 0)
+    if spec["order"] == "desc":
+        if isinstance(value, (int, float)):
+            return (0, -value)
+        return (0, _Rev(value))
+    return (0, value)
+
+
+def merge_sorted(
+    shard_results: Sequence[TopDocs],
+    shard_sort_values: Sequence[Sequence[list]],
+    sort_specs: Sequence[dict],
+    from_: int,
+    size: int,
+) -> tuple:
+    """Coordinator merge for field-sorted results: compare raw sort
+    values per column with direction/missing applied (TopFieldDocs merge
+    in SearchPhaseController). Returns (total, None, hits, hit_sorts)."""
+    total = sum(td.total for td in shard_results)
+    entries = []
+    for si, td in enumerate(shard_results):
+        svals = shard_sort_values[si]
+        for i, h in enumerate(td.hits):
+            vals = svals[i] if i < len(svals) else []
+            key = tuple(
+                _col_key(v, spec) for v, spec in zip(vals, sort_specs)
+            )
+            entries.append((key, si, h.segment, h.local_doc, h, vals))
+    entries.sort(key=lambda e: e[:4])
+    page = entries[from_ : from_ + size]
+    hits = [
+        ShardHit(
+            score=h.score,
+            shard=si,
+            segment=h.segment,
+            local_doc=h.local_doc,
+            doc_id=h.doc_id,
+        )
+        for _, si, _, _, h, _ in page
+    ]
+    hit_sorts = [vals for *_, vals in page]
+    return total, None, hits, hit_sorts
+
+
 def merge_top_docs(
     shard_results: Sequence[TopDocs], from_: int = 0, size: int = 10
 ) -> tuple:

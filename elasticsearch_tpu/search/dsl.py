@@ -104,9 +104,84 @@ class ConstantScoreQuery(Query):
 
 
 @dataclass
+class ScoreFunction:
+    """One function_score entry: optional filter + weight and/or
+    field_value_factor (FunctionScoreQueryBuilder.FilterFunctionBuilder)."""
+
+    filter: Optional[Query] = None
+    weight: Optional[float] = None
+    field_value_factor: Optional[dict] = None  # {field, factor, modifier, missing}
+    random_score: Optional[dict] = None  # {seed, field}
+
+
+@dataclass
 class FunctionScoreQuery(Query):
     query: Query = None  # type: ignore[assignment]
-    # round 1: weight-only function_score
+    functions: List[ScoreFunction] = dc_field(default_factory=list)
+    score_mode: str = "multiply"  # multiply | sum | avg | max | min | first
+    boost_mode: str = "multiply"  # multiply | sum | replace | avg | max | min
+    max_boost: Optional[float] = None
+    min_score: Optional[float] = None
+
+
+@dataclass
+class IdsQuery(Query):
+    values: List[str] = dc_field(default_factory=list)
+
+
+@dataclass
+class PrefixQuery(Query):
+    field: str = ""
+    value: str = ""
+    case_insensitive: bool = False
+
+
+@dataclass
+class WildcardQuery(Query):
+    field: str = ""
+    value: str = ""
+    case_insensitive: bool = False
+
+
+@dataclass
+class RegexpQuery(Query):
+    field: str = ""
+    value: str = ""
+    case_insensitive: bool = False
+
+
+@dataclass
+class FuzzyQuery(Query):
+    field: str = ""
+    value: str = ""
+    fuzziness: str = "AUTO"
+    prefix_length: int = 0
+    max_expansions: int = 50
+
+
+@dataclass
+class DisMaxQuery(Query):
+    queries: List[Query] = dc_field(default_factory=list)
+    tie_breaker: float = 0.0
+
+
+@dataclass
+class BoostingQuery(Query):
+    positive: Query = None  # type: ignore[assignment]
+    negative: Query = None  # type: ignore[assignment]
+    negative_boost: float = 0.0
+
+
+@dataclass
+class QueryStringQuery(Query):
+    """query_string / simple_query_string lite: terms, field:term,
+    quoted phrases, AND/OR/NOT (query_string) — no grouping parens."""
+
+    query: str = ""
+    default_field: Optional[str] = None
+    fields: List[str] = dc_field(default_factory=list)
+    default_operator: str = "or"
+    simple: bool = False
 
 
 @dataclass
@@ -290,6 +365,129 @@ def parse_knn(params: dict) -> KnnSection:
     )
 
 
+def _parse_ids(params):
+    values = params.get("values")
+    if not isinstance(values, list):
+        raise QueryParseError("[ids] query requires [values] array")
+    return IdsQuery(values=[str(v) for v in values], boost=float(params.get("boost", 1.0)))
+
+
+def _parse_simple_pattern(cls, qname):
+    def parse(params):
+        fname, cfg = _field_params(params, qname)
+        if isinstance(cfg, dict):
+            value = cfg.get("value", cfg.get(qname, ""))
+            if qname == "wildcard" and value == "" and "wildcard" in cfg:
+                value = cfg["wildcard"]
+            return cls(
+                field=fname,
+                value=str(value),
+                case_insensitive=bool(cfg.get("case_insensitive", False)),
+                boost=float(cfg.get("boost", 1.0)),
+            )
+        return cls(field=fname, value=str(cfg))
+
+    return parse
+
+
+def _parse_fuzzy(params):
+    fname, cfg = _field_params(params, "fuzzy")
+    if isinstance(cfg, dict):
+        return FuzzyQuery(
+            field=fname,
+            value=str(cfg.get("value", "")),
+            fuzziness=str(cfg.get("fuzziness", "AUTO")),
+            prefix_length=int(cfg.get("prefix_length", 0)),
+            max_expansions=int(cfg.get("max_expansions", 50)),
+            boost=float(cfg.get("boost", 1.0)),
+        )
+    return FuzzyQuery(field=fname, value=str(cfg))
+
+
+def _parse_dis_max(params):
+    qs = params.get("queries")
+    if not isinstance(qs, list) or not qs:
+        raise QueryParseError("[dis_max] query requires [queries] array")
+    return DisMaxQuery(
+        queries=[parse_query(q) for q in qs],
+        tie_breaker=float(params.get("tie_breaker", 0.0)),
+        boost=float(params.get("boost", 1.0)),
+    )
+
+
+def _parse_boosting(params):
+    if "positive" not in params or "negative" not in params:
+        raise QueryParseError("[boosting] requires [positive] and [negative]")
+    return BoostingQuery(
+        positive=parse_query(params["positive"]),
+        negative=parse_query(params["negative"]),
+        negative_boost=float(params.get("negative_boost", 0.0)),
+        boost=float(params.get("boost", 1.0)),
+    )
+
+
+def _parse_function_score(params):
+    inner = (
+        parse_query(params["query"]) if "query" in params else MatchAllQuery()
+    )
+    functions: List[ScoreFunction] = []
+    raw_fns = params.get("functions")
+    if raw_fns is None:
+        raw_fns = []
+        # single-function shorthand at the top level
+        single = {
+            k: params[k]
+            for k in ("weight", "field_value_factor", "random_score")
+            if k in params
+        }
+        if single:
+            raw_fns = [single]
+    for fn in raw_fns:
+        if not isinstance(fn, dict):
+            raise QueryParseError("[function_score] malformed function")
+        known = {"filter", "weight", "field_value_factor", "random_score"}
+        unknown = set(fn) - known
+        if unknown:
+            raise QueryParseError(
+                f"[function_score] unsupported function [{sorted(unknown)[0]}]"
+            )
+        functions.append(
+            ScoreFunction(
+                filter=parse_query(fn["filter"]) if "filter" in fn else None,
+                weight=float(fn["weight"]) if "weight" in fn else None,
+                field_value_factor=fn.get("field_value_factor"),
+                random_score=fn.get("random_score"),
+            )
+        )
+    return FunctionScoreQuery(
+        query=inner,
+        functions=functions,
+        score_mode=str(params.get("score_mode", "multiply")),
+        boost_mode=str(params.get("boost_mode", "multiply")),
+        max_boost=float(params["max_boost"]) if "max_boost" in params else None,
+        min_score=params.get("min_score"),
+        boost=float(params.get("boost", 1.0)),
+    )
+
+
+def _parse_query_string(params):
+    if "query" not in params:
+        raise QueryParseError("[query_string] requires [query]")
+    return QueryStringQuery(
+        query=str(params["query"]),
+        default_field=params.get("default_field"),
+        fields=list(params.get("fields", [])),
+        default_operator=str(params.get("default_operator", "or")).lower(),
+        boost=float(params.get("boost", 1.0)),
+    )
+
+
+def _parse_simple_query_string(params):
+    q = _parse_query_string(params)
+    q.simple = True
+    return q
+
+
 _PARSERS = {
     "match": _parse_match,
     "match_phrase": _parse_match_phrase,
@@ -303,6 +501,16 @@ _PARSERS = {
     "match_all": _parse_match_all,
     "match_none": _parse_match_none,
     "knn": _parse_knn_query,
+    "ids": _parse_ids,
+    "prefix": lambda p: _parse_simple_pattern(PrefixQuery, "prefix")(p),
+    "wildcard": lambda p: _parse_simple_pattern(WildcardQuery, "wildcard")(p),
+    "regexp": lambda p: _parse_simple_pattern(RegexpQuery, "regexp")(p),
+    "fuzzy": _parse_fuzzy,
+    "dis_max": _parse_dis_max,
+    "boosting": _parse_boosting,
+    "function_score": _parse_function_score,
+    "query_string": _parse_query_string,
+    "simple_query_string": _parse_simple_query_string,
 }
 
 

@@ -224,6 +224,88 @@ class NumpyExecutor:
             [m for m, _ in per_segment],
         )
 
+    def execute_sorted(
+        self,
+        query: Optional[Query],
+        sort_specs: List[dict],
+        size: int = 10,
+        from_: int = 0,
+        knn: Optional[List[KnnSection]] = None,
+        min_score: Optional[float] = None,
+    ) -> Tuple[TopDocs, List[np.ndarray], List[List]]:
+        """Field-sorted collection (FieldSortBuilder / SortField analog).
+
+        Returns (TopDocs, masks, sort_values per hit). Sort keys: field
+        doc values (numeric/date/boolean/keyword), _score, _doc; missing
+        values follow the `missing` policy (_last default)."""
+        knn_sets = [self._knn_topk_global(sec) for sec in (knn or [])]
+        per_segment = []
+        for si, seg in enumerate(self.reader.segments):
+            mask, scores = self._execute_root(query, knn_sets, si, seg)
+            live = self.reader.live_docs[si]
+            if live is not None:
+                mask = mask & live
+            if min_score is not None:
+                mask = mask & (scores >= min_score)
+            per_segment.append((mask, scores))
+        total = int(sum(m.sum() for m, _ in per_segment))
+
+        cand_rows: List[np.ndarray] = []  # per key: concatenated arrays
+        seg_idx: List[np.ndarray] = []
+        doc_idx: List[np.ndarray] = []
+        score_arr: List[np.ndarray] = []
+        key_cols: List[List[np.ndarray]] = [[] for _ in sort_specs]
+        raw_cols: List[List[np.ndarray]] = [[] for _ in sort_specs]
+        for si, (mask, scores) in enumerate(per_segment):
+            idx = np.nonzero(mask)[0]
+            if not len(idx):
+                continue
+            seg = self.reader.segments[si]
+            seg_idx.append(np.full(len(idx), si))
+            doc_idx.append(idx)
+            score_arr.append(scores[idx])
+            for ki, spec in enumerate(sort_specs):
+                sort_key, raw = _sort_key_values(
+                    spec, seg, idx, scores[idx], self.reader.mappings
+                )
+                if sort_key is None:  # string column: rank globally below
+                    sort_key = np.zeros(0)
+                key_cols[ki].append(sort_key)
+                raw_cols[ki].append(raw)
+        if not seg_idx:
+            return TopDocs(total=total, hits=[], max_score=None), [
+                m for m, _ in per_segment
+            ], []
+        segs = np.concatenate(seg_idx)
+        docs = np.concatenate(doc_idx)
+        scrs = np.concatenate(score_arr)
+        raws = [np.concatenate(c) for c in raw_cols]
+        keys = []
+        for ki, spec in enumerate(sort_specs):
+            cols = key_cols[ki]
+            if any(len(c) == 0 for c in cols):
+                keys.append(_rank_strings(raws[ki], spec))
+            else:
+                keys.append(np.concatenate(cols))
+        # lexsort: last key is primary → reverse; tiebreak (seg, doc)
+        order = np.lexsort(tuple([docs, segs] + keys[::-1]))
+        top = order[from_ : from_ + size]
+        hits = [
+            Hit(
+                score=float(scrs[i]),
+                segment=int(segs[i]),
+                local_doc=int(docs[i]),
+                doc_id=self.reader.segments[int(segs[i])].doc_ids[int(docs[i])],
+            )
+            for i in top
+        ]
+        sort_values = [[_to_jsonable(raws[ki][i]) for ki in range(len(sort_specs))] for i in top]
+        return (
+            TopDocs(total=total, hits=hits, max_score=None),
+            [m for m, _ in per_segment],
+            sort_values,
+        )
+
     def _execute_root(
         self,
         query: Optional[Query],
@@ -300,7 +382,191 @@ class NumpyExecutor:
         if isinstance(q, KnnQueryWrapper):
             si = self.reader.segments.index(seg)
             return self._exec_knn(q.knn, si, seg)
+        if isinstance(q, dsl.IdsQuery):
+            return self._exec_ids(q, seg)
+        if isinstance(q, (dsl.PrefixQuery, dsl.WildcardQuery, dsl.RegexpQuery)):
+            return self._exec_pattern(q, seg)
+        if isinstance(q, dsl.FuzzyQuery):
+            return self._exec_fuzzy(q, seg)
+        if isinstance(q, dsl.DisMaxQuery):
+            return self._exec_dis_max(q, seg)
+        if isinstance(q, dsl.BoostingQuery):
+            return self._exec_boosting(q, seg)
+        if isinstance(q, dsl.FunctionScoreQuery):
+            return self._exec_function_score(q, seg)
+        if isinstance(q, dsl.QueryStringQuery):
+            return self._exec(rewrite_query_string(q, self.reader.mappings), seg)
         raise QueryParseError(f"unsupported query node [{type(q).__name__}]")
+
+    # ---- expanded / compound leaves ----
+
+    def _exec_ids(self, q: "dsl.IdsQuery", seg: Segment) -> Tuple[np.ndarray, np.ndarray]:
+        n = seg.num_docs
+        wanted = set(q.values)
+        mask = np.fromiter(
+            (d in wanted for d in seg.doc_ids), bool, count=n
+        ) if n else np.zeros(0, bool)
+        return mask, np.where(mask, np.float32(q.boost), 0).astype(np.float32)
+
+    def _expand_terms(self, q, seg: Segment) -> List[str]:
+        """MultiTermQuery rewrite: expand the pattern against the sorted
+        term dictionary (constant-score rewrite, the ES default)."""
+        import bisect
+        import fnmatch
+        import re as _re
+
+        pf = seg.postings.get(q.field)
+        if pf is None:
+            return []
+        terms = pf.terms
+        value = q.value.lower() if q.case_insensitive else q.value
+        if isinstance(q, dsl.PrefixQuery):
+            if q.case_insensitive:
+                return [t for t in terms if t.lower().startswith(value)]
+            # scan forward from the insertion point: O(matches), and no
+            # sentinel-character upper bound to miss astral-plane terms
+            lo = bisect.bisect_left(terms, value)
+            out = []
+            for i in range(lo, len(terms)):
+                if not terms[i].startswith(value):
+                    break
+                out.append(terms[i])
+            return out
+        if isinstance(q, dsl.WildcardQuery):
+            rx = _re.compile(
+                fnmatch.translate(value), _re.IGNORECASE if q.case_insensitive else 0
+            )
+            return [t for t in terms if rx.match(t)]
+        # regexp: Lucene anchors the pattern to the whole term
+        flags = _re.IGNORECASE if q.case_insensitive else 0
+        try:
+            rx = _re.compile(q.value, flags)
+        except _re.error as e:
+            raise QueryParseError(f"invalid regexp [{q.value}]: {e}")
+        return [t for t in terms if rx.fullmatch(t)]
+
+    def _exec_pattern(self, q, seg: Segment) -> Tuple[np.ndarray, np.ndarray]:
+        n = seg.num_docs
+        matched = self._expand_terms(q, seg)
+        mask = np.zeros(n, bool)
+        for t in matched:
+            m, _ = self._score_term_dense(seg, q.field, t, 1.0)
+            mask |= m
+        return mask, np.where(mask, np.float32(q.boost), 0).astype(np.float32)
+
+    def _exec_fuzzy(self, q: "dsl.FuzzyQuery", seg: Segment) -> Tuple[np.ndarray, np.ndarray]:
+        n = seg.num_docs
+        pf = seg.postings.get(q.field)
+        if pf is None:
+            return np.zeros(n, bool), np.zeros(n, np.float32)
+        max_edits = _fuzziness_edits(q.fuzziness, q.value)
+        prefix = q.value[: q.prefix_length]
+        cands = []
+        for t in pf.terms:
+            if abs(len(t) - len(q.value)) > max_edits:
+                continue
+            if prefix and not t.startswith(prefix):
+                continue
+            if _levenshtein_at_most(q.value, t, max_edits):
+                cands.append(t)
+                if len(cands) >= q.max_expansions:
+                    break
+        mask = np.zeros(n, bool)
+        for t in cands:
+            m, _ = self._score_term_dense(seg, q.field, t, 1.0)
+            mask |= m
+        return mask, np.where(mask, np.float32(q.boost), 0).astype(np.float32)
+
+    def _exec_dis_max(self, q: "dsl.DisMaxQuery", seg: Segment) -> Tuple[np.ndarray, np.ndarray]:
+        n = seg.num_docs
+        masks, scores = [], []
+        for sub in q.queries:
+            m, s = self._exec(sub, seg)
+            masks.append(m)
+            scores.append(np.where(m, s, 0))
+        mask = np.any(masks, axis=0)
+        mat = np.stack(scores)
+        best = mat.max(axis=0)
+        total = best + np.float32(q.tie_breaker) * (mat.sum(axis=0) - best)
+        total = (total * np.float32(q.boost)).astype(np.float32)
+        return mask, np.where(mask, total, 0).astype(np.float32)
+
+    def _exec_boosting(self, q: "dsl.BoostingQuery", seg: Segment) -> Tuple[np.ndarray, np.ndarray]:
+        pm, ps = self._exec(q.positive, seg)
+        nm, _ = self._exec(q.negative, seg)
+        scores = np.where(nm, ps * np.float32(q.negative_boost), ps)
+        scores = (scores * np.float32(q.boost)).astype(np.float32)
+        return pm, np.where(pm, scores, 0).astype(np.float32)
+
+    def _exec_function_score(
+        self, q: "dsl.FunctionScoreQuery", seg: Segment
+    ) -> Tuple[np.ndarray, np.ndarray]:
+        n = seg.num_docs
+        mask, base = self._exec(q.query, seg)
+        fvals: List[np.ndarray] = []
+        for fn in q.functions:
+            if fn.filter is not None:
+                fmask, _ = self._exec(fn.filter, seg)
+            else:
+                fmask = np.ones(n, bool)
+            val = np.ones(n, np.float32)
+            if fn.field_value_factor is not None:
+                val = _field_value_factor(fn.field_value_factor, seg)
+            elif fn.random_score is not None:
+                seed = fn.random_score.get("seed", 0)
+                val = np.asarray(
+                    [_stable_random(seed, d) for d in seg.doc_ids], np.float32
+                ) if n else np.zeros(0, np.float32)
+            if fn.weight is not None:
+                val = val * np.float32(fn.weight)
+            # functions only apply where their filter matches; identity
+            # elsewhere depends on score_mode (multiply→1, sum→0)
+            fvals.append(np.where(fmask, val, np.nan))
+        if fvals:
+            mat = np.stack(fvals)
+            present = ~np.isnan(mat)
+            any_fn = present.any(axis=0)
+            zed = np.where(present, mat, 0.0)
+            if q.score_mode == "multiply":
+                combined = np.where(present, mat, 1.0).prod(axis=0)
+            elif q.score_mode == "sum":
+                combined = zed.sum(axis=0)
+            elif q.score_mode == "avg":
+                cnt = np.maximum(present.sum(axis=0), 1)
+                combined = zed.sum(axis=0) / cnt
+            elif q.score_mode == "max":
+                combined = np.where(present, mat, -np.inf).max(axis=0)
+            elif q.score_mode == "min":
+                combined = np.where(present, mat, np.inf).min(axis=0)
+            elif q.score_mode == "first":
+                first_idx = present.argmax(axis=0)
+                combined = mat[first_idx, np.arange(n)]
+            else:
+                raise QueryParseError(f"unknown score_mode [{q.score_mode}]")
+            combined = np.where(any_fn, combined, 1.0).astype(np.float32)
+            if q.max_boost is not None:
+                combined = np.minimum(combined, np.float32(q.max_boost))
+            bm = q.boost_mode
+            if bm == "multiply":
+                scores = base * combined
+            elif bm == "sum":
+                scores = base + combined
+            elif bm == "replace":
+                scores = combined
+            elif bm == "avg":
+                scores = (base + combined) / 2
+            elif bm == "max":
+                scores = np.maximum(base, combined)
+            elif bm == "min":
+                scores = np.minimum(base, combined)
+            else:
+                raise QueryParseError(f"unknown boost_mode [{bm}]")
+        else:
+            scores = base
+        scores = (scores * np.float32(q.boost)).astype(np.float32)
+        if q.min_score is not None:
+            mask = mask & (scores >= np.float32(q.min_score))
+        return mask, np.where(mask, scores, 0).astype(np.float32)
 
     # ---- leaves ----
 
@@ -539,11 +805,19 @@ class NumpyExecutor:
             return np.zeros(n, bool), np.zeros(n, np.float32)
         per_field: List[Tuple[np.ndarray, np.ndarray]] = []
         for fname, fboost in fields:
-            m, s = self._exec_match(
-                MatchQuery(field=fname, query=q.query, operator=q.operator,
-                           boost=q.boost * fboost),
-                seg,
-            )
+            if q.type == "phrase":
+                m, s = self._exec_phrase(
+                    MatchPhraseQuery(
+                        field=fname, query=q.query, boost=q.boost * fboost
+                    ),
+                    seg,
+                )
+            else:
+                m, s = self._exec_match(
+                    MatchQuery(field=fname, query=q.query, operator=q.operator,
+                               boost=q.boost * fboost),
+                    seg,
+                )
             per_field.append((m, s))
         masks = np.stack([m for m, _ in per_field])
         score_mat = np.stack([s for _, s in per_field])
@@ -593,6 +867,333 @@ class NumpyExecutor:
 
 
 # ---- helpers ----
+
+def parse_sort(sort_body) -> List[dict]:
+    """Normalizes the request's "sort" into [{field, order, missing}]."""
+    specs = []
+    for entry in sort_body if isinstance(sort_body, list) else [sort_body]:
+        if isinstance(entry, str):
+            specs.append(
+                {
+                    "field": entry,
+                    "order": "desc" if entry == "_score" else "asc",
+                    "missing": "_last",
+                }
+            )
+        elif isinstance(entry, dict) and len(entry) == 1:
+            field, cfg = next(iter(entry.items()))
+            if isinstance(cfg, str):
+                specs.append({"field": field, "order": cfg, "missing": "_last"})
+            elif isinstance(cfg, dict):
+                specs.append(
+                    {
+                        "field": field,
+                        "order": cfg.get(
+                            "order", "desc" if field == "_score" else "asc"
+                        ),
+                        "missing": cfg.get("missing", "_last"),
+                    }
+                )
+            else:
+                raise QueryParseError(f"malformed sort entry [{entry}]")
+        else:
+            raise QueryParseError(f"malformed sort entry [{entry}]")
+    return specs
+
+
+def _sort_key_values(spec, seg, idx, scores, mappings):
+    """(lexsort-ready key array, raw response values) for matching docs.
+
+    Keys live in "ascending key space": desc orders negate the value, and
+    the `missing` policy fills ±inf in key space so _last/_first hold for
+    either direction (SortField.setMissingValue semantics). Keyword keys
+    are float ord ranks within the segment — NOTE: cross-segment keyword
+    sort uses per-segment ranks, which is correct only because the merge
+    re-sorts on the raw string values at the coordinator.
+    """
+    field = spec["field"]
+    desc = spec["order"] == "desc"
+    missing = spec["missing"]
+    n = len(idx)
+    if field == "_score":
+        raw = scores.astype(np.float64)
+        return (-raw if desc else raw), raw
+    if field == "_doc":
+        raw = idx.astype(np.float64)
+        return (-raw if desc else raw), raw
+    mf = mappings.get(field)
+    if mf is not None and mf.type in (KEYWORD, TEXT):
+        # string keys are only comparable globally: return key=None and
+        # let execute_sorted rank the concatenated raw values
+        of = seg.ordinals.get(field)
+        if of is None:
+            return None, np.full(n, None, object)
+        ords = of.ords[idx]
+        raw = np.asarray(
+            [of.ord_terms[o] if o >= 0 else None for o in ords], object
+        )
+        return None, raw
+    nf = seg.numerics.get(field)
+    if nf is None:
+        vals = np.zeros(n)
+        have = np.zeros(n, bool)
+    else:
+        vals = nf.values[idx]
+        have = nf.exists[idx]
+    key_vals = -vals if desc else vals
+    if missing == "_first":
+        fill_key = -np.inf
+        raw = np.where(have, vals, np.nan)
+    elif missing == "_last":
+        fill_key = np.inf
+        raw = np.where(have, vals, np.nan)
+    else:
+        # concrete missing value: docs sort (and report) AS that value
+        mv = float(missing)
+        fill_key = -mv if desc else mv
+        raw = np.where(have, vals, mv)
+    key = np.where(have, key_vals, fill_key)
+    return key.astype(np.float64), raw
+
+
+def _rank_strings(raw: np.ndarray, spec: dict) -> np.ndarray:
+    """Global ascending-key-space ranks for a string sort column."""
+    have = np.asarray([v is not None for v in raw])
+    vals = [v for v in raw if v is not None]
+    uniq = {v: i for i, v in enumerate(sorted(set(vals)))}
+    key = np.asarray([float(uniq[v]) if v is not None else 0.0 for v in raw])
+    if spec["order"] == "desc":
+        key = -key
+    fill = np.inf if spec["missing"] == "_last" else -np.inf
+    return np.where(have, key, fill)
+
+
+def _to_jsonable(v):
+    if v is None:
+        return None
+    if isinstance(v, (np.floating, np.integer)):
+        f = float(v)
+        if np.isnan(f):
+            return None
+        return int(f) if f.is_integer() and abs(f) < 2**53 else f
+    return v
+
+
+def filter_source(src: Optional[dict], spec):
+    """_source request option: false, list of patterns, or
+    {includes, excludes} (FetchSourcePhase / XContentMapValues.filter)."""
+    import fnmatch
+
+    if src is None or spec is None or spec is True:
+        return src
+    if spec is False:
+        return None
+    if isinstance(spec, str):
+        spec = [spec]
+    if isinstance(spec, list):
+        includes, excludes = spec, []
+    else:
+        includes = spec.get("includes", []) or []
+        excludes = spec.get("excludes", []) or []
+        if isinstance(includes, str):
+            includes = [includes]
+        if isinstance(excludes, str):
+            excludes = [excludes]
+
+    def walk(node, path):
+        if not isinstance(node, dict):
+            return node
+        out = {}
+        for k, v in node.items():
+            p = f"{path}.{k}" if path else k
+            if excludes and any(fnmatch.fnmatch(p, e) for e in excludes):
+                continue
+            if isinstance(v, dict):
+                sub = walk(v, p)
+                if sub or _included(p, includes, prefix_ok=True):
+                    if includes and not _included(p, includes, prefix_ok=True):
+                        continue
+                    out[k] = sub
+            else:
+                if not includes or _included(p, includes):
+                    out[k] = v
+        return out
+
+    return walk(src, "")
+
+
+def _included(path, includes, prefix_ok=False):
+    import fnmatch
+
+    for inc in includes:
+        if fnmatch.fnmatch(path, inc):
+            return True
+        if inc.startswith(path + "."):
+            return True  # an ancestor of an included leaf
+        if path.startswith(inc + "."):
+            return True  # a descendant of an included object
+        if prefix_ok and fnmatch.fnmatch(path, inc + "*"):
+            return True
+    return False
+
+
+def _fuzziness_edits(fuzziness: str, term: str) -> int:
+    """Fuzziness.AUTO: 0 edits for length<3, 1 for 3-5, else 2."""
+    f = str(fuzziness).upper()
+    if f.startswith("AUTO"):
+        n = len(term)
+        return 0 if n < 3 else (1 if n <= 5 else 2)
+    try:
+        return max(0, min(int(float(f)), 2))
+    except ValueError:
+        raise QueryParseError(f"invalid fuzziness [{fuzziness}]")
+
+
+def _levenshtein_at_most(a: str, b: str, k: int) -> bool:
+    if a == b:
+        return True
+    if k == 0:
+        return False
+    if abs(len(a) - len(b)) > k:
+        return False
+    prev = list(range(len(b) + 1))
+    for i, ca in enumerate(a, 1):
+        cur = [i]
+        row_min = i
+        for j, cb in enumerate(b, 1):
+            cur.append(min(prev[j] + 1, cur[-1] + 1, prev[j - 1] + (ca != cb)))
+            row_min = min(row_min, cur[-1])
+        if row_min > k:
+            return False
+        prev = cur
+    return prev[-1] <= k
+
+
+def _field_value_factor(cfg: dict, seg: Segment) -> np.ndarray:
+    """FieldValueFactorFunction: factor * modifier(doc_value)."""
+    field = cfg.get("field")
+    if field is None:
+        raise QueryParseError("[field_value_factor] requires [field]")
+    n = seg.num_docs
+    nf = seg.numerics.get(field)
+    missing = cfg.get("missing")
+    if nf is None:
+        if missing is None:
+            vals = np.zeros(n)
+            have = np.zeros(n, bool)
+        else:
+            vals = np.full(n, float(missing))
+            have = np.ones(n, bool)
+    else:
+        vals, have = nf.values, nf.exists
+        if missing is not None:
+            vals = np.where(have, vals, float(missing))
+            have = np.ones(n, bool)
+    v = vals * float(cfg.get("factor", 1.0))
+    modifier = cfg.get("modifier", "none")
+    mods = {
+        "none": lambda x: x,
+        "log": lambda x: np.log10(np.maximum(x, 1e-30)),
+        "log1p": lambda x: np.log10(x + 1),
+        "log2p": lambda x: np.log10(x + 2),
+        "ln": lambda x: np.log(np.maximum(x, 1e-30)),
+        "ln1p": lambda x: np.log1p(x),
+        "ln2p": lambda x: np.log(x + 2),
+        "square": lambda x: x * x,
+        "sqrt": lambda x: np.sqrt(np.maximum(x, 0)),
+        "reciprocal": lambda x: 1.0 / np.where(x == 0, 1e30, x),
+    }
+    if modifier not in mods:
+        raise QueryParseError(f"unknown modifier [{modifier}]")
+    out = mods[modifier](v).astype(np.float32)
+    return np.where(have, out, 0.0).astype(np.float32)
+
+
+def _stable_random(seed, doc_id: str) -> float:
+    """Deterministic per-doc pseudo-random in [0,1) (RandomScoreFunction)."""
+    import hashlib
+
+    h = hashlib.md5(f"{seed}:{doc_id}".encode()).digest()
+    return int.from_bytes(h[:8], "big") / 2**64
+
+
+def rewrite_query_string(q: "dsl.QueryStringQuery", mappings) -> "dsl.Query":
+    """query_string lite → bool tree. Supports: bare terms, field:term,
+    quoted phrases, AND/OR/NOT connectives (first connective wins as the
+    group operator), +term/-term prefixes in simple mode."""
+    import re as _re
+
+    default_fields = q.fields or (
+        [q.default_field] if q.default_field and q.default_field != "*" else ["*"]
+    )
+    tokens = _re.findall(r'(?:[\w.*]+:)?"[^"]*"|\S+', q.query)
+    must: List[dsl.Query] = []
+    should: List[dsl.Query] = []
+    must_not: List[dsl.Query] = []
+    operator = q.default_operator
+    pending: List[Tuple[str, dsl.Query]] = []  # (polarity, query)
+    saw_and = False
+    i = 0
+    while i < len(tokens):
+        tok = tokens[i]
+        i += 1
+        up = tok.upper()
+        if up == "AND" and not q.simple:
+            saw_and = True
+            continue
+        if up == "OR" and not q.simple:
+            continue
+        if up == "NOT" and not q.simple:
+            if i < len(tokens):
+                sub = _qs_leaf(tokens[i], default_fields)
+                if sub is not None:
+                    pending.append(("not", sub))
+                i += 1
+            continue
+        polarity = ""
+        if q.simple and tok[:1] in "+-" and len(tok) > 1:
+            polarity = tok[0]
+            tok = tok[1:]
+        sub = _qs_leaf(tok, default_fields)
+        if sub is None:
+            continue
+        pending.append(("must" if polarity == "+" else "not" if polarity == "-" else "", sub))
+    use_and = saw_and or operator == "and"
+    for pol, sub in pending:
+        if pol == "not":
+            must_not.append(sub)
+        elif pol == "must" or use_and:
+            must.append(sub)
+        else:
+            should.append(sub)
+    return dsl.BoolQuery(
+        must=must, should=should, must_not=must_not, boost=q.boost,
+        # should is only mandatory when it stands alone (bool default)
+        minimum_should_match="1" if (should and not must) else None,
+    )
+
+
+def _qs_leaf(tok: str, default_fields: List[str]) -> Optional["dsl.Query"]:
+    field = None
+    if ":" in tok and not tok.startswith('"'):
+        field, _, tok = tok.partition(":")
+    if not tok:
+        return None
+    fields = [field] if field else default_fields
+    if tok.startswith('"') and tok.endswith('"') and len(tok) >= 2:
+        phrase = tok[1:-1]
+        if len(fields) == 1 and fields[0] != "*":
+            return dsl.MatchPhraseQuery(field=fields[0], query=phrase)
+        return dsl.MultiMatchQuery(query=phrase, fields=fields, type="phrase")
+    if "*" in tok or "?" in tok:
+        if len(fields) == 1 and fields[0] != "*":
+            return dsl.WildcardQuery(field=fields[0], value=tok)
+        # wildcard over unspecified fields: unsupported → match nothing
+        return dsl.MatchNoneQuery()
+    if len(fields) == 1 and fields[0] != "*":
+        return dsl.MatchQuery(field=fields[0], query=tok)
+    return dsl.MultiMatchQuery(query=tok, fields=fields)
+
 
 def expand_match_fields(mappings, patterns) -> List[Tuple[str, float]]:
     """Expands multi_match field patterns (``title^2``, ``body``, ``*``,

@@ -233,7 +233,22 @@ class JaxExecutor:
         if isinstance(q, KnnQueryWrapper):
             hm, hs = self._oracle._exec_knn(q.knn, si, seg)
             return jnp.asarray(hm), jnp.asarray(hs)
-        raise QueryParseError(f"unsupported query node [{type(q).__name__}]")
+        if isinstance(q, dsl.DisMaxQuery):
+            masks, scores = [], []
+            for sub in q.queries:
+                m, s = self._exec(sub, si)
+                masks.append(m)
+                scores.append(jnp.where(m, s, 0.0))
+            mask = jnp.stack(masks).any(axis=0)
+            mat = jnp.stack(scores)
+            best = mat.max(axis=0)
+            total = best + jnp.float32(q.tie_breaker) * (mat.sum(axis=0) - best)
+            return mask, jnp.where(mask, total * jnp.float32(q.boost), 0.0)
+        # term-expansion and scripted-function nodes run host-side via the
+        # oracle (the reference keeps MultiTermQuery rewrites on the CPU
+        # too — expansion is dictionary work, not scoring work)
+        hm, hs = self._oracle._exec(q, seg)
+        return jnp.asarray(hm), jnp.asarray(hs)
 
     # ---- text leaves via the tile kernel ----
 

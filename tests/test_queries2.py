@@ -1,0 +1,315 @@
+"""Expanded query DSL, sort, _source filtering, and RRF retriever tests."""
+
+import numpy as np
+import pytest
+
+from elasticsearch_tpu.analysis import AnalysisRegistry
+from elasticsearch_tpu.cluster import IndexService
+from elasticsearch_tpu.index.mapping import DocumentParser, Mappings
+from elasticsearch_tpu.index.segment import SegmentBuilder
+from elasticsearch_tpu.search import dsl
+from elasticsearch_tpu.search.executor import (
+    NumpyExecutor,
+    ShardReader,
+    filter_source,
+)
+from elasticsearch_tpu.search.executor_jax import JaxExecutor
+
+MAPPING = {
+    "properties": {
+        "title": {"type": "text"},
+        "body": {"type": "text"},
+        "tag": {"type": "keyword"},
+        "views": {"type": "integer"},
+        "price": {"type": "double"},
+        "embedding": {"type": "dense_vector", "dims": 4, "similarity": "cosine"},
+    }
+}
+
+DOCS = [
+    ("1", {"title": "quick brown fox", "body": "jumps over the lazy dog", "tag": "animal", "views": 10, "price": 3.5, "embedding": [1, 0, 0, 0]}),
+    ("2", {"title": "quiet quality", "body": "quartz quarry qualms", "tag": "mineral", "views": 50, "price": 1.0, "embedding": [0, 1, 0, 0]}),
+    ("3", {"title": "foxtrot dance", "body": "dancing with foxes", "tag": "dance", "views": 5, "embedding": [0.7, 0.7, 0, 0]}),
+    ("4", {"title": "quickstep", "body": "another dance style", "tag": "dance", "views": 100, "price": 9.9, "embedding": [0, 0, 1, 0]}),
+    ("5", {"title": "box of rocks", "body": "a quick box", "tag": "mineral", "views": 7, "price": 2.2, "embedding": [0, 0, 0, 1]}),
+]
+
+
+@pytest.fixture(scope="module")
+def reader():
+    mappings = Mappings(MAPPING)
+    analysis = AnalysisRegistry()
+    parser = DocumentParser(mappings, analysis)
+    builder = SegmentBuilder(mappings)
+    for _id, src in DOCS:
+        builder.add(parser.parse(_id, src))
+    return ShardReader([builder.build()], mappings, analysis)
+
+
+@pytest.fixture(scope="module", params=["numpy", "jax"])
+def ex(request, reader):
+    return NumpyExecutor(reader) if request.param == "numpy" else JaxExecutor(reader)
+
+
+def ids(ex, qjson, size=10):
+    td = ex.search(dsl.parse_query(qjson), size=size)
+    return [h.doc_id for h in td.hits]
+
+
+class TestExpandedQueries:
+    def test_ids(self, ex):
+        assert set(ids(ex, {"ids": {"values": ["2", "4", "nope"]}})) == {"2", "4"}
+
+    def test_prefix(self, ex):
+        assert set(ids(ex, {"prefix": {"title": "qui"}})) == {"1", "2", "4"}
+        assert set(ids(ex, {"prefix": {"title": {"value": "fox"}}})) == {"1", "3"}
+
+    def test_prefix_keyword(self, ex):
+        assert set(ids(ex, {"prefix": {"tag": "min"}})) == {"2", "5"}
+
+    def test_wildcard(self, ex):
+        assert set(ids(ex, {"wildcard": {"title": "qu*k*"}})) == {"1", "4"}
+        assert set(ids(ex, {"wildcard": {"tag": "?ance"}})) == {"3", "4"}
+
+    def test_regexp(self, ex):
+        assert set(ids(ex, {"regexp": {"title": "fox(trot)?"}})) == {"1", "3"}
+        with pytest.raises(dsl.QueryParseError):
+            ids(ex, {"regexp": {"title": "[unclosed"}})
+
+    def test_fuzzy(self, ex):
+        # "quick" within edit distance of "quack"/"quick"
+        assert "1" in ids(ex, {"fuzzy": {"title": {"value": "quack"}}})
+        assert set(ids(ex, {"fuzzy": {"title": {"value": "boxs"}}})) == {"5"}
+        # fuzziness 0 = exact only
+        assert ids(ex, {"fuzzy": {"title": {"value": "quack", "fuzziness": 0}}}) == []
+
+    def test_dis_max(self, ex):
+        qjson = {
+            "dis_max": {
+                "queries": [
+                    {"match": {"title": "quick"}},
+                    {"match": {"body": "quick"}},
+                ],
+                "tie_breaker": 0.3,
+            }
+        }
+        got = ids(ex, qjson)
+        assert set(got) == {"1", "5"}
+        # score of doc 1 (title match) vs doc 5 (body match): dis_max keeps max
+        td = ex.search(dsl.parse_query(qjson))
+        t1 = ex.search(dsl.parse_query({"match": {"title": "quick"}}))
+        by_id = {h.doc_id: h.score for h in td.hits}
+        t1_by_id = {h.doc_id: h.score for h in t1.hits}
+        assert by_id["1"] == pytest.approx(t1_by_id["1"], rel=1e-5)
+
+    def test_boosting(self, ex):
+        qjson = {
+            "boosting": {
+                "positive": {"match": {"body": "dance dancing"}},
+                "negative": {"term": {"tag": "dance"}},
+                "negative_boost": 0.1,
+            }
+        }
+        td = ex.search(dsl.parse_query(qjson))
+        scores = {h.doc_id: h.score for h in td.hits}
+        pos = ex.search(dsl.parse_query({"match": {"body": "dance dancing"}}))
+        pos_scores = {h.doc_id: h.score for h in pos.hits}
+        for d in scores:
+            assert scores[d] == pytest.approx(pos_scores[d] * 0.1, rel=1e-5)
+
+    def test_function_score_weight_and_fvf(self, ex):
+        qjson = {
+            "function_score": {
+                "query": {"match": {"title": "quick quickstep foxtrot box"}},
+                "functions": [
+                    {
+                        "filter": {"term": {"tag": "dance"}},
+                        "weight": 3,
+                    },
+                    {
+                        "field_value_factor": {
+                            "field": "views",
+                            "factor": 0.1,
+                            "modifier": "ln1p",
+                        }
+                    },
+                ],
+                "score_mode": "sum",
+                "boost_mode": "multiply",
+            }
+        }
+        td = ex.search(dsl.parse_query(qjson))
+        base = ex.search(
+            dsl.parse_query({"match": {"title": "quick quickstep foxtrot box"}})
+        )
+        base_s = {h.doc_id: h.score for h in base.hits}
+        got = {h.doc_id: h.score for h in td.hits}
+        for d, s in got.items():
+            views = dict(DOCS)[d].get("views", 0)
+            fv = np.log1p(views * 0.1)
+            w = 3.0 if dict(DOCS)[d]["tag"] == "dance" else 0.0
+            assert s == pytest.approx(base_s[d] * (w + fv), rel=1e-4)
+
+    def test_function_score_min_score(self, ex):
+        qjson = {
+            "function_score": {
+                "query": {"match_all": {}},
+                "functions": [
+                    {"field_value_factor": {"field": "views", "missing": 0}}
+                ],
+                "boost_mode": "replace",
+                "min_score": 20,
+            }
+        }
+        assert set(ids(ex, qjson)) == {"2", "4"}
+
+    def test_query_string(self, ex):
+        assert set(ids(ex, {"query_string": {"query": "title:quick OR body:box"}})) == {"1", "5"}
+        assert set(ids(ex, {"query_string": {"query": "dance AND style", "default_field": "body"}})) == {"4"}
+        assert set(ids(ex, {"query_string": {"query": "dancing NOT quick", "fields": ["body"]}})) == {"3"}
+
+    def test_simple_query_string(self, ex):
+        assert set(
+            ids(ex, {"simple_query_string": {"query": "+dancing -quick", "fields": ["body"]}})
+        ) == {"3"}
+        # plain terms stay optional next to a +term
+        assert set(
+            ids(ex, {"simple_query_string": {"query": "+dancing style", "fields": ["body"]}})
+        ) == {"3"}
+        assert set(
+            ids(ex, {"simple_query_string": {"query": "+dance -style", "fields": ["body"]}})
+        ) == set()
+
+
+class TestSortAndSource:
+    @pytest.fixture(scope="class")
+    def idx(self):
+        idx = IndexService(
+            "sorttest",
+            settings={"number_of_shards": 2},
+            mappings_json=MAPPING,
+        )
+        for _id, src in DOCS:
+            idx.index_doc(_id, src)
+        idx.refresh()
+        return idx
+
+    def test_sort_numeric_desc(self, idx):
+        r = idx.search({"query": {"match_all": {}}, "sort": [{"views": "desc"}]})
+        got = [h["_id"] for h in r["hits"]["hits"]]
+        assert got == ["4", "2", "1", "5", "3"]
+        assert r["hits"]["hits"][0]["sort"] == [100]
+        assert r["hits"]["hits"][0]["_score"] is None
+
+    def test_sort_missing_last(self, idx):
+        r = idx.search({"query": {"match_all": {}}, "sort": [{"price": "asc"}]})
+        got = [h["_id"] for h in r["hits"]["hits"]]
+        assert got == ["2", "5", "1", "4", "3"]  # doc 3 has no price → last
+        assert r["hits"]["hits"][-1]["sort"] == [None]
+
+    def test_sort_missing_first(self, idx):
+        r = idx.search(
+            {
+                "query": {"match_all": {}},
+                "sort": [{"price": {"order": "asc", "missing": "_first"}}],
+            }
+        )
+        assert [h["_id"] for h in r["hits"]["hits"]][0] == "3"
+
+    def test_sort_keyword_and_secondary(self, idx):
+        r = idx.search(
+            {
+                "query": {"match_all": {}},
+                "sort": [{"tag": "asc"}, {"views": "desc"}],
+            }
+        )
+        got = [(h["sort"][0], h["_id"]) for h in r["hits"]["hits"]]
+        assert got == [
+            ("animal", "1"),
+            ("dance", "4"),
+            ("dance", "3"),
+            ("mineral", "2"),
+            ("mineral", "5"),
+        ]
+
+    def test_sort_pagination(self, idx):
+        r1 = idx.search({"sort": [{"views": "asc"}], "size": 2})
+        r2 = idx.search({"sort": [{"views": "asc"}], "size": 2, "from": 2})
+        assert [h["_id"] for h in r1["hits"]["hits"]] == ["3", "5"]
+        assert [h["_id"] for h in r2["hits"]["hits"]] == ["1", "2"]
+
+    def test_sort_missing_concrete_value(self, idx):
+        r = idx.search(
+            {
+                "query": {"match_all": {}},
+                "sort": [{"price": {"order": "asc", "missing": 5.0}}],
+            }
+        )
+        got = [(h["_id"], h["sort"][0]) for h in r["hits"]["hits"]]
+        # doc 3 (no price) sorts as 5.0: after 4.0, before 9.5
+        assert got == [("2", 1.0), ("5", 2.2), ("1", 3.5), ("3", 5.0), ("4", 9.9)]
+
+    def test_source_include_object_subtree(self, idx):
+        from elasticsearch_tpu.search.executor import filter_source
+
+        src = {"user": {"name": "x", "age": 3}, "title": "t"}
+        assert filter_source(src, ["user"]) == {"user": {"name": "x", "age": 3}}
+        assert filter_source(src, ["user.name"]) == {"user": {"name": "x"}}
+
+    def test_source_filtering(self, idx):
+        r = idx.search({"query": {"ids": {"values": ["1"]}}, "_source": ["title", "views"]})
+        assert r["hits"]["hits"][0]["_source"] == {"title": "quick brown fox", "views": 10}
+        r = idx.search({"query": {"ids": {"values": ["1"]}}, "_source": False})
+        assert "_source" not in r["hits"]["hits"][0]
+        r = idx.search(
+            {"query": {"ids": {"values": ["1"]}}, "_source": {"excludes": ["embedding", "t*"]}}
+        )
+        src = r["hits"]["hits"][0]["_source"]
+        assert "embedding" not in src and "title" not in src and "tag" not in src
+        assert src["views"] == 10
+
+
+class TestRRFRetriever:
+    @pytest.fixture(scope="class")
+    def idx(self):
+        idx = IndexService("rrftest", settings={"number_of_shards": 2}, mappings_json=MAPPING)
+        for _id, src in DOCS:
+            idx.index_doc(_id, src)
+        idx.refresh()
+        return idx
+
+    def test_rrf_fuses_lexical_and_vector(self, idx):
+        body = {
+            "retriever": {
+                "rrf": {
+                    "retrievers": [
+                        {"standard": {"query": {"match": {"title": "quick fox"}}}},
+                        {
+                            "knn": {
+                                "field": "embedding",
+                                "query_vector": [1, 0, 0, 0],
+                                "k": 3,
+                                "num_candidates": 5,
+                            }
+                        },
+                    ],
+                    "rank_constant": 60,
+                    "rank_window_size": 10,
+                }
+            },
+            "size": 3,
+        }
+        r = idx.search(body)
+        hits = r["hits"]["hits"]
+        assert hits, "rrf returned no hits"
+        # doc 1 ranks #1 lexically (quick fox in title) and #1 by vector
+        assert hits[0]["_id"] == "1"
+        assert hits[0]["_score"] == pytest.approx(2 / 61, rel=1e-6)
+        scores = [h["_score"] for h in hits]
+        assert scores == sorted(scores, reverse=True)
+
+    def test_standard_retriever_alone(self, idx):
+        r = idx.search(
+            {"retriever": {"standard": {"query": {"match": {"body": "dance"}}}}}
+        )
+        assert {h["_id"] for h in r["hits"]["hits"]} == {"4"}
