@@ -161,7 +161,15 @@ class IndexService:
         self._executors[shard.shard_id] = (shard.change_generation, ex)
         return ex
 
-    def search(self, body: Optional[dict] = None) -> dict:
+    def pin_executors(self) -> List:
+        """Point-in-time executor snapshot (ReaderContext acquire): scroll
+        and PIT searches reuse these so concurrent refreshes don't change
+        the view between pages."""
+        return [self._executor(s) for s in self.shards]
+
+    def search(
+        self, body: Optional[dict] = None, pinned_executors: Optional[List] = None
+    ) -> dict:
         body = body or {}
         if "retriever" in body:
             return self._retriever_search(body)
@@ -170,13 +178,24 @@ class IndexService:
         from_ = int(body.get("from", 0))
         min_score = body.get("min_score")
         source_spec = body.get("_source", True)
+        search_after = body.get("search_after")
         sort_specs = None
         if "sort" in body:
             from ..search.executor import parse_sort
 
             sort_specs = parse_sort(body["sort"])
-            if [s["field"] for s in sort_specs] == ["_score"]:
+            if search_after is None and [s["field"] for s in sort_specs] == ["_score"]:
                 sort_specs = None  # default relevance order
+        if search_after is not None:
+            if sort_specs is None:
+                raise dsl.QueryParseError(
+                    "Sort must contain at least one field when using search_after"
+                )
+            if len(search_after) != len(sort_specs):
+                raise dsl.QueryParseError(
+                    f"search_after has {len(search_after)} value(s) but sort "
+                    f"has {len(sort_specs)}"
+                )
         query = dsl.parse_query(body["query"]) if "query" in body else None
         knn_body = body.get("knn")
         knn = None
@@ -198,9 +217,13 @@ class IndexService:
         shard_sort_values: List[List[List]] = []
         profile = bool(body.get("profile"))
         shard_profiles = []
-        for shard in self.shards:
+        for shard_i, shard in enumerate(self.shards):
             ts = time.perf_counter_ns()
-            ex = self._executor(shard)
+            ex = (
+                pinned_executors[shard_i]
+                if pinned_executors is not None
+                else self._executor(shard)
+            )
             executors.append(ex)
             # each shard returns the full global page's worth of hits;
             # the same execution's masks feed the agg phase (no re-run)
@@ -213,6 +236,7 @@ class IndexService:
                     from_=0,
                     knn=knn,
                     min_score=min_score,
+                    search_after=search_after,
                 )
                 shard_sort_values.append(svals)
             else:
@@ -295,6 +319,16 @@ class IndexService:
         self.search_stats["query_total"] += 1
         self.search_stats["query_time_in_millis"] += took
         self.search_stats["fetch_total"] += 1
+        hits_obj: dict = {"max_score": max_score, "hits": out_hits}
+        tth = body.get("track_total_hits", True)
+        if tth is True:
+            hits_obj["total"] = {"value": total, "relation": "eq"}
+        elif tth is not False:
+            limit = int(tth)
+            hits_obj["total"] = {
+                "value": min(total, limit),
+                "relation": "gte" if total > limit else "eq",
+            }
         resp = {
             "took": took,
             "timed_out": False,
@@ -304,11 +338,7 @@ class IndexService:
                 "skipped": 0,
                 "failed": 0,
             },
-            "hits": {
-                "total": {"value": total, "relation": "eq"},
-                "max_score": max_score,
-                "hits": out_hits,
-            },
+            "hits": hits_obj,
         }
         if agg_nodes is not None:
             from ..search.aggs import reduce_aggs

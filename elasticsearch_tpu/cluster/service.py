@@ -49,6 +49,8 @@ class ClusterService:
         self.version = 0
         self.indices: Dict[str, IndexService] = {}
         self.cluster_settings = ClusterSettingsStore()
+        self._scrolls: Dict[str, dict] = {}
+        self._pits: Dict[str, dict] = {}
         self._lock = threading.RLock()
         self._started_at = time.time()
         if data_path is not None:
@@ -214,6 +216,105 @@ class ClusterService:
     # cluster-level APIs
     # ------------------------------------------------------------------
 
+    # ------------------------------------------------------------------
+    # scroll + point-in-time contexts (ReaderContext registry analog:
+    # SearchService.createAndPutReaderContext / freeReaderContext)
+    # ------------------------------------------------------------------
+
+    def create_scroll(self, index: str, body: dict, keep_alive: str) -> dict:
+        import uuid as _uuid
+
+        idx = self.get_index(index)
+        body = dict(body or {})
+        size = int(body.get("size", 10))
+        body.pop("from", None)
+        pinned = idx.pin_executors()
+        resp = idx.search({**body, "from": 0, "size": size}, pinned_executors=pinned)
+        scroll_id = _uuid.uuid4().hex
+        with self._lock:
+            self._scrolls[scroll_id] = {
+                "index": index,
+                "body": body,
+                "offset": size,
+                "size": size,
+                "pinned": pinned,
+                "expires": time.time() + _parse_keep_alive(keep_alive),
+            }
+        resp["_scroll_id"] = scroll_id
+        return resp
+
+    def continue_scroll(self, scroll_id: str, keep_alive: Optional[str]) -> dict:
+        with self._lock:
+            ctx = self._scrolls.get(scroll_id)
+            if ctx is None or ctx["expires"] < time.time():
+                self._scrolls.pop(scroll_id, None)
+                raise ClusterError(
+                    404,
+                    "No search context found for id [" + scroll_id + "]",
+                    "search_context_missing_exception",
+                )
+            if keep_alive:
+                ctx["expires"] = time.time() + _parse_keep_alive(keep_alive)
+            offset = ctx["offset"]
+            ctx["offset"] += ctx["size"]
+        idx = self.get_index(ctx["index"])
+        resp = idx.search(
+            {**ctx["body"], "from": offset, "size": ctx["size"]},
+            pinned_executors=ctx["pinned"],
+        )
+        resp["_scroll_id"] = scroll_id
+        return resp
+
+    def delete_scrolls(self, ids) -> dict:
+        freed = 0
+        with self._lock:
+            if ids == "_all":
+                freed = len(self._scrolls)
+                self._scrolls.clear()
+            else:
+                for sid in ids:
+                    if self._scrolls.pop(sid, None) is not None:
+                        freed += 1
+        return {"succeeded": True, "num_freed": freed}
+
+    def open_pit(self, index: str, keep_alive: str) -> dict:
+        import uuid as _uuid
+
+        idx = self.get_index(index)
+        pit_id = _uuid.uuid4().hex
+        with self._lock:
+            self._pits[pit_id] = {
+                "index": index,
+                "pinned": idx.pin_executors(),
+                "expires": time.time() + _parse_keep_alive(keep_alive),
+            }
+        return {"id": pit_id}
+
+    def pit_search(self, body: dict) -> dict:
+        pit = body.get("pit") or {}
+        pit_id = pit.get("id")
+        with self._lock:
+            ctx = self._pits.get(pit_id)
+            if ctx is None or ctx["expires"] < time.time():
+                self._pits.pop(pit_id, None)
+                raise ClusterError(
+                    404,
+                    f"No search context found for id [{pit_id}]",
+                    "search_context_missing_exception",
+                )
+            if pit.get("keep_alive"):
+                ctx["expires"] = time.time() + _parse_keep_alive(pit["keep_alive"])
+        idx = self.get_index(ctx["index"])
+        sub = {k: v for k, v in body.items() if k != "pit"}
+        resp = idx.search(sub, pinned_executors=ctx["pinned"])
+        resp["pit_id"] = pit_id
+        return resp
+
+    def close_pit(self, pit_id: str) -> dict:
+        with self._lock:
+            found = self._pits.pop(pit_id, None) is not None
+        return {"succeeded": found, "num_freed": 1 if found else 0}
+
     def health(self) -> dict:
         n_primaries = sum(len(i.shards) for i in self.indices.values())
         n_replicas = sum(
@@ -248,6 +349,19 @@ class ClusterService:
     def close(self) -> None:
         for idx in self.indices.values():
             idx.close()
+
+
+def _parse_keep_alive(value: str) -> float:
+    """'1m' / '30s' / '500ms' → seconds (TimeValue subset)."""
+    s = str(value)
+    for suffix, mult in (("ms", 0.001), ("s", 1.0), ("m", 60.0), ("h", 3600.0), ("d", 86400.0)):
+        if s.endswith(suffix) and s[: -len(suffix)].replace(".", "", 1).isdigit():
+            return float(s[: -len(suffix)]) * mult
+    raise ClusterError(
+        400,
+        f"failed to parse setting [keep_alive] with value [{value}]",
+        "illegal_argument_exception",
+    )
 
 
 def _validate_index_name(name: str) -> None:

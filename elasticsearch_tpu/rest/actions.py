@@ -59,6 +59,14 @@ class RestActions:
         add("POST", "/_refresh", self.refresh_all)
         add("POST", "/_flush", self.flush_all)
         add("POST", "/_msearch", self.msearch)
+        add("POST", "/_search", self.search_no_index)
+        add("GET", "/_search", self.search_no_index)
+        add("POST", "/_search/scroll", self.scroll)
+        add("GET", "/_search/scroll", self.scroll)
+        add("DELETE", "/_search/scroll", self.delete_scroll)
+        add("DELETE", "/_pit", self.close_pit)
+        add("POST", "/_analyze", self.analyze)
+        add("GET", "/_analyze", self.analyze)
         # index admin
         add("PUT", "/{index}", self.create_index)
         add("DELETE", "/{index}", self.delete_index)
@@ -79,6 +87,9 @@ class RestActions:
         add("GET", "/{index}/_count", self.count)
         add("POST", "/{index}/_msearch", self.msearch)
         add("POST", "/{index}/_bulk", self.bulk)
+        add("POST", "/{index}/_pit", self.open_pit)
+        add("POST", "/{index}/_analyze", self.analyze)
+        add("GET", "/{index}/_analyze", self.analyze)
         # documents
         add("POST", "/{index}/_doc", self.index_doc_auto)
         add("PUT", "/{index}/_doc/{id}", self.index_doc)
@@ -422,7 +433,6 @@ class RestActions:
     # ------------------------------------------------------------------
 
     def search(self, body, params, qs):
-        idx = self.cluster.get_index(params["index"])
         body = dict(body or {})
         if "size" in qs:
             body["size"] = int(qs["size"][0])
@@ -431,7 +441,95 @@ class RestActions:
         if "q" in qs:
             # query_string lite: field:value or plain terms on all text fields
             body["query"] = _parse_q_param(qs["q"][0])
+        if "scroll" in qs:
+            return 200, self.cluster.create_scroll(
+                params["index"], body, qs["scroll"][0] or "1m"
+            )
+        idx = self.cluster.get_index(params["index"])
         return 200, idx.search(body)
+
+    def search_no_index(self, body, params, qs):
+        body = body or {}
+        if "pit" in body:
+            return 200, self.cluster.pit_search(body)
+        return 400, error_body(
+            400,
+            "action_request_validation_exception",
+            "index is missing (only pit searches may omit the index)",
+        )
+
+    def scroll(self, body, params, qs):
+        body = body or {}
+        scroll_id = body.get("scroll_id") or (qs.get("scroll_id", [None])[0])
+        if not scroll_id:
+            return 400, error_body(
+                400, "action_request_validation_exception", "scroll_id is missing"
+            )
+        keep = body.get("scroll") or qs.get("scroll", [None])[0]
+        return 200, self.cluster.continue_scroll(scroll_id, keep)
+
+    def delete_scroll(self, body, params, qs):
+        body = body or {}
+        ids = body.get("scroll_id", "_all")
+        if isinstance(ids, str) and ids != "_all":
+            ids = [ids]
+        return 200, self.cluster.delete_scrolls(ids)
+
+    def open_pit(self, body, params, qs):
+        keep = qs.get("keep_alive", ["1m"])[0]
+        return 200, self.cluster.open_pit(params["index"], keep)
+
+    def close_pit(self, body, params, qs):
+        body = body or {}
+        pit_id = body.get("id")
+        if not pit_id:
+            return 400, error_body(
+                400, "action_request_validation_exception", "id is missing"
+            )
+        return 200, self.cluster.close_pit(pit_id)
+
+    def analyze(self, body, params, qs):
+        """_analyze (TransportAnalyzeAction): run an analyzer or an ad-hoc
+        tokenizer/filter chain over text, return tokens with offsets."""
+        body = body or {}
+        text = body.get("text")
+        if text is None:
+            return 400, error_body(
+                400, "action_request_validation_exception", "text is missing"
+            )
+        texts = text if isinstance(text, list) else [text]
+        if "index" in params:
+            idx = self.cluster.get_index(params["index"])
+            registry = idx.analysis
+            field = body.get("field")
+            if field is not None and body.get("analyzer") is None:
+                mf = idx.mappings.get(field)
+                analyzer_name = (mf.analyzer if mf else None) or "standard"
+            else:
+                analyzer_name = body.get("analyzer", "standard")
+        else:
+            from ..analysis import AnalysisRegistry
+
+            registry = AnalysisRegistry()
+            analyzer_name = body.get("analyzer", "standard")
+        analyzer = registry.get(analyzer_name)
+        tokens = []
+        pos_offset = 0
+        for t in texts:
+            toks = analyzer.analyze(t)
+            for tok in toks:
+                tokens.append(
+                    {
+                        "token": tok.text,
+                        "start_offset": tok.start_offset,
+                        "end_offset": tok.end_offset,
+                        "type": "<NUM>" if tok.text.isdigit() else "<ALPHANUM>",
+                        "position": pos_offset + tok.position,
+                    }
+                )
+            if toks:
+                pos_offset += toks[-1].position + 100  # position_increment_gap
+        return 200, {"tokens": tokens}
 
     def count(self, body, params, qs):
         idx = self.cluster.get_index(params["index"])

@@ -232,6 +232,7 @@ class NumpyExecutor:
         from_: int = 0,
         knn: Optional[List[KnnSection]] = None,
         min_score: Optional[float] = None,
+        search_after: Optional[List] = None,
     ) -> Tuple[TopDocs, List[np.ndarray], List[List]]:
         """Field-sorted collection (FieldSortBuilder / SortField analog).
 
@@ -281,12 +282,37 @@ class NumpyExecutor:
         scrs = np.concatenate(score_arr)
         raws = [np.concatenate(c) for c in raw_cols]
         keys = []
+        after_keys = []
         for ki, spec in enumerate(sort_specs):
             cols = key_cols[ki]
+            after_v = search_after[ki] if search_after is not None else None
             if any(len(c) == 0 for c in cols):
-                keys.append(_rank_strings(raws[ki], spec))
+                key, ak = _rank_strings(raws[ki], spec, after_v)
             else:
-                keys.append(np.concatenate(cols))
+                key = np.concatenate(cols)
+                ak = _numeric_after_key(after_v, spec)
+            keys.append(key)
+            after_keys.append(ak)
+        if search_after is not None:
+            # keep only docs strictly after the cursor in key space
+            # (SearchAfterBuilder: the cursor is the last hit's sort values)
+            gt = np.zeros(len(segs), bool)
+            eq = np.ones(len(segs), bool)
+            for ki, ak in enumerate(after_keys):
+                col = keys[ki]
+                gt |= eq & (col > ak)
+                eq &= col == ak
+            mask_after = gt  # strictly greater (ties skipped, as ES does
+            # when the tiebreak column is included in the sort)
+            segs, docs, scrs = segs[mask_after], docs[mask_after], scrs[mask_after]
+            keys = [k[mask_after] for k in keys]
+            raws = [r[mask_after] for r in raws]
+            if not len(segs):
+                return (
+                    TopDocs(total=total, hits=[], max_score=None),
+                    [m for m, _ in per_segment],
+                    [],
+                )
         # lexsort: last key is primary → reverse; tiebreak (seg, doc)
         order = np.lexsort(tuple([docs, segs] + keys[::-1]))
         top = order[from_ : from_ + size]
@@ -956,16 +982,36 @@ def _sort_key_values(spec, seg, idx, scores, mappings):
     return key.astype(np.float64), raw
 
 
-def _rank_strings(raw: np.ndarray, spec: dict) -> np.ndarray:
-    """Global ascending-key-space ranks for a string sort column."""
+def _rank_strings(raw: np.ndarray, spec: dict, after_value=None):
+    """Global ascending-key-space ranks for a string sort column; the
+    search_after cursor (if any) is ranked in the same space."""
     have = np.asarray([v is not None for v in raw])
-    vals = [v for v in raw if v is not None]
-    uniq = {v: i for i, v in enumerate(sorted(set(vals)))}
+    vals = {v for v in raw if v is not None}
+    if after_value is not None:
+        vals.add(str(after_value))
+    uniq = {v: i for i, v in enumerate(sorted(vals))}
     key = np.asarray([float(uniq[v]) if v is not None else 0.0 for v in raw])
-    if spec["order"] == "desc":
+    desc = spec["order"] == "desc"
+    if desc:
         key = -key
     fill = np.inf if spec["missing"] == "_last" else -np.inf
-    return np.where(have, key, fill)
+    key = np.where(have, key, fill)
+    ak = None
+    if after_value is not None:
+        ak = float(uniq[str(after_value)])
+        if desc:
+            ak = -ak
+    elif after_value is None:
+        ak = fill  # null cursor = the missing fill position
+    return key, ak
+
+
+def _numeric_after_key(after_value, spec: dict):
+    if after_value is None:
+        # null cursor = the doc before had a missing value
+        return np.inf if spec["missing"] == "_last" else -np.inf
+    v = float(after_value)
+    return -v if spec["order"] == "desc" else v
 
 
 def _to_jsonable(v):

@@ -1,0 +1,192 @@
+"""search_after, scroll, PIT, track_total_hits, _analyze tests."""
+
+import json
+import urllib.error
+import urllib.request
+
+import pytest
+
+from elasticsearch_tpu.cluster import ClusterError, ClusterService, IndexService
+from elasticsearch_tpu.rest.server import ElasticsearchTpuServer
+
+MAPPING = {
+    "properties": {
+        "body": {"type": "text"},
+        "n": {"type": "integer"},
+        "tag": {"type": "keyword"},
+    }
+}
+
+
+def build_index(n_docs=25, n_shards=3):
+    idx = IndexService(
+        "pg", settings={"number_of_shards": n_shards}, mappings_json=MAPPING
+    )
+    for i in range(n_docs):
+        idx.index_doc(str(i), {"body": f"doc {i}", "n": i, "tag": f"t{i % 4}"})
+    idx.refresh()
+    return idx
+
+
+class TestSearchAfter:
+    def test_walks_all_docs_in_order(self):
+        idx = build_index()
+        seen = []
+        after = None
+        while True:
+            body = {"sort": [{"n": "asc"}], "size": 7}
+            if after is not None:
+                body["search_after"] = after
+            r = idx.search(body)
+            hits = r["hits"]["hits"]
+            if not hits:
+                break
+            seen.extend(int(h["_id"]) for h in hits)
+            after = hits[-1]["sort"]
+        assert seen == list(range(25))
+
+    def test_keyword_sort_after(self):
+        idx = build_index()
+        r1 = idx.search({"sort": [{"tag": "asc"}, {"n": "asc"}], "size": 10})
+        after = r1["hits"]["hits"][-1]["sort"]
+        r2 = idx.search(
+            {"sort": [{"tag": "asc"}, {"n": "asc"}], "size": 10, "search_after": after}
+        )
+        ids1 = {h["_id"] for h in r1["hits"]["hits"]}
+        ids2 = {h["_id"] for h in r2["hits"]["hits"]}
+        assert not ids1 & ids2
+        pairs = [
+            (h["sort"][0], h["sort"][1])
+            for h in r1["hits"]["hits"] + r2["hits"]["hits"]
+        ]
+        assert pairs == sorted(pairs)
+
+    def test_requires_sort(self):
+        idx = build_index()
+        from elasticsearch_tpu.search.dsl import QueryParseError
+
+        with pytest.raises(QueryParseError):
+            idx.search({"search_after": [5]})
+        with pytest.raises(QueryParseError):
+            idx.search({"sort": [{"n": "asc"}], "search_after": [1, 2]})
+
+
+class TestTrackTotalHits:
+    def test_modes(self):
+        idx = build_index()
+        r = idx.search({"query": {"match_all": {}}})
+        assert r["hits"]["total"] == {"value": 25, "relation": "eq"}
+        r = idx.search({"query": {"match_all": {}}, "track_total_hits": False})
+        assert "total" not in r["hits"]
+        r = idx.search({"query": {"match_all": {}}, "track_total_hits": 10})
+        assert r["hits"]["total"] == {"value": 10, "relation": "gte"}
+        r = idx.search({"query": {"match_all": {}}, "track_total_hits": 100})
+        assert r["hits"]["total"] == {"value": 25, "relation": "eq"}
+
+
+class TestScrollAndPit:
+    def test_scroll_pages_are_stable_under_writes(self):
+        cs = ClusterService()
+        cs.create_index("sc", {"mappings": MAPPING, "settings": {"number_of_shards": 2}})
+        idx = cs.get_index("sc")
+        for i in range(12):
+            idx.index_doc(str(i), {"body": "scrollme", "n": i})
+        idx.refresh()
+        r = cs.create_scroll("sc", {"query": {"match": {"body": "scrollme"}}, "size": 5, "sort": [{"n": "asc"}]}, "1m")
+        sid = r["_scroll_id"]
+        page1 = [h["_id"] for h in r["hits"]["hits"]]
+        # writes after the scroll opened must not affect its view
+        idx.index_doc("new", {"body": "scrollme", "n": 100})
+        idx.refresh()
+        r2 = cs.continue_scroll(sid, None)
+        page2 = [h["_id"] for h in r2["hits"]["hits"]]
+        r3 = cs.continue_scroll(sid, None)
+        page3 = [h["_id"] for h in r3["hits"]["hits"]]
+        all_ids = page1 + page2 + page3
+        assert all_ids == [str(i) for i in range(12)]
+        r4 = cs.continue_scroll(sid, None)
+        assert r4["hits"]["hits"] == []
+        assert cs.delete_scrolls([sid])["num_freed"] == 1
+        with pytest.raises(ClusterError):
+            cs.continue_scroll(sid, None)
+
+    def test_pit_stable_view(self):
+        cs = ClusterService()
+        cs.create_index("pt", {"mappings": MAPPING})
+        idx = cs.get_index("pt")
+        for i in range(5):
+            idx.index_doc(str(i), {"body": "pitdoc", "n": i})
+        idx.refresh()
+        pit = cs.open_pit("pt", "1m")
+        idx.index_doc("5", {"body": "pitdoc", "n": 5})
+        idx.refresh()
+        r = cs.pit_search({"pit": {"id": pit["id"]}, "query": {"match": {"body": "pitdoc"}}})
+        assert r["hits"]["total"]["value"] == 5  # new doc invisible
+        assert r["pit_id"] == pit["id"]
+        # live search sees 6
+        assert idx.search({"query": {"match": {"body": "pitdoc"}}})["hits"]["total"]["value"] == 6
+        assert cs.close_pit(pit["id"])["succeeded"] is True
+        with pytest.raises(ClusterError):
+            cs.pit_search({"pit": {"id": pit["id"]}})
+
+
+@pytest.fixture
+def es():
+    srv = ElasticsearchTpuServer(port=0)
+    srv.start_background()
+    base = f"http://127.0.0.1:{srv.port}"
+
+    def call(method, path, body=None):
+        req = urllib.request.Request(
+            base + path,
+            data=json.dumps(body).encode() if body is not None else None,
+            method=method,
+            headers={"Content-Type": "application/json"},
+        )
+        try:
+            with urllib.request.urlopen(req) as r:
+                return r.status, json.loads(r.read() or b"null")
+        except urllib.error.HTTPError as e:
+            return e.code, json.loads(e.read() or b"null")
+
+    yield call
+    srv.close()
+
+
+class TestOverHttp:
+    def test_scroll_http(self, es):
+        for i in range(7):
+            es("PUT", f"/h1/_doc/{i}?refresh=true", {"b": f"x{i}", "n": i})
+        status, r = es("POST", "/h1/_search?scroll=1m", {"size": 3, "sort": [{"n": "asc"}]})
+        assert status == 200 and "_scroll_id" in r
+        sid = r["_scroll_id"]
+        got = [h["_id"] for h in r["hits"]["hits"]]
+        while True:
+            status, r = es("POST", "/_search/scroll", {"scroll_id": sid, "scroll": "1m"})
+            if not r["hits"]["hits"]:
+                break
+            got.extend(h["_id"] for h in r["hits"]["hits"])
+        assert got == [str(i) for i in range(7)]
+        status, r = es("DELETE", "/_search/scroll", {"scroll_id": sid})
+        assert r["num_freed"] == 1
+
+    def test_pit_http(self, es):
+        es("PUT", "/h2/_doc/1?refresh=true", {"b": "hello"})
+        status, pit = es("POST", "/h2/_pit?keep_alive=1m")
+        assert status == 200 and "id" in pit
+        status, r = es("POST", "/_search", {"pit": {"id": pit["id"]}, "query": {"match_all": {}}})
+        assert r["hits"]["total"]["value"] == 1
+        status, r = es("DELETE", "/_pit", {"id": pit["id"]})
+        assert r["succeeded"] is True
+
+    def test_analyze_http(self, es):
+        status, r = es("POST", "/_analyze", {"analyzer": "standard", "text": "The Quick-Fox 42"})
+        assert status == 200
+        toks = [(t["token"], t["position"]) for t in r["tokens"]]
+        assert toks == [("the", 0), ("quick", 1), ("fox", 2), ("42", 3)]
+        assert r["tokens"][3]["type"] == "<NUM>"
+        assert r["tokens"][1]["start_offset"] == 4
+        # with a field on an index
+        es("PUT", "/h3", {"mappings": {"properties": {"t": {"type": "text"}}}})
+        status, r = es("POST", "/h3/_analyze", {"field": "t", "text": "a b"})
+        assert [t["token"] for t in r["tokens"]] == ["a", "b"]
