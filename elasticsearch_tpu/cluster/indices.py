@@ -170,9 +170,34 @@ class IndexService:
     def search(
         self, body: Optional[dict] = None, pinned_executors: Optional[List] = None
     ) -> dict:
+        resp, agg_nodes, agg_partials = self.search_internal(
+            body, pinned_executors
+        )
+        if agg_nodes is not None:
+            from ..search.aggs import reduce_aggs
+
+            resp["aggregations"] = reduce_aggs(agg_nodes, agg_partials)
+        return resp
+
+    def search_internal(
+        self,
+        body: Optional[dict] = None,
+        pinned_executors: Optional[List] = None,
+        extra_filter: Optional[dict] = None,
+    ):
+        """Returns (response-without-aggs, agg_nodes, agg_partials) so a
+        multi-index coordinator can reduce aggs across indices (the
+        QueryPhaseResultConsumer split). ``extra_filter`` supports
+        filtered aliases (AliasFilter ANDed into the query)."""
         body = body or {}
         if "retriever" in body:
-            return self._retriever_search(body)
+            return self._retriever_search(body, extra_filter), None, []
+        if extra_filter is not None:
+            inner = body.get("query", {"match_all": {}})
+            body = {
+                **body,
+                "query": {"bool": {"must": [inner], "filter": [extra_filter]}},
+            }
         t0 = time.perf_counter()
         size = int(body.get("size", 10))
         from_ = int(body.get("from", 0))
@@ -340,15 +365,13 @@ class IndexService:
             },
             "hits": hits_obj,
         }
-        if agg_nodes is not None:
-            from ..search.aggs import reduce_aggs
-
-            resp["aggregations"] = reduce_aggs(agg_nodes, agg_partials)
         if profile:
             resp["profile"] = {"shards": shard_profiles}
-        return resp
+        return resp, agg_nodes, agg_partials
 
-    def _retriever_search(self, body: dict) -> dict:
+    def _retriever_search(
+        self, body: dict, extra_filter: Optional[dict] = None
+    ) -> dict:
         """`retriever` tree: standard / knn / rrf (x-pack rank-rrf:
         RRFRetrieverBuilder — score = Σ 1/(rank_constant + rank) over
         child retrievers, exact-doc dedup, rank_window_size candidates)."""
@@ -366,11 +389,16 @@ class IndexService:
                 sub = {"size": window, "_source": False}
                 if "query" in params:
                     sub["query"] = params["query"]
-                if "filter" in params:
+                filters = [
+                    f
+                    for f in (params.get("filter"), extra_filter)
+                    if f is not None
+                ]
+                if filters:
                     sub["query"] = {
                         "bool": {
                             "must": [sub.get("query", {"match_all": {}})],
-                            "filter": [params["filter"]],
+                            "filter": filters,
                         }
                     }
                 resp = self.search(sub)
@@ -378,8 +406,17 @@ class IndexService:
                     (h["_id"], h["_score"]) for h in resp["hits"]["hits"]
                 ]
             if kind == "knn":
+                knn_params = dict(params)
+                if extra_filter is not None:
+                    # alias filter constrains the knn candidate set too
+                    existing = knn_params.get("filter")
+                    knn_params["filter"] = (
+                        {"bool": {"filter": [existing, extra_filter]}}
+                        if existing is not None
+                        else extra_filter
+                    )
                 resp = self.search(
-                    {"knn": params, "size": window, "_source": False}
+                    {"knn": knn_params, "size": window, "_source": False}
                 )
                 return [
                     (h["_id"], h["_score"]) for h in resp["hits"]["hits"]
@@ -429,8 +466,16 @@ class IndexService:
             },
         }
 
-    def count(self, body: Optional[dict] = None) -> dict:
+    def count(
+        self, body: Optional[dict] = None, extra_filter: Optional[dict] = None
+    ) -> dict:
         body = body or {}
+        if extra_filter is not None:
+            inner = body.get("query", {"match_all": {}})
+            body = {
+                **body,
+                "query": {"bool": {"must": [inner], "filter": [extra_filter]}},
+            }
         query = dsl.parse_query(body["query"]) if "query" in body else None
         total = 0
         for shard in self.shards:

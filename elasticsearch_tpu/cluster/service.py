@@ -15,9 +15,10 @@ import json
 import os
 import threading
 import time
-from typing import Dict, Optional
+from typing import Dict, List, Optional, Tuple
 
 from ..analysis import AnalysisRegistry
+from ..common import deep_merge
 from ..common.settings import ClusterSettingsStore, SettingsError, validate_index_settings
 from ..index.mapping import MappingParseError
 from .indices import IndexService, _flatten_settings
@@ -49,6 +50,10 @@ class ClusterService:
         self.version = 0
         self.indices: Dict[str, IndexService] = {}
         self.cluster_settings = ClusterSettingsStore()
+        # alias → {index → {"filter": dict|None, "is_write_index": bool}}
+        self.aliases: Dict[str, Dict[str, dict]] = {}
+        # template name → {"index_patterns": [...], "template": {...}, "priority": N}
+        self.templates: Dict[str, dict] = {}
         self._scrolls: Dict[str, dict] = {}
         self._pits: Dict[str, dict] = {}
         self._lock = threading.RLock()
@@ -71,6 +76,8 @@ class ClusterService:
         state = {
             "version": self.version,
             "cluster_name": self.cluster_name,
+            "aliases": self.aliases,
+            "templates": self.templates,
             "indices": {
                 name: {
                     "settings": {k: v for k, v in idx.settings.items()},
@@ -95,6 +102,8 @@ class ClusterService:
         except (FileNotFoundError, json.JSONDecodeError):
             return
         self.version = state.get("version", 0)
+        self.aliases = state.get("aliases", {})
+        self.templates = state.get("templates", {})
         for name, meta in state.get("indices", {}).items():
             path = self._index_path(name)
             # prefer the per-index _meta.json written at flush — it carries
@@ -130,12 +139,26 @@ class ClusterService:
                     f"index [{name}] already exists",
                     "resource_already_exists_exception",
                 )
+            if name in self.aliases:
+                raise ClusterError(
+                    400,
+                    f"an alias with the same name as the index [{name}] "
+                    "already exists",
+                    "invalid_index_name_exception",
+                )
             body = body or {}
+            settings = body.get("settings") or {}
+            mappings = body.get("mappings") or {}
+            template = self._template_for(name)
+            if template is not None:
+                t = template.get("template", {})
+                settings = deep_merge(t.get("settings") or {}, settings)
+                mappings = deep_merge(t.get("mappings") or {}, mappings)
             try:
                 idx = IndexService(
                     name,
-                    settings=body.get("settings"),
-                    mappings_json=body.get("mappings"),
+                    settings=settings,
+                    mappings_json=mappings,
                     base_path=self._index_path(name),
                 )
             except SettingsError as e:
@@ -153,6 +176,10 @@ class ClusterService:
             idx = self.indices.pop(name, None)
             if idx is None:
                 raise IndexNotFoundError(name)
+            for alias in list(self.aliases):
+                self.aliases[alias].pop(name, None)
+                if not self.aliases[alias]:
+                    self.aliases.pop(alias)
             idx.close()
             path = self._index_path(name)
             if path and os.path.isdir(path):
@@ -215,6 +242,345 @@ class ClusterService:
     # ------------------------------------------------------------------
     # cluster-level APIs
     # ------------------------------------------------------------------
+
+    # ------------------------------------------------------------------
+    # aliases (MetadataIndexAliasesService / TransportIndicesAliasesAction)
+    # ------------------------------------------------------------------
+
+    def update_aliases(self, body: dict) -> dict:
+        with self._lock:
+            actions = (body or {}).get("actions", [])
+            for entry in actions:
+                if not isinstance(entry, dict) or len(entry) != 1:
+                    raise ClusterError(
+                        400, "malformed alias action", "illegal_argument_exception"
+                    )
+                op, spec = next(iter(entry.items()))
+                indices = spec.get("indices") or (
+                    [spec["index"]] if "index" in spec else []
+                )
+                names = spec.get("aliases") or (
+                    [spec["alias"]] if "alias" in spec else []
+                )
+                if not indices:
+                    raise ClusterError(
+                        400,
+                        "Validation Failed: 1: index is missing;",
+                        "action_request_validation_exception",
+                    )
+                if not names and op != "remove_index":
+                    raise ClusterError(
+                        400,
+                        "Validation Failed: 1: alias is missing;",
+                        "action_request_validation_exception",
+                    )
+                if op == "add":
+                    for index in indices:
+                        self.get_index(index)  # must exist
+                        for alias in names:
+                            if alias in self.indices:
+                                raise ClusterError(
+                                    400,
+                                    f"an index exists with the same name as the alias [{alias}]",
+                                    "invalid_alias_name_exception",
+                                )
+                            self.aliases.setdefault(alias, {})[index] = {
+                                "filter": spec.get("filter"),
+                                "is_write_index": bool(
+                                    spec.get("is_write_index", False)
+                                ),
+                            }
+                elif op == "remove":
+                    for index in indices:
+                        for alias in names:
+                            entry2 = self.aliases.get(alias)
+                            if entry2 is None or index not in entry2:
+                                if not spec.get("must_exist", True) is False:
+                                    raise ClusterError(
+                                        404,
+                                        f"aliases [{alias}] missing",
+                                        "aliases_not_found_exception",
+                                    )
+                            else:
+                                entry2.pop(index, None)
+                                if not entry2:
+                                    self.aliases.pop(alias, None)
+                elif op == "remove_index":
+                    for index in indices:
+                        self.delete_index(index)
+                else:
+                    raise ClusterError(
+                        400,
+                        f"unknown alias action [{op}]",
+                        "illegal_argument_exception",
+                    )
+            self.version += 1
+            self._persist()
+            return {"acknowledged": True}
+
+    def get_aliases(self, index: Optional[str] = None) -> dict:
+        out: Dict[str, dict] = {}
+        for alias, entries in self.aliases.items():
+            for idx_name, spec in entries.items():
+                if index is not None and idx_name != index:
+                    continue
+                meta: dict = {}
+                if spec.get("filter") is not None:
+                    meta["filter"] = spec["filter"]
+                if spec.get("is_write_index"):
+                    meta["is_write_index"] = True
+                out.setdefault(idx_name, {"aliases": {}})["aliases"][alias] = meta
+        if index is not None and index in self.indices and index not in out:
+            out[index] = {"aliases": {}}
+        return out
+
+    # ------------------------------------------------------------------
+    # index-expression resolution (IndexNameExpressionResolver)
+    # ------------------------------------------------------------------
+
+    def resolve(self, expression: str) -> List[Tuple[str, Optional[dict]]]:
+        """'a,logs-*,myalias' → [(concrete index, alias filter or None)].
+
+        Wildcards match index names and aliases; unknown concrete names
+        raise index_not_found (like ignore_unavailable=false)."""
+        import fnmatch
+
+        # one entry per concrete index: an unfiltered route wins outright;
+        # multiple filtered aliases OR their filters (AliasFilter semantics)
+        resolved: Dict[str, Optional[dict]] = {}
+        order: List[str] = []
+        NO_FILTER = object()
+
+        def add(name: str, filt: Optional[dict]):
+            if name not in resolved:
+                resolved[name] = NO_FILTER if filt is None else filt
+                order.append(name)
+                return
+            cur = resolved[name]
+            if cur is NO_FILTER or filt is None:
+                resolved[name] = NO_FILTER
+            elif json.dumps(cur, sort_keys=True) != json.dumps(filt, sort_keys=True):
+                resolved[name] = {
+                    "bool": {"should": [cur, filt], "minimum_should_match": 1}
+                }
+
+        for part in str(expression).split(","):
+            part = part.strip()
+            if not part:
+                continue
+            if part in ("_all", "*"):
+                for name in sorted(self.indices):
+                    add(name, None)
+                continue
+            if "*" in part or "?" in part:
+                matched = False
+                for name in sorted(self.indices):
+                    if fnmatch.fnmatch(name, part):
+                        add(name, None)
+                        matched = True
+                for alias in sorted(self.aliases):
+                    if fnmatch.fnmatch(alias, part):
+                        for idx_name, spec in self.aliases[alias].items():
+                            add(idx_name, spec.get("filter"))
+                        matched = True
+                # non-matching wildcards resolve to nothing (ES default
+                # allow_no_indices=true)
+                continue
+            if part in self.indices:
+                add(part, None)
+            elif part in self.aliases:
+                for idx_name, spec in self.aliases[part].items():
+                    add(idx_name, spec.get("filter"))
+            else:
+                raise IndexNotFoundError(part)
+        return [
+            (name, None if resolved[name] is NO_FILTER else resolved[name])
+            for name in order
+        ]
+
+    def resolve_write_index(
+        self, name: str, allow_auto_create: bool = True
+    ) -> Tuple["IndexService", Optional[str]]:
+        """Write target for a name: concrete index, or alias with a single
+        index / an is_write_index (TransportBulkAction resolution)."""
+        if name in self.indices:
+            return self.indices[name], name
+        entries = self.aliases.get(name)
+        if entries:
+            writes = [i for i, s in entries.items() if s.get("is_write_index")]
+            if len(writes) == 1:
+                return self.indices[writes[0]], writes[0]
+            if len(entries) == 1:
+                only = next(iter(entries))
+                return self.indices[only], only
+            raise ClusterError(
+                400,
+                f"no write index is defined for alias [{name}]. The write "
+                "index may be explicitly disabled using is_write_index=false "
+                "or the alias points to multiple indices without one being "
+                "designated as a write index",
+                "illegal_argument_exception",
+            )
+        if not allow_auto_create:
+            raise IndexNotFoundError(name)
+        idx = self.get_or_autocreate(name)
+        return idx, name
+
+    # ------------------------------------------------------------------
+    # multi-index search (TransportSearchAction over resolved indices)
+    # ------------------------------------------------------------------
+
+    def search(self, expression: str, body: Optional[dict] = None) -> dict:
+        targets = self.resolve(expression)
+        body = body or {}
+        if len(targets) == 1 and targets[0][1] is None:
+            return self.get_index(targets[0][0]).search(body)
+        if not targets:
+            return _empty_search_response()
+        size = int(body.get("size", 10))
+        from_ = int(body.get("from", 0))
+        sub = {**body, "from": 0, "size": from_ + size}
+        responses = []
+        agg_nodes = None
+        all_partials: List[dict] = []
+        sort_specs = None
+        if "sort" in body:
+            from ..search.executor import parse_sort
+
+            sort_specs = parse_sort(body["sort"])
+        for name, filt in targets:
+            idx = self.get_index(name)
+            resp, nodes, partials = idx.search_internal(sub, extra_filter=filt)
+            responses.append((name, resp))
+            if nodes is not None:
+                agg_nodes = nodes
+                all_partials.extend(partials)
+        # merge hits across indices
+        entries = []
+        total = 0
+        max_score = None
+        shards_total = 0
+        for pos, (name, resp) in enumerate(responses):
+            shards_total += resp["_shards"]["total"]
+            ht = resp["hits"].get("total")
+            if ht:
+                total += ht["value"]
+            ms = resp["hits"].get("max_score")
+            if ms is not None:
+                max_score = ms if max_score is None else max(max_score, ms)
+            for hi, h in enumerate(resp["hits"]["hits"]):
+                if sort_specs is not None:
+                    from ..search.coordinator import _col_key
+
+                    key = tuple(
+                        _col_key(v, spec)
+                        for v, spec in zip(h.get("sort", []), sort_specs)
+                    )
+                else:
+                    score = h.get("_score")
+                    key = (-(score if score is not None else 0.0),)
+                entries.append((key, pos, hi, h))
+        entries.sort(key=lambda e: e[:3])
+        hits = [h for _, _, _, h in entries[from_ : from_ + size]]
+        out = {
+            "took": sum(r["took"] for _, r in responses),
+            "timed_out": False,
+            "_shards": {
+                "total": shards_total,
+                "successful": shards_total,
+                "skipped": 0,
+                "failed": 0,
+            },
+            "hits": {
+                "total": {"value": total, "relation": "eq"},
+                "max_score": max_score,
+                "hits": hits,
+            },
+        }
+        if agg_nodes is not None:
+            from ..search.aggs import reduce_aggs
+
+            out["aggregations"] = reduce_aggs(agg_nodes, all_partials)
+        return out
+
+    def count(self, expression: str, body: Optional[dict] = None) -> dict:
+        targets = self.resolve(expression)
+        total = 0
+        shards = 0
+        for name, filt in targets:
+            r = self.get_index(name).count(body, extra_filter=filt)
+            total += r["count"]
+            shards += r["_shards"]["total"]
+        return {
+            "count": total,
+            "_shards": {
+                "total": shards,
+                "successful": shards,
+                "skipped": 0,
+                "failed": 0,
+            },
+        }
+
+    # ------------------------------------------------------------------
+    # index templates (MetadataIndexTemplateService, composable v2 subset)
+    # ------------------------------------------------------------------
+
+    def put_template(self, name: str, body: dict) -> dict:
+        with self._lock:
+            body = body or {}
+            patterns = body.get("index_patterns")
+            if not patterns:
+                raise ClusterError(
+                    400,
+                    "index template must have at least one index pattern",
+                    "illegal_argument_exception",
+                )
+            self.templates[name] = {
+                "index_patterns": patterns
+                if isinstance(patterns, list)
+                else [patterns],
+                "template": body.get("template", {}),
+                "priority": int(body.get("priority", 0)),
+            }
+            self.version += 1
+            self._persist()
+            return {"acknowledged": True}
+
+    def get_templates(self, name: Optional[str] = None) -> dict:
+        out = []
+        for tname, t in sorted(self.templates.items()):
+            if name is not None and tname != name:
+                continue
+            out.append({"name": tname, "index_template": t})
+        if name is not None and not out:
+            raise ClusterError(
+                404,
+                f"index template matching [{name}] not found",
+                "resource_not_found_exception",
+            )
+        return {"index_templates": out}
+
+    def delete_template(self, name: str) -> dict:
+        with self._lock:
+            if self.templates.pop(name, None) is None:
+                raise ClusterError(
+                    404,
+                    f"index template matching [{name}] not found",
+                    "resource_not_found_exception",
+                )
+            self.version += 1
+            self._persist()
+            return {"acknowledged": True}
+
+    def _template_for(self, index_name: str) -> Optional[dict]:
+        import fnmatch
+
+        best = None
+        for t in self.templates.values():
+            if any(fnmatch.fnmatch(index_name, p) for p in t["index_patterns"]):
+                if best is None or t["priority"] > best["priority"]:
+                    best = t
+        return best
 
     # ------------------------------------------------------------------
     # scroll + point-in-time contexts (ReaderContext registry analog:
@@ -349,6 +715,19 @@ class ClusterService:
     def close(self) -> None:
         for idx in self.indices.values():
             idx.close()
+
+
+def _empty_search_response() -> dict:
+    return {
+        "took": 0,
+        "timed_out": False,
+        "_shards": {"total": 0, "successful": 0, "skipped": 0, "failed": 0},
+        "hits": {
+            "total": {"value": 0, "relation": "eq"},
+            "max_score": None,
+            "hits": [],
+        },
+    }
 
 
 def _parse_keep_alive(value: str) -> float:

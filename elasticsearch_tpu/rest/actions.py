@@ -19,6 +19,7 @@ import uuid
 from typing import Any, Dict, List, Optional, Tuple
 
 from ..cluster import ClusterError, ClusterService
+from ..common import deep_merge
 from ..index.engine import VersionConflictError
 from ..search.dsl import QueryParseError
 from .router import Router, error_body
@@ -67,6 +68,17 @@ class RestActions:
         add("DELETE", "/_pit", self.close_pit)
         add("POST", "/_analyze", self.analyze)
         add("GET", "/_analyze", self.analyze)
+        # aliases & templates
+        add("POST", "/_aliases", self.update_aliases)
+        add("GET", "/_alias", self.get_alias)
+        add("GET", "/_alias/{name}", self.get_alias)
+        add("GET", "/{index}/_alias", self.get_index_alias)
+        add("PUT", "/{index}/_alias/{name}", self.put_alias)
+        add("DELETE", "/{index}/_alias/{name}", self.delete_alias)
+        add("PUT", "/_index_template/{name}", self.put_template)
+        add("GET", "/_index_template", self.get_template)
+        add("GET", "/_index_template/{name}", self.get_template)
+        add("DELETE", "/_index_template/{name}", self.delete_template)
         # index admin
         add("PUT", "/{index}", self.create_index)
         add("DELETE", "/{index}", self.delete_index)
@@ -134,6 +146,51 @@ class RestActions:
                 }
             },
         }
+
+    def update_aliases(self, body, params, qs):
+        return 200, self.cluster.update_aliases(body or {})
+
+    def get_alias(self, body, params, qs):
+        out = self.cluster.get_aliases()
+        name = params.get("name")
+        if name is not None:
+            out = {
+                idx: {"aliases": {a: m for a, m in e["aliases"].items() if a == name}}
+                for idx, e in out.items()
+                if name in e["aliases"]
+            }
+            if not out:
+                return 404, error_body(
+                    404, "aliases_not_found_exception", f"alias [{name}] missing"
+                )
+        return 200, out
+
+    def get_index_alias(self, body, params, qs):
+        self.cluster.get_index(params["index"])
+        return 200, self.cluster.get_aliases(params["index"])
+
+    def put_alias(self, body, params, qs):
+        action = {"index": params["index"], "alias": params["name"]}
+        if body:
+            if "filter" in body:
+                action["filter"] = body["filter"]
+            if "is_write_index" in body:
+                action["is_write_index"] = body["is_write_index"]
+        return 200, self.cluster.update_aliases({"actions": [{"add": action}]})
+
+    def delete_alias(self, body, params, qs):
+        return 200, self.cluster.update_aliases(
+            {"actions": [{"remove": {"index": params["index"], "alias": params["name"]}}]}
+        )
+
+    def put_template(self, body, params, qs):
+        return 200, self.cluster.put_template(params["name"], body or {})
+
+    def get_template(self, body, params, qs):
+        return 200, self.cluster.get_templates(params.get("name"))
+
+    def delete_template(self, body, params, qs):
+        return 200, self.cluster.delete_template(params["name"])
 
     def get_cluster_settings(self, body, params, qs):
         return 200, self.cluster.cluster_settings.to_json()
@@ -305,7 +362,8 @@ class RestActions:
             idx.refresh()
 
     def index_doc(self, body, params, qs, op_type=None):
-        idx = self.cluster.get_or_autocreate(params["index"])
+        idx, index_name = self.cluster.resolve_write_index(params["index"])
+        params = dict(params, index=index_name)
         routing = qs.get("routing", [None])[0]
         op = op_type or qs.get("op_type", ["index"])[0]
         kwargs = {}
@@ -328,8 +386,18 @@ class RestActions:
     def create_doc(self, body, params, qs):
         return self.index_doc(body, params, qs, op_type="create")
 
+    def _single_target(self, name: str):
+        targets = self.cluster.resolve(name)
+        if len(targets) != 1:
+            raise ClusterError(
+                400,
+                f"alias [{name}] has more than one index associated with it",
+                "illegal_argument_exception",
+            )
+        return self.cluster.get_index(targets[0][0]), targets[0][0]
+
     def get_doc(self, body, params, qs):
-        idx = self.cluster.get_index(params["index"])
+        idx, _ = self._single_target(params["index"])
         routing = qs.get("routing", [None])[0]
         doc = idx.get_doc(params["id"], routing=routing)
         if doc is None:
@@ -345,7 +413,7 @@ class RestActions:
         }
 
     def get_source(self, body, params, qs):
-        idx = self.cluster.get_index(params["index"])
+        idx, _ = self._single_target(params["index"])
         doc = idx.get_doc(params["id"], routing=qs.get("routing", [None])[0])
         if doc is None:
             return 404, error_body(
@@ -356,7 +424,10 @@ class RestActions:
         return 200, doc["_source"]
 
     def delete_doc(self, body, params, qs):
-        idx = self.cluster.get_index(params["index"])
+        idx, index_name = self.cluster.resolve_write_index(
+            params["index"], allow_auto_create=False
+        )
+        params = dict(params, index=index_name)
         routing = qs.get("routing", [None])[0]
         kwargs = {}
         if "if_seq_no" in qs:
@@ -371,7 +442,10 @@ class RestActions:
     def update_doc(self, body, params, qs):
         """_update: partial doc merge / doc_as_upsert / scripted noop
         detection (TransportUpdateAction subset: doc merge only)."""
-        idx = self.cluster.get_index(params["index"])
+        idx, index_name = self.cluster.resolve_write_index(
+            params["index"], allow_auto_create=False
+        )
+        params = dict(params, index=index_name)
         routing = qs.get("routing", [None])[0]
         body = body or {}
         doc_part = body.get("doc")
@@ -385,7 +459,7 @@ class RestActions:
         if existing is None:
             if body.get("doc_as_upsert") or "upsert" in body:
                 base = body.get("upsert", doc_part if body.get("doc_as_upsert") else {})
-                merged = _deep_merge(dict(base), doc_part)
+                merged = deep_merge(base, doc_part)
                 r = idx.index_doc(params["id"], merged, routing=routing)
                 self._maybe_refresh(idx, qs)
                 return 201, self._doc_response(params["index"], r, len(idx.shards))
@@ -394,7 +468,7 @@ class RestActions:
                 "document_missing_exception",
                 f"[{params['id']}]: document missing",
             )
-        merged = _deep_merge(dict(existing["_source"]), doc_part)
+        merged = deep_merge(existing["_source"], doc_part)
         if merged == existing["_source"] and body.get("detect_noop", True):
             return 200, {
                 "_index": params["index"],
@@ -418,7 +492,7 @@ class RestActions:
         for spec in docs_spec or []:
             index = spec.get("_index", params.get("index"))
             try:
-                idx = self.cluster.get_index(index)
+                idx, index = self._single_target(index)
                 doc = idx.get_doc(spec["_id"], routing=spec.get("routing"))
             except ClusterError:
                 doc = None
@@ -442,11 +516,24 @@ class RestActions:
             # query_string lite: field:value or plain terms on all text fields
             body["query"] = _parse_q_param(qs["q"][0])
         if "scroll" in qs:
+            targets = self.cluster.resolve(params["index"])
+            if len(targets) != 1:
+                return 400, error_body(
+                    400,
+                    "illegal_argument_exception",
+                    "scroll is only supported over a single index",
+                )
+            name, alias_filter = targets[0]
+            if alias_filter is not None:
+                inner = body.get("query", {"match_all": {}})
+                body = {
+                    **body,
+                    "query": {"bool": {"must": [inner], "filter": [alias_filter]}},
+                }
             return 200, self.cluster.create_scroll(
-                params["index"], body, qs["scroll"][0] or "1m"
+                name, body, qs["scroll"][0] or "1m"
             )
-        idx = self.cluster.get_index(params["index"])
-        return 200, idx.search(body)
+        return 200, self.cluster.search(params["index"], body)
 
     def search_no_index(self, body, params, qs):
         body = body or {}
@@ -532,8 +619,7 @@ class RestActions:
         return 200, {"tokens": tokens}
 
     def count(self, body, params, qs):
-        idx = self.cluster.get_index(params["index"])
-        return 200, idx.count(body)
+        return 200, self.cluster.count(params["index"], body)
 
     def msearch(self, body, params, qs):
         # body arrives pre-split as a list of (header, body) dicts
@@ -541,8 +627,7 @@ class RestActions:
         for header, sub in body:
             index = header.get("index", params.get("index"))
             try:
-                idx = self.cluster.get_index(index)
-                resp = idx.search(sub)
+                resp = self.cluster.search(index, sub)
                 resp["status"] = 200
             except (ClusterError, QueryParseError) as e:
                 status = e.status if isinstance(e, ClusterError) else 400
@@ -611,7 +696,7 @@ class RestActions:
                 errors = True
                 continue
             try:
-                idx = self.cluster.get_or_autocreate(index)
+                idx, index = self.cluster.resolve_write_index(index)
                 touched.add(index)
                 if action == "delete":
                     r = idx.delete_doc(doc_id, routing=routing)
@@ -682,15 +767,6 @@ class RestActions:
                     pass
         took = int((time.perf_counter() - t0) * 1000)
         return 200, {"took": took, "errors": errors, "items": items}
-
-
-def _deep_merge(base: dict, patch: dict) -> dict:
-    for k, v in patch.items():
-        if isinstance(v, dict) and isinstance(base.get(k), dict):
-            base[k] = _deep_merge(dict(base[k]), v)
-        else:
-            base[k] = v
-    return base
 
 
 def _parse_q_param(q: str) -> dict:

@@ -1,0 +1,278 @@
+"""Aliases, multi-index/wildcard resolution, index templates."""
+
+import json
+import urllib.error
+import urllib.request
+
+import pytest
+
+from elasticsearch_tpu.cluster import ClusterError, ClusterService
+from elasticsearch_tpu.rest.server import ElasticsearchTpuServer
+
+
+@pytest.fixture
+def cs():
+    cs = ClusterService()
+    for name in ("logs-2024-01", "logs-2024-02", "metrics-2024"):
+        cs.create_index(name, {"mappings": {"properties": {"msg": {"type": "text"}, "level": {"type": "keyword"}}}})
+    for name, n in (("logs-2024-01", 3), ("logs-2024-02", 2), ("metrics-2024", 4)):
+        idx = cs.get_index(name)
+        for i in range(n):
+            idx.index_doc(f"{name}-{i}", {"msg": f"event {i}", "level": "info" if i % 2 == 0 else "error"})
+        idx.refresh()
+    return cs
+
+
+class TestResolution:
+    def test_wildcards_and_lists(self, cs):
+        assert [n for n, _ in cs.resolve("logs-*")] == ["logs-2024-01", "logs-2024-02"]
+        assert len(cs.resolve("_all")) == 3
+        assert len(cs.resolve("logs-2024-01,metrics-2024")) == 2
+        assert cs.resolve("nomatch-*") == []
+        with pytest.raises(ClusterError):
+            cs.resolve("missing-index")
+
+    def test_multi_index_search(self, cs):
+        r = cs.search("logs-*", {"query": {"match": {"msg": "event"}}, "size": 20})
+        assert r["hits"]["total"]["value"] == 5
+        indices = {h["_index"] for h in r["hits"]["hits"]}
+        assert indices == {"logs-2024-01", "logs-2024-02"}
+        scores = [h["_score"] for h in r["hits"]["hits"]]
+        assert scores == sorted(scores, reverse=True)
+
+    def test_multi_index_aggs(self, cs):
+        r = cs.search(
+            "_all",
+            {"size": 0, "aggs": {"levels": {"terms": {"field": "level"}}}},
+        )
+        buckets = {b["key"]: b["doc_count"] for b in r["aggregations"]["levels"]["buckets"]}
+        assert buckets == {"info": 5, "error": 4}
+
+    def test_multi_index_count(self, cs):
+        assert cs.count("logs-*")["count"] == 5
+        assert cs.count("_all")["count"] == 9
+
+
+class TestAliases:
+    def test_add_search_remove(self, cs):
+        cs.update_aliases(
+            {
+                "actions": [
+                    {"add": {"index": "logs-2024-01", "alias": "logs"}},
+                    {"add": {"index": "logs-2024-02", "alias": "logs"}},
+                ]
+            }
+        )
+        r = cs.search("logs", {"size": 10})
+        assert r["hits"]["total"]["value"] == 5
+        aliases = cs.get_aliases()
+        assert "logs" in aliases["logs-2024-01"]["aliases"]
+        cs.update_aliases(
+            {"actions": [{"remove": {"index": "logs-2024-02", "alias": "logs"}}]}
+        )
+        assert cs.search("logs", {})["hits"]["total"]["value"] == 3
+
+    def test_filtered_alias(self, cs):
+        cs.update_aliases(
+            {
+                "actions": [
+                    {
+                        "add": {
+                            "index": "metrics-2024",
+                            "alias": "errors-only",
+                            "filter": {"term": {"level": "error"}},
+                        }
+                    }
+                ]
+            }
+        )
+        r = cs.search("errors-only", {"size": 10})
+        assert r["hits"]["total"]["value"] == 2
+        assert cs.count("errors-only")["count"] == 2
+
+    def test_write_index_resolution(self, cs):
+        cs.update_aliases(
+            {
+                "actions": [
+                    {"add": {"index": "logs-2024-01", "alias": "logs-w"}},
+                    {"add": {"index": "logs-2024-02", "alias": "logs-w", "is_write_index": True}},
+                ]
+            }
+        )
+        idx, name = cs.resolve_write_index("logs-w")
+        assert name == "logs-2024-02"
+        # alias with two indices and no write index → error
+        cs.update_aliases(
+            {
+                "actions": [
+                    {"add": {"index": "logs-2024-01", "alias": "logs-nw"}},
+                    {"add": {"index": "logs-2024-02", "alias": "logs-nw"}},
+                ]
+            }
+        )
+        with pytest.raises(ClusterError):
+            cs.resolve_write_index("logs-nw")
+
+    def test_alias_name_conflicts_with_index(self, cs):
+        with pytest.raises(ClusterError):
+            cs.update_aliases(
+                {"actions": [{"add": {"index": "logs-2024-01", "alias": "metrics-2024"}}]}
+            )
+
+    def test_index_plus_filtered_alias_dedup(self, cs):
+        cs.update_aliases(
+            {
+                "actions": [
+                    {
+                        "add": {
+                            "index": "logs-2024-01",
+                            "alias": "filt",
+                            "filter": {"term": {"level": "error"}},
+                        }
+                    }
+                ]
+            }
+        )
+        # same concrete index via both routes: unfiltered access wins once
+        targets = cs.resolve("logs-2024-01,filt")
+        assert targets == [("logs-2024-01", None)]
+        r = cs.search("logs-2024-01,filt", {"size": 10})
+        assert r["hits"]["total"]["value"] == 3  # not doubled
+
+    def test_retriever_respects_alias_filter(self, cs):
+        cs.update_aliases(
+            {
+                "actions": [
+                    {
+                        "add": {
+                            "index": "metrics-2024",
+                            "alias": "m-err",
+                            "filter": {"term": {"level": "error"}},
+                        }
+                    }
+                ]
+            }
+        )
+        r = cs.search(
+            "m-err",
+            {"retriever": {"standard": {"query": {"match_all": {}}}}, "size": 10},
+        )
+        assert len(r["hits"]["hits"]) == 2
+
+    def test_create_index_rejects_alias_name(self, cs):
+        cs.update_aliases(
+            {"actions": [{"add": {"index": "logs-2024-01", "alias": "taken"}}]}
+        )
+        with pytest.raises(ClusterError):
+            cs.create_index("taken")
+
+    def test_add_without_index_or_alias_rejected(self, cs):
+        with pytest.raises(ClusterError):
+            cs.update_aliases({"actions": [{"add": {"alias": "a"}}]})
+        with pytest.raises(ClusterError):
+            cs.update_aliases({"actions": [{"add": {"index": "logs-2024-01"}}]})
+
+    def test_alias_removed_with_index(self, cs):
+        cs.update_aliases(
+            {"actions": [{"add": {"index": "metrics-2024", "alias": "m"}}]}
+        )
+        cs.delete_index("metrics-2024")
+        assert "m" not in cs.aliases
+
+
+class TestTemplates:
+    def test_template_applied_on_create(self, cs):
+        cs.put_template(
+            "logs-template",
+            {
+                "index_patterns": ["logs-*"],
+                "template": {
+                    "settings": {"index": {"number_of_shards": 3}},
+                    "mappings": {"properties": {"ts": {"type": "date"}}},
+                },
+                "priority": 10,
+            },
+        )
+        cs.create_index("logs-2024-03")
+        idx = cs.get_index("logs-2024-03")
+        assert len(idx.shards) == 3
+        assert idx.mappings.get("ts").type == "date"
+        # explicit body overrides the template
+        cs.create_index(
+            "logs-2024-04", {"settings": {"index": {"number_of_shards": 1}}}
+        )
+        assert len(cs.get_index("logs-2024-04").shards) == 1
+
+    def test_priority_picks_best(self, cs):
+        cs.put_template("t-low", {"index_patterns": ["x-*"], "template": {"settings": {"index": {"number_of_shards": 2}}}, "priority": 1})
+        cs.put_template("t-high", {"index_patterns": ["x-special-*"], "template": {"settings": {"index": {"number_of_shards": 4}}}, "priority": 5})
+        cs.create_index("x-special-1")
+        assert len(cs.get_index("x-special-1").shards) == 4
+        cs.create_index("x-other")
+        assert len(cs.get_index("x-other").shards) == 2
+
+    def test_template_crud_and_errors(self, cs):
+        with pytest.raises(ClusterError):
+            cs.put_template("bad", {})
+        cs.put_template("ok", {"index_patterns": ["ok-*"]})
+        assert cs.get_templates("ok")["index_templates"][0]["name"] == "ok"
+        cs.delete_template("ok")
+        with pytest.raises(ClusterError):
+            cs.get_templates("ok")
+
+    def test_persistence(self, tmp_path):
+        p = str(tmp_path / "node")
+        cs = ClusterService(data_path=p)
+        cs.create_index("a1")
+        cs.update_aliases({"actions": [{"add": {"index": "a1", "alias": "al"}}]})
+        cs.put_template("tp", {"index_patterns": ["zz-*"]})
+        cs.close()
+        cs2 = ClusterService(data_path=p)
+        assert "al" in cs2.aliases
+        assert "tp" in cs2.templates
+
+
+class TestOverHttp:
+    def test_alias_endpoints(self):
+        srv = ElasticsearchTpuServer(port=0)
+        srv.start_background()
+        base = f"http://127.0.0.1:{srv.port}"
+
+        def call(method, path, body=None):
+            req = urllib.request.Request(
+                base + path,
+                data=json.dumps(body).encode() if body is not None else None,
+                method=method,
+                headers={"Content-Type": "application/json"},
+            )
+            try:
+                with urllib.request.urlopen(req) as r:
+                    return r.status, json.loads(r.read() or b"null")
+            except urllib.error.HTTPError as e:
+                return e.code, json.loads(e.read() or b"null")
+
+        try:
+            call("PUT", "/i1")
+            call("PUT", "/i1/_doc/1?refresh=true", {"a": 1})
+            status, _ = call("PUT", "/i1/_alias/my-alias")
+            assert status == 200
+            status, r = call("GET", "/_alias/my-alias")
+            assert r == {"i1": {"aliases": {"my-alias": {}}}}
+            status, r = call("POST", "/my-alias/_search", {})
+            assert r["hits"]["total"]["value"] == 1
+            status, r = call("PUT", "/my-alias/_doc/2?refresh=true", {"a": 2})
+            assert status == 201 and r["_index"] == "i1"
+            status, _ = call("DELETE", "/i1/_alias/my-alias")
+            status, r = call("GET", "/_alias/my-alias")
+            assert status == 404
+            # template endpoint
+            status, _ = call(
+                "PUT",
+                "/_index_template/t1",
+                {"index_patterns": ["tv-*"], "template": {"settings": {"index": {"number_of_replicas": 0}}}},
+            )
+            assert status == 200
+            status, r = call("GET", "/_index_template/t1")
+            assert r["index_templates"][0]["name"] == "t1"
+        finally:
+            srv.close()
