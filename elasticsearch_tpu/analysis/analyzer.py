@@ -142,6 +142,152 @@ class AsciiFoldingFilter(TokenFilter):
         return out
 
 
+class UppercaseFilter(TokenFilter):
+    def apply(self, tokens: List[Token]) -> List[Token]:
+        return [t._replace(text=t.text.upper()) for t in tokens]
+
+
+class TrimFilter(TokenFilter):
+    def apply(self, tokens: List[Token]) -> List[Token]:
+        return [t._replace(text=t.text.strip()) for t in tokens]
+
+
+class ReverseFilter(TokenFilter):
+    def apply(self, tokens: List[Token]) -> List[Token]:
+        return [t._replace(text=t.text[::-1]) for t in tokens]
+
+
+class TruncateFilter(TokenFilter):
+    def __init__(self, length: int = 10):
+        self.length = length
+
+    def apply(self, tokens: List[Token]) -> List[Token]:
+        return [t._replace(text=t.text[: self.length]) for t in tokens]
+
+
+class UniqueFilter(TokenFilter):
+    """only_on_same_position=false semantics: drop repeated terms."""
+
+    def apply(self, tokens: List[Token]) -> List[Token]:
+        seen = set()
+        out = []
+        for t in tokens:
+            if t.text not in seen:
+                seen.add(t.text)
+                out.append(t)
+        return out
+
+
+class LengthFilter(TokenFilter):
+    def __init__(self, min_len: int = 0, max_len: int = 2**31 - 1):
+        self.min_len = min_len
+        self.max_len = max_len
+
+    def apply(self, tokens: List[Token]) -> List[Token]:
+        return [t for t in tokens if self.min_len <= len(t.text) <= self.max_len]
+
+
+class EdgeNgramFilter(TokenFilter):
+    """edge_ngram: leading-edge grams, same position as the source token
+    (Lucene EdgeNGramTokenFilter)."""
+
+    def __init__(self, min_gram: int = 1, max_gram: int = 2):
+        self.min_gram = min_gram
+        self.max_gram = max_gram
+
+    def apply(self, tokens: List[Token]) -> List[Token]:
+        out = []
+        for t in tokens:
+            for n in range(self.min_gram, min(self.max_gram, len(t.text)) + 1):
+                out.append(t._replace(text=t.text[:n]))
+        return out
+
+
+class NgramFilter(TokenFilter):
+    def __init__(self, min_gram: int = 1, max_gram: int = 2):
+        self.min_gram = min_gram
+        self.max_gram = max_gram
+
+    def apply(self, tokens: List[Token]) -> List[Token]:
+        out = []
+        for t in tokens:
+            for n in range(self.min_gram, self.max_gram + 1):
+                for i in range(0, max(len(t.text) - n + 1, 0)):
+                    out.append(t._replace(text=t.text[i : i + n]))
+        return out
+
+
+class ShingleFilter(TokenFilter):
+    """shingle: word n-grams joined by a separator, emitted alongside the
+    unigrams when output_unigrams (Lucene ShingleFilter)."""
+
+    def __init__(
+        self,
+        min_shingle_size: int = 2,
+        max_shingle_size: int = 2,
+        output_unigrams: bool = True,
+        token_separator: str = " ",
+    ):
+        self.min_size = min_shingle_size
+        self.max_size = max_shingle_size
+        self.output_unigrams = output_unigrams
+        self.sep = token_separator
+
+    def apply(self, tokens: List[Token]) -> List[Token]:
+        out = []
+        for i, t in enumerate(tokens):
+            if self.output_unigrams:
+                out.append(t)
+            for size in range(self.min_size, self.max_size + 1):
+                if i + size <= len(tokens):
+                    window = tokens[i : i + size]
+                    out.append(
+                        Token(
+                            text=self.sep.join(w.text for w in window),
+                            position=t.position,
+                            start_offset=t.start_offset,
+                            end_offset=window[-1].end_offset,
+                        )
+                    )
+        return out
+
+
+class SynonymFilter(TokenFilter):
+    """synonym / synonym_graph lite: single-token rules only.
+
+    Rules: "a, b => c" (a and b rewrite to c) or "a, b, c" (equivalence
+    class — each token expands to every member at the same position)."""
+
+    def __init__(self, synonyms: Sequence[str] = ()):
+        self.map: Dict[str, List[str]] = {}
+        for rule in synonyms:
+            if "=>" in rule:
+                lhs, _, rhs = rule.partition("=>")
+                targets = [t.strip() for t in rhs.split(",") if t.strip()]
+                for src in lhs.split(","):
+                    src = src.strip()
+                    if src:
+                        self.map[src] = targets
+            else:
+                group = [t.strip() for t in rule.split(",") if t.strip()]
+                for src in group:
+                    self.map[src] = group
+
+    def apply(self, tokens: List[Token]) -> List[Token]:
+        out = []
+        for t in tokens:
+            targets = self.map.get(t.text)
+            if targets is None:
+                out.append(t)
+            else:
+                seen = set()
+                for tgt in targets:
+                    if tgt not in seen:
+                        seen.add(tgt)
+                        out.append(t._replace(text=tgt))
+        return out
+
+
 def _stemmer_for(language: str) -> "PorterStemFilter":
     """Only English stemming is implemented (Porter, as Lucene's
     porter_stem / PorterStemFilter). Note ES's `stemmer` filter default
@@ -232,11 +378,33 @@ class AnalysisRegistry:
     }
     _FILTERS: Dict[str, Callable[[dict], TokenFilter]] = {
         "lowercase": lambda cfg: LowercaseFilter(),
+        "uppercase": lambda cfg: UppercaseFilter(),
         "stop": lambda cfg: StopFilter(_resolve_stopwords(cfg.get("stopwords"))),
         "porter_stem": lambda cfg: PorterStemFilter(),
         "stemmer": lambda cfg: _stemmer_for(cfg.get("language", "english")),
         "asciifolding": lambda cfg: AsciiFoldingFilter(),
         "english_possessive": lambda cfg: PossessiveFilter(),
+        "trim": lambda cfg: TrimFilter(),
+        "reverse": lambda cfg: ReverseFilter(),
+        "truncate": lambda cfg: TruncateFilter(int(cfg.get("length", 10))),
+        "unique": lambda cfg: UniqueFilter(),
+        "length": lambda cfg: LengthFilter(
+            int(cfg.get("min", 0)), int(cfg.get("max", 2**31 - 1))
+        ),
+        "edge_ngram": lambda cfg: EdgeNgramFilter(
+            int(cfg.get("min_gram", 1)), int(cfg.get("max_gram", 2))
+        ),
+        "ngram": lambda cfg: NgramFilter(
+            int(cfg.get("min_gram", 1)), int(cfg.get("max_gram", 2))
+        ),
+        "shingle": lambda cfg: ShingleFilter(
+            int(cfg.get("min_shingle_size", 2)),
+            int(cfg.get("max_shingle_size", 2)),
+            bool(cfg.get("output_unigrams", True)),
+            str(cfg.get("token_separator", " ")),
+        ),
+        "synonym": lambda cfg: SynonymFilter(cfg.get("synonyms", [])),
+        "synonym_graph": lambda cfg: SynonymFilter(cfg.get("synonyms", [])),
     }
 
     def __init__(self, index_settings: Optional[dict] = None):

@@ -39,8 +39,13 @@ class IndexService:
     ):
         self.name = name
         self.settings = dict(DEFAULT_SETTINGS)
+        # index.analysis.* is a free-form group setting (custom analyzers,
+        # filters, char_filters) consumed by the AnalysisRegistry, not the
+        # scalar registry
+        self.analysis_config = _extract_analysis(settings or {})
         if settings:
             flat = _flatten_settings(settings)
+            flat = {k: v for k, v in flat.items() if not k.startswith("analysis.")}
             flat.pop("uuid", None)  # round-trip fields from metadata()
             flat.pop("creation_date", None)
             flat.pop("provided_name", None)
@@ -48,7 +53,9 @@ class IndexService:
         self.creation_date = int(time.time() * 1000)
         self.uuid = _index_uuid(name, self.creation_date)
         self.mappings = Mappings(mappings_json or {})
-        self.analysis = analysis or AnalysisRegistry()
+        self.analysis = analysis or AnalysisRegistry(
+            {"analysis": self.analysis_config} if self.analysis_config else None
+        )
         self.base_path = base_path
         n = int(self.settings["number_of_shards"])
         if n < 1:
@@ -114,8 +121,11 @@ class IndexService:
         import json
 
         os.makedirs(self.base_path, exist_ok=True)
+        meta_settings = {k: v for k, v in self.settings.items()}
+        if self.analysis_config:
+            meta_settings["analysis"] = self.analysis_config
         meta = {
-            "settings": {k: v for k, v in self.settings.items()},
+            "settings": meta_settings,
             "mappings": self.mappings.to_json(),
             "uuid": self.uuid,
             "creation_date": self.creation_date,
@@ -325,6 +335,15 @@ class IndexService:
             hit_sorts = None
         from ..search.executor import filter_source
 
+        highlight_specs = None
+        highlight_terms = None
+        if "highlight" in body:
+            from ..search.highlight import extract_highlight_terms, parse_highlight
+
+            highlight_specs = parse_highlight(body["highlight"])
+            highlight_terms = extract_highlight_terms(
+                query, self.mappings, self.analysis
+            )
         out_hits = []
         for i, h in enumerate(hits):
             reader = executors[h.shard].reader
@@ -339,6 +358,10 @@ class IndexService:
                 entry["_source"] = filtered
             if hit_sorts is not None:
                 entry["sort"] = hit_sorts[i]
+            if highlight_specs is not None and src is not None:
+                hl = self._highlight_hit(src, highlight_specs, highlight_terms)
+                if hl:
+                    entry["highlight"] = hl
             out_hits.append(entry)
         took = int((time.perf_counter() - t0) * 1000)
         self.search_stats["query_total"] += 1
@@ -368,6 +391,48 @@ class IndexService:
         if profile:
             resp["profile"] = {"shards": shard_profiles}
         return resp, agg_nodes, agg_partials
+
+    def _highlight_hit(self, src: dict, specs: dict, terms_by_field: dict) -> dict:
+        from ..search.highlight import highlight_field
+
+        out = {}
+        for fname, spec in specs.items():
+            terms = terms_by_field.get(fname)
+            if not terms:
+                continue
+            value = src.get(fname)
+            if value is None and "." in fname:
+                node = src
+                for part in fname.split("."):
+                    node = node.get(part) if isinstance(node, dict) else None
+                    if node is None:
+                        break
+                value = node
+            if value is None:
+                continue
+            mf = self.mappings.get(fname)
+            analyzer_name = mf.analyzer if mf is not None else "standard"
+            try:
+                analyzer = self.analysis.get(analyzer_name)
+            except ValueError:
+                continue
+            values = value if isinstance(value, list) else [value]
+            frags: List[str] = []
+            for v in values:
+                frags.extend(
+                    highlight_field(
+                        str(v),
+                        terms,
+                        analyzer,
+                        spec["pre"],
+                        spec["post"],
+                        spec["fragment_size"],
+                        spec["number_of_fragments"],
+                    )
+                )
+            if frags:
+                out[fname] = frags
+        return out
 
     def _retriever_search(
         self, body: dict, extra_filter: Optional[dict] = None
@@ -533,15 +598,16 @@ class IndexService:
         return {"uuid": self.uuid, "primaries": body, "total": body}
 
     def metadata(self) -> dict:
+        index_settings = {
+            **{k: str(v) for k, v in self.settings.items()},
+            "uuid": self.uuid,
+            "creation_date": str(self.creation_date),
+            "provided_name": self.name,
+        }
+        if self.analysis_config:
+            index_settings["analysis"] = self.analysis_config
         return {
-            "settings": {
-                "index": {
-                    **{k: str(v) for k, v in self.settings.items()},
-                    "uuid": self.uuid,
-                    "creation_date": str(self.creation_date),
-                    "provided_name": self.name,
-                }
-            },
+            "settings": {"index": index_settings},
             "mappings": self.mappings.to_json(),
         }
 
@@ -553,6 +619,15 @@ def json_dumps_safe(obj) -> str:
         return json.dumps(obj)
     except (TypeError, ValueError):
         return str(obj)
+
+
+def _extract_analysis(settings: dict) -> dict:
+    node = settings.get("index", settings)
+    if isinstance(node, dict):
+        cfg = node.get("analysis") or settings.get("analysis")
+        if isinstance(cfg, dict):
+            return cfg
+    return {}
 
 
 def _flatten_settings(settings: dict) -> dict:

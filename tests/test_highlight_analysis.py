@@ -1,0 +1,190 @@
+"""Highlighting and expanded analysis-chain tests."""
+
+import pytest
+
+from elasticsearch_tpu.analysis import AnalysisRegistry
+from elasticsearch_tpu.cluster import IndexService
+
+
+class TestTokenFilters:
+    def make(self, filters, custom_filters=None, tokenizer="standard"):
+        return AnalysisRegistry(
+            {
+                "analysis": {
+                    "analyzer": {
+                        "t": {"type": "custom", "tokenizer": tokenizer, "filter": filters}
+                    },
+                    "filter": custom_filters or {},
+                }
+            }
+        ).get("t")
+
+    def test_edge_ngram(self):
+        a = self.make(
+            ["lowercase", "my_edge"],
+            {"my_edge": {"type": "edge_ngram", "min_gram": 2, "max_gram": 4}},
+        )
+        assert a.terms("Search") == ["se", "sea", "sear"]
+
+    def test_ngram(self):
+        a = self.make(
+            ["my_ng"], {"my_ng": {"type": "ngram", "min_gram": 2, "max_gram": 2}}
+        )
+        assert a.terms("abc") == ["ab", "bc"]
+
+    def test_shingle(self):
+        a = self.make(["lowercase", "shingle"])
+        assert a.terms("quick brown fox") == [
+            "quick",
+            "quick brown",
+            "brown",
+            "brown fox",
+            "fox",
+        ]
+
+    def test_synonym_equivalence_and_rule(self):
+        a = self.make(
+            ["lowercase", "syn"],
+            {
+                "syn": {
+                    "type": "synonym",
+                    "synonyms": ["car, automobile", "tv => television"],
+                }
+            },
+        )
+        assert a.terms("car") == ["car", "automobile"]
+        assert a.terms("automobile") == ["car", "automobile"]
+        assert a.terms("tv") == ["television"]
+
+    def test_misc_filters(self):
+        a = self.make(["uppercase"])
+        assert a.terms("abc") == ["ABC"]
+        a = self.make(["truncate"], {"truncate": {"type": "truncate", "length": 3}})
+        assert a.terms("abcdef") == ["abc"]
+        a = self.make(["lowercase", "unique"])
+        assert a.terms("A a b") == ["a", "b"]
+        a = self.make(
+            ["my_len"], {"my_len": {"type": "length", "min": 2, "max": 3}}
+        )
+        assert a.terms("a ab abc abcd") == ["ab", "abc"]
+        a = self.make(["reverse"])
+        assert a.terms("abc") == ["cba"]
+
+    def test_synonym_search_roundtrip(self):
+        """Index with synonyms; search for either member matches."""
+        idx = IndexService(
+            "syn",
+            settings={
+                "number_of_shards": 1,
+                "analysis": {
+                    "analyzer": {
+                        "synned": {
+                            "type": "custom",
+                            "tokenizer": "standard",
+                            "filter": ["lowercase", "mysyn"],
+                        }
+                    },
+                    "filter": {
+                        "mysyn": {"type": "synonym", "synonyms": ["car, automobile"]}
+                    },
+                },
+            },
+            mappings_json={
+                "properties": {"body": {"type": "text", "analyzer": "synned"}}
+            },
+        )
+        idx.index_doc("1", {"body": "a red automobile"})
+        idx.refresh()
+        r = idx.search({"query": {"match": {"body": "car"}}})
+        assert r["hits"]["total"]["value"] == 1
+
+
+class TestHighlight:
+    @pytest.fixture(scope="class")
+    def idx(self):
+        idx = IndexService(
+            "hl",
+            settings={"number_of_shards": 1},
+            mappings_json={
+                "properties": {
+                    "title": {"type": "text"},
+                    "body": {"type": "text"},
+                }
+            },
+        )
+        idx.index_doc(
+            "1",
+            {
+                "title": "The quick brown fox",
+                "body": "The quick brown fox jumps over the lazy dog. "
+                "Far away, another fox watches the quick rabbit. " * 3,
+            },
+        )
+        idx.index_doc("2", {"title": "slow turtle", "body": "nothing relevant"})
+        idx.refresh()
+        return idx
+
+    def test_basic_highlight(self, idx):
+        r = idx.search(
+            {
+                "query": {"match": {"title": "quick fox"}},
+                "highlight": {"fields": {"title": {}}},
+            }
+        )
+        h = r["hits"]["hits"][0]
+        assert h["highlight"]["title"] == ["The <em>quick</em> brown <em>fox</em>"]
+
+    def test_custom_tags_and_fragments(self, idx):
+        r = idx.search(
+            {
+                "query": {"match": {"body": "fox"}},
+                "highlight": {
+                    "pre_tags": ["<b>"],
+                    "post_tags": ["</b>"],
+                    "fields": {"body": {"fragment_size": 40, "number_of_fragments": 2}},
+                },
+            }
+        )
+        frags = r["hits"]["hits"][0]["highlight"]["body"]
+        assert len(frags) == 2
+        for f in frags:
+            assert "<b>fox</b>" in f
+            assert len(f) < 120
+
+    def test_no_match_field_omitted(self, idx):
+        r = idx.search(
+            {
+                "query": {"match": {"title": "turtle"}},
+                "highlight": {"fields": {"title": {}, "body": {}}},
+            }
+        )
+        h = r["hits"]["hits"][0]
+        assert "title" in h["highlight"]
+        assert "body" not in h["highlight"]
+
+    def test_bool_and_multi_match_terms(self, idx):
+        r = idx.search(
+            {
+                "query": {
+                    "bool": {
+                        "must": [{"multi_match": {"query": "fox", "fields": ["title", "body"]}}],
+                        "filter": [{"match": {"body": "dog"}}],
+                    }
+                },
+                "highlight": {"fields": {"title": {}, "body": {"number_of_fragments": 1}}},
+            }
+        )
+        h = r["hits"]["hits"][0]
+        assert "<em>fox</em>" in h["highlight"]["title"][0]
+        # filter clause ("dog") must not highlight
+        assert all("dog</em>" not in f for f in h["highlight"]["body"])
+
+    def test_whole_field_mode(self, idx):
+        r = idx.search(
+            {
+                "query": {"match": {"title": "fox"}},
+                "highlight": {"fields": {"title": {"number_of_fragments": 0}}},
+            }
+        )
+        h = r["hits"]["hits"][0]
+        assert h["highlight"]["title"] == ["The quick brown <em>fox</em>"]
