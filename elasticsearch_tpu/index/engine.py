@@ -316,38 +316,67 @@ class ShardEngine:
 
     def flush(self) -> None:
         """Refresh + persist segments + atomic manifest commit + translog
-        trim (IndexShard.flush → Lucene commit + trimUnreferencedReaders)."""
+        trim (IndexShard.flush → Lucene commit + trimUnreferencedReaders).
+
+        Crash-safe commit protocol (the reference fsyncs every segment
+        file before the commit point and never mutates committed files):
+          1. every new segment dir is fully written AND fsynced first
+             (versions/seqnos sidecars are immutable per segment and are
+             written exactly once, with the segment);
+          2. mutable live-doc bitmaps go to fresh per-generation names
+             (``live-<gen>.npy``) — committed files are never rewritten;
+          3. the manifest referencing them is atomically replaced and the
+             shard directory fsynced;
+          4. only then is the translog trimmed and old files GC'd.
+        A power loss at any step leaves either the old commit (all its
+        files untouched) or the new one (all its files durable)."""
         with self._lock:
             self.refresh()
             self.op_stats["flush_total"] += 1
             if self.path is None:
                 return
+            from .segment import fsync_dir, fsync_path
+
             self.committed_generation += 1
             gen = self.committed_generation
             if self.translog is not None:
                 self.translog.roll_generation()
-            seg_dirs = []
+            seg_entries = []
             for si, seg in enumerate(self.segments):
                 name = self.seg_names[si]
                 seg_dir = os.path.join(self.path, name)
                 if not os.path.exists(os.path.join(seg_dir, "segment.json")):
-                    seg.save(seg_dir)
-                np.save(
-                    os.path.join(seg_dir, "versions.npy"), self.seg_versions[si]
-                )
-                np.save(os.path.join(seg_dir, "seqnos.npy"), self.seg_seqnos[si])
+                    # sidecars FIRST: segment.json is the "segment fully
+                    # persisted" sentinel (checked above), so everything
+                    # it references must be durable before seg.save
+                    # atomically commits it — otherwise a crash between
+                    # the two leaves a sentinel whose sidecars are torn
+                    # and the skip branch would never repair them
+                    os.makedirs(seg_dir, exist_ok=True)
+                    np.save(
+                        os.path.join(seg_dir, "versions.npy"),
+                        self.seg_versions[si],
+                    )
+                    np.save(
+                        os.path.join(seg_dir, "seqnos.npy"), self.seg_seqnos[si]
+                    )
+                    fsync_path(os.path.join(seg_dir, "versions.npy"))
+                    fsync_path(os.path.join(seg_dir, "seqnos.npy"))
+                    seg.save(seg_dir)  # fsyncs its files + dir, commits segment.json last
                 live = self.live_docs[si]
-                live_path = os.path.join(seg_dir, "live.npy")
+                live_gen = None
                 if live is not None:
+                    live_gen = gen
+                    live_path = os.path.join(seg_dir, f"live-{gen}.npy")
                     np.save(live_path, live)
-                elif os.path.exists(live_path):
-                    os.remove(live_path)
-                seg_dirs.append(name)
+                    fsync_path(live_path)
+                    fsync_dir(seg_dir)
+                seg_entries.append({"name": name, "live_gen": live_gen})
             committed_seq = self._next_seq - 1
             manifest = {
-                "format_version": 1,
+                "format_version": 2,
                 "generation": gen,
-                "segments": seg_dirs,
+                "segments": seg_entries,
                 "max_seq_no": committed_seq,
                 "primary_term": self.primary_term,
             }
@@ -359,18 +388,39 @@ class ShardEngine:
                 f.flush()
                 os.fsync(f.fileno())
             os.replace(tmp, os.path.join(self.path, "manifest.json"))
+            fsync_dir(self.path)
             self.committed_seq_no = committed_seq
             if self.translog is not None:
                 self.translog.trim_unreferenced(committed_seq)
-            self._gc_segments(seg_dirs)
+            self._gc_segments(seg_entries)
 
-    def _gc_segments(self, referenced: List[str]) -> None:
+    def _gc_segments(self, referenced: List[dict]) -> None:
         assert self.path is not None
-        keep = set(referenced) | {"translog"}
+        keep = {e["name"] for e in referenced} | {"translog"}
+        live_gens = {e["name"]: e["live_gen"] for e in referenced}
         for fname in os.listdir(self.path):
             full = os.path.join(self.path, fname)
-            if os.path.isdir(full) and fname not in keep:
+            if not os.path.isdir(full):
+                continue
+            if fname not in keep:
                 shutil.rmtree(full, ignore_errors=True)
+                continue
+            # drop superseded per-generation live bitmaps
+            want = live_gens.get(fname)
+            for sub in os.listdir(full):
+                if sub.startswith("live-") and sub.endswith(".npy"):
+                    g = sub[len("live-") : -len(".npy")]
+                    if not g.isdigit() or want is None or int(g) != want:
+                        try:
+                            os.remove(os.path.join(full, sub))
+                        except OSError:
+                            pass
+                elif sub == "live.npy" and want is not None:
+                    # pre-format-v2 mutable bitmap superseded by live-<gen>
+                    try:
+                        os.remove(os.path.join(full, sub))
+                    except OSError:
+                        pass
 
     def maybe_merge(self, max_segments: int = 8) -> bool:
         """Segment-count merge policy (TieredMergePolicy, crudely): when
@@ -424,7 +474,11 @@ class ShardEngine:
             self.committed_generation = manifest["generation"]
             committed_seq = manifest["max_seq_no"]
             self.primary_term = manifest.get("primary_term", self.primary_term)
-            for si, name in enumerate(manifest["segments"]):
+            for si, entry in enumerate(manifest["segments"]):
+                if isinstance(entry, str):  # format_version 1
+                    name, live_gen = entry, None
+                else:
+                    name, live_gen = entry["name"], entry.get("live_gen")
                 seg_dir = os.path.join(self.path, name)
                 seg = Segment.load(seg_dir)
                 self.segments.append(seg)
@@ -433,7 +487,10 @@ class ShardEngine:
                     np.load(os.path.join(seg_dir, "versions.npy"))
                 )
                 self.seg_seqnos.append(np.load(os.path.join(seg_dir, "seqnos.npy")))
-                live_path = os.path.join(seg_dir, "live.npy")
+                if live_gen is not None:
+                    live_path = os.path.join(seg_dir, f"live-{live_gen}.npy")
+                else:
+                    live_path = os.path.join(seg_dir, "live.npy")
                 self.live_docs.append(
                     np.load(live_path) if os.path.exists(live_path) else None
                 )

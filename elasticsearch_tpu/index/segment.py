@@ -191,12 +191,18 @@ class Segment:
             put(f"vec.{key}.exists", vf.exists)
 
         np.savez(os.path.join(path, "arrays.npz"), **arrays)
+        fsync_path(os.path.join(path, "arrays.npz"))
         with open(os.path.join(path, "docs.json"), "w") as f:
             json.dump({"doc_ids": self.doc_ids, "sources": self.sources}, f)
+            f.flush()
+            os.fsync(f.fileno())
         tmp = os.path.join(path, "segment.json.tmp")
         with open(tmp, "w") as f:
             json.dump(manifest, f)
+            f.flush()
+            os.fsync(f.fileno())
         os.replace(tmp, os.path.join(path, "segment.json"))
+        fsync_dir(path)
 
     @classmethod
     def load(cls, path: str) -> "Segment":
@@ -261,6 +267,24 @@ class Segment:
             vectors=vectors,
             generation=manifest.get("generation", 0),
         )
+
+
+def fsync_path(path: str) -> None:
+    """fsync an already-written file by path (durability before commit)."""
+    fd = os.open(path, os.O_RDONLY)
+    try:
+        os.fsync(fd)
+    finally:
+        os.close(fd)
+
+
+def fsync_dir(path: str) -> None:
+    """fsync a directory so its entries (renames, new files) are durable."""
+    fd = os.open(path, os.O_RDONLY)
+    try:
+        os.fsync(fd)
+    finally:
+        os.close(fd)
 
 
 def _fkey(fname: str) -> str:

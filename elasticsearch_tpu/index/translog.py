@@ -19,22 +19,31 @@ from __future__ import annotations
 
 import json
 import os
+import time as _time
 from typing import Iterator, Optional
 
 DURABILITY_REQUEST = "request"  # fsync before ack (default)
-DURABILITY_ASYNC = "async"  # fsync on a schedule / at close
+DURABILITY_ASYNC = "async"  # fsync at most sync_interval behind
+DEFAULT_SYNC_INTERVAL = 5.0  # index.translog.sync_interval default (5s)
 
 
 class Translog:
-    def __init__(self, path: str, durability: str = DURABILITY_REQUEST):
+    def __init__(
+        self,
+        path: str,
+        durability: str = DURABILITY_REQUEST,
+        sync_interval: float = DEFAULT_SYNC_INTERVAL,
+    ):
         self.dir = path
         self.durability = durability
+        self.sync_interval = sync_interval
         os.makedirs(path, exist_ok=True)
         ckp = self._read_checkpoint()
         self.generation = ckp.get("generation", 1)
         self.min_retained_seq_no = ckp.get("min_retained_seq_no", 0)
         self._file = open(self._gen_path(self.generation), "a", encoding="utf-8")
         self._ops_in_gen = 0
+        self._last_sync = _time.monotonic()
 
     # ---- paths ----
 
@@ -68,16 +77,26 @@ class Translog:
     # ---- write path ----
 
     def add(self, op: dict) -> None:
-        """Appends one operation (must carry ``seq_no``)."""
+        """Appends one operation (must carry ``seq_no``).
+
+        ``async`` durability bounds the acked-but-volatile window to
+        ``sync_interval`` (index.translog.sync_interval, default 5s) by
+        checking the clock on every append — no timer thread, but an
+        actively-written shard fsyncs at least every interval; an idle
+        shard's tail syncs at the next op, roll, or close."""
         self._file.write(json.dumps(op, separators=(",", ":")) + "\n")
         if self.durability == DURABILITY_REQUEST:
             self._file.flush()
             os.fsync(self._file.fileno())
+            self._last_sync = _time.monotonic()
+        elif _time.monotonic() - self._last_sync >= self.sync_interval:
+            self.sync()
         self._ops_in_gen += 1
 
     def sync(self) -> None:
         self._file.flush()
         os.fsync(self._file.fileno())
+        self._last_sync = _time.monotonic()
 
     # ---- generations ----
 

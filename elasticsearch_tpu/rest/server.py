@@ -17,7 +17,7 @@ import json
 import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional
-from urllib.parse import parse_qs, urlparse
+from urllib.parse import parse_qs, unquote, urlparse
 
 from ..cluster import ClusterError, ClusterService
 from ..index.engine import EngineError, VersionConflictError
@@ -65,6 +65,12 @@ class ElasticHandler(BaseHTTPRequestHandler):
         raw = self._read_body()
         head_only = method == "HEAD"
         route, params, path_exists = self.actions.router.dispatch(method, path)
+        # percent-decode extracted path params AFTER routing so an
+        # encoded %2F stays inside one path segment during dispatch but
+        # the handler sees the client's literal id ("a%20b" → "a b") —
+        # RestUtils.decodeComponent semantics
+        if params:
+            params = {k: unquote(v) for k, v in params.items()}
         if route is None:
             if path_exists:
                 self._respond(

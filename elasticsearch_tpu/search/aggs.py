@@ -217,14 +217,29 @@ class AggCollector:
         }
 
     def _collect_cardinality(self, node, masks):
-        """Exact distinct count; partials are numpy arrays (keyword terms
-        hash to uint64 so cross-segment/shard union needs no boxing).
-        Round 2: HLL++ sketch for true sublinear partials."""
+        """Exact distinct count; partials are numpy arrays so
+        cross-segment/shard union needs no boxing. Keyword terms hash
+        with a 64-bit murmur3 combination (stable across processes —
+        Python hash() is PYTHONHASHSEED-randomized — and wide enough
+        that birthday collisions stay negligible, unlike a single
+        32-bit hash); term hashes and numeric bit patterns live in
+        separate partial keys so they can never collide when reduced
+        together. Round 3: HLL++ sketch for sublinear partials."""
+        from ..utils.murmur3 import murmurhash3_x86_32
+
+        def _hash64(term: str) -> int:
+            b = term.encode("utf-8")
+            v = (murmurhash3_x86_32(b, seed=0) << 32) | murmurhash3_x86_32(
+                b, seed=0x9747B28C
+            )
+            return v - (1 << 64) if v >= (1 << 63) else v  # wrap to int64
+
         f = node.params.get("field")
         if f is None:
             raise AggParseError(f"agg [{node.name}] requires a field")
         mf = self.reader.mappings.get(f)
-        parts = []
+        term_parts = []
+        num_parts = []
         for si, mask in enumerate(masks):
             if mf is not None and mf.type in (KEYWORD, TEXT):
                 of = self._keyword_ords(si, f)
@@ -232,20 +247,29 @@ class AggCollector:
                     continue
                 sel_ords = np.unique(of.mv_ords[mask[self._entry_docs(si, of)]])
                 # hash terms so segments with different ord spaces merge
-                parts.append(
+                term_parts.append(
                     np.fromiter(
-                        (hash(of.ord_terms[o]) for o in sel_ords),
+                        (_hash64(of.ord_terms[o]) for o in sel_ords),
                         np.int64,
                         count=len(sel_ords),
                     )
                 )
             else:
                 v, e = self._numeric_values(si, f)
-                parts.append(np.unique(v[mask & e]).view(np.int64))
-        vals = (
-            np.unique(np.concatenate(parts)) if parts else np.zeros(0, np.int64)
-        )
-        return {"t": "cardinality", "values": vals}
+                num_parts.append(np.unique(v[mask & e]).view(np.int64))
+        return {
+            "t": "cardinality",
+            "terms": (
+                np.unique(np.concatenate(term_parts))
+                if term_parts
+                else np.zeros(0, np.int64)
+            ),
+            "nums": (
+                np.unique(np.concatenate(num_parts))
+                if num_parts
+                else np.zeros(0, np.int64)
+            ),
+        }
 
     def _collect_percentiles(self, node, masks):
         # exact percentiles: the partial keeps matched values as one numpy
@@ -556,8 +580,10 @@ def _reduce_node(node: AggNode, parts: List[dict]) -> dict:
             "sum": s,
         }
     if t == "cardinality":
-        arrays = [np.asarray(p["values"]) for p in parts if len(p["values"])]
-        n = len(np.unique(np.concatenate(arrays))) if arrays else 0
+        n = 0
+        for key in ("terms", "nums"):
+            arrays = [np.asarray(p[key]) for p in parts if len(p[key])]
+            n += len(np.unique(np.concatenate(arrays))) if arrays else 0
         return {"value": n}
     if t == "percentiles":
         vals = np.concatenate([np.asarray(p["values"]) for p in parts]) if parts else np.zeros(0)

@@ -257,17 +257,19 @@ class NumpyExecutor:
         score_arr: List[np.ndarray] = []
         key_cols: List[List[np.ndarray]] = [[] for _ in sort_specs]
         raw_cols: List[List[np.ndarray]] = [[] for _ in sort_specs]
+        doc_base = 0
         for si, (mask, scores) in enumerate(per_segment):
+            seg = self.reader.segments[si]
+            seg_base, doc_base = doc_base, doc_base + seg.num_docs
             idx = np.nonzero(mask)[0]
             if not len(idx):
                 continue
-            seg = self.reader.segments[si]
             seg_idx.append(np.full(len(idx), si))
             doc_idx.append(idx)
             score_arr.append(scores[idx])
             for ki, spec in enumerate(sort_specs):
                 sort_key, raw = _sort_key_values(
-                    spec, seg, idx, scores[idx], self.reader.mappings
+                    spec, seg, idx, scores[idx], self.reader.mappings, seg_base
                 )
                 if sort_key is None:  # string column: rank globally below
                     sort_key = np.zeros(0)
@@ -927,7 +929,7 @@ def parse_sort(sort_body) -> List[dict]:
     return specs
 
 
-def _sort_key_values(spec, seg, idx, scores, mappings):
+def _sort_key_values(spec, seg, idx, scores, mappings, doc_base=0):
     """(lexsort-ready key array, raw response values) for matching docs.
 
     Keys live in "ascending key space": desc orders negate the value, and
@@ -945,7 +947,10 @@ def _sort_key_values(spec, seg, idx, scores, mappings):
         raw = scores.astype(np.float64)
         return (-raw if desc else raw), raw
     if field == "_doc":
-        raw = idx.astype(np.float64)
+        # global doc id = cumulative segment docBase + local id, so
+        # cross-segment ordering is segment-major (Lucene docBase
+        # semantics) and search_after cursors are unambiguous
+        raw = (idx + doc_base).astype(np.float64)
         return (-raw if desc else raw), raw
     mf = mappings.get(field)
     if mf is not None and mf.type in (KEYWORD, TEXT):

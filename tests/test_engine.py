@@ -319,3 +319,51 @@ class TestClusterService:
         assert m.get("b").type == "keyword"
         with pytest.raises(ClusterError):
             cs.put_mapping("idx", {"properties": {"a": {"type": "long"}}})
+
+
+class TestCommitProtocol:
+    def test_committed_files_never_rewritten(self, tmp_path):
+        """Mutable state goes to per-generation live-<gen>.npy files; the
+        files of an existing commit are never modified in place."""
+        import os
+
+        p = str(tmp_path / "shardc")
+        e = make_engine(p)
+        e.index("1", {"body": "alpha fox", "n": 1})
+        e.index("2", {"body": "alpha dog", "n": 2})
+        e.flush()
+        seg_dir = os.path.join(p, e.seg_names[0])
+        mtimes = {
+            f: os.path.getmtime(os.path.join(seg_dir, f))
+            for f in os.listdir(seg_dir)
+        }
+        e.delete("2")
+        e.flush()  # writes live-<gen>.npy, must not touch committed files
+        for f, t in mtimes.items():
+            assert os.path.getmtime(os.path.join(seg_dir, f)) == t, f
+        live_files = [f for f in os.listdir(seg_dir) if f.startswith("live-")]
+        assert live_files == [f"live-{e.committed_generation}.npy"]
+        e.close()
+
+        e2 = make_engine(p)
+        assert e2.num_docs == 1
+        ids, _ = search_ids(e2, {"match": {"body": "alpha"}})
+        assert ids == ["1"]
+        e2.close()
+
+    def test_superseded_live_files_gced(self, tmp_path):
+        import os
+
+        p = str(tmp_path / "shardg")
+        e = make_engine(p)
+        for i in range(4):
+            e.index(str(i), {"body": f"doc {i}", "n": i})
+        e.flush()
+        seg_dir = os.path.join(p, e.seg_names[0])
+        e.delete("0")
+        e.flush()
+        e.delete("1")
+        e.flush()
+        live_files = [f for f in os.listdir(seg_dir) if f.startswith("live-")]
+        assert live_files == [f"live-{e.committed_generation}.npy"]
+        e.close()

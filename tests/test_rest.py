@@ -328,3 +328,18 @@ class TestPersistence:
         body = call("POST", "/persist/_search", {"query": {"match": {"body": "durable"}}})
         assert body["hits"]["total"]["value"] == 1
         srv2.close()
+
+
+class TestUrlEncoding:
+    def test_percent_encoded_doc_id_roundtrip(self, es):
+        # clients percent-encode ids; the server must store under the
+        # decoded id (RestUtils.decodeComponent semantics)
+        status, body = es("PUT", "/enc/_doc/a%20b", {"v": 1})
+        assert status == 201 and body["_id"] == "a b"
+        status, body = es("GET", "/enc/_doc/a%20b")
+        assert status == 200 and body["found"] is True and body["_id"] == "a b"
+        # non-ASCII id
+        status, body = es("PUT", "/enc/_doc/caf%C3%A9", {"v": 2})
+        assert status == 201 and body["_id"] == "café"
+        status, body = es("GET", "/enc/_doc/caf%C3%A9")
+        assert status == 200 and body["_source"] == {"v": 2}
