@@ -70,6 +70,11 @@ class IndexService:
             )
         # executor cache: shard id → (change_generation, executor)
         self._executors: Dict[int, tuple] = {}
+        # created eagerly (its worker thread only starts on first submit)
+        # so concurrent first searches can't race a lazy init
+        from ..search.batcher import QueryBatcher
+
+        self._batcher = QueryBatcher()
         # SearchStats (per-index totals; query_current omitted)
         self.search_stats = {
             "query_total": 0,
@@ -153,6 +158,7 @@ class IndexService:
         self.flush()
         for s in self.shards:
             s.close()
+        self._batcher.close()
 
     # ---- search (coordinator fan-out over local shards) ----
 
@@ -170,6 +176,23 @@ class IndexService:
             ex = NumpyExecutor(reader)
         self._executors[shard.shard_id] = (shard.change_generation, ex)
         return ex
+
+    def _search_batched(self, plan, k: int):
+        """Fan one request's shards into the micro-batching dispatcher
+        (they batch with each other AND with concurrent requests).
+        Returns (shard TopDocs list, executors) or None if any shard's
+        executor isn't a JaxExecutor."""
+        from ..search.batcher import QueryBatcher
+        from ..search.executor_jax import JaxExecutor
+
+        executors = [self._executor(s) for s in self.shards]
+        if not all(isinstance(ex, JaxExecutor) for ex in executors):
+            return None
+        try:
+            jobs = [self._batcher.submit(ex, plan, k) for ex in executors]
+            return [QueryBatcher.wait(j) for j in jobs], executors
+        except RuntimeError:
+            return None  # batcher closed mid-request → unbatched path
 
     def pin_executors(self) -> List:
         """Point-in-time executor snapshot (ReaderContext acquire): scroll
@@ -252,7 +275,32 @@ class IndexService:
         shard_sort_values: List[List[List]] = []
         profile = bool(body.get("profile"))
         shard_profiles = []
-        for shard_i, shard in enumerate(self.shards):
+        tth = body.get("track_total_hits", True)
+        # ---- batched fast path: flat match plans on the jax backend go
+        # through the cross-request micro-batching dispatcher (one
+        # [B,T,128] launch across concurrent requests) ----
+        if (
+            query is not None
+            and knn is None
+            and agg_nodes is None
+            and sort_specs is None
+            and search_after is None
+            and min_score is None
+            and not profile
+            and pinned_executors is None
+            and str(self.settings.get("search.backend")) == "jax"
+        ):
+            from ..search.batcher import extract_match_plan
+
+            plan = extract_match_plan(
+                query, self.mappings, self.analysis, tth_capped=(tth is False)
+            )
+            if plan is not None:
+                batched = self._search_batched(plan, from_ + size)
+                if batched is not None:
+                    shard_results, executors = batched
+                    shard_sort_values = [[] for _ in shard_results]
+        for shard_i, shard in enumerate(self.shards if not shard_results else ()):
             ts = time.perf_counter_ns()
             ex = (
                 pinned_executors[shard_i]

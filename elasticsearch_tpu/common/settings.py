@@ -100,7 +100,9 @@ INDEX_SETTINGS: Dict[str, Setting] = {
         Setting("number_of_replicas", 1, INDEX_SCOPE, parser=int,
                 validator=_non_negative("number_of_replicas")),
         Setting("refresh_interval", "1s", INDEX_SCOPE, parser=_parse_time),
-        Setting("search.backend", "numpy", INDEX_SCOPE, dynamic=False),
+        # jax is the production default (round-2): the REST serving path
+        # runs on the device kernels; "numpy" selects the CPU oracle
+        Setting("search.backend", "jax", INDEX_SCOPE, dynamic=False),
         Setting("max_result_window", 10000, INDEX_SCOPE, parser=int,
                 validator=_positive("max_result_window")),
         Setting("translog.durability", "request", INDEX_SCOPE),

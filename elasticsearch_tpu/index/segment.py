@@ -74,6 +74,16 @@ class PostingsField:
     tile_min_norm: np.ndarray  # uint8[n_tiles] min norm byte in tile
     norms: np.ndarray  # uint8[N] SmallFloat-encoded field length per doc
     stats: FieldStats = field(default_factory=FieldStats)
+    # columnar positions (text fields; None for keyword/legacy segments).
+    # Compact CSR aligned to posting order: posting k of term t lives at
+    # global posting index term_pos_start[t] + k, and its sorted positions
+    # are pos_data[pos_offsets[p] : pos_offsets[p+1]]. This is the tiled
+    # analog of Lucene's PositionsEnum — decoded once at index build, so
+    # match_phrase never re-analyzes stored _source (SURVEY.md §2.5
+    # postings row; VERDICT round-1 weak #5).
+    term_pos_start: Optional[np.ndarray] = None  # int64[n_terms]
+    pos_offsets: Optional[np.ndarray] = None  # int64[sum(df)+1]
+    pos_data: Optional[np.ndarray] = None  # int32[sum(tf)]
     _term_index: Optional[Dict[str, int]] = None
 
     def term_id(self, term: str) -> int:
@@ -84,6 +94,28 @@ class PostingsField:
     @property
     def n_tiles(self) -> int:
         return self.doc_ids.shape[0]
+
+    @property
+    def has_positions(self) -> bool:
+        return self.pos_data is not None
+
+    def term_docs(self, tid: int) -> np.ndarray:
+        """Compact (unpadded) sorted doc-id list for one term."""
+        start = int(self.term_tile_start[tid])
+        count = int(self.term_tile_count[tid])
+        return self.doc_ids[start : start + count].ravel()[: int(self.term_df[tid])]
+
+    def doc_positions(self, tid: int, doc: int) -> Optional[np.ndarray]:
+        """Sorted positions of term `tid` in local doc `doc`, or None if
+        the term does not occur there (or positions are absent)."""
+        if self.pos_data is None:
+            return None
+        docs = self.term_docs(tid)
+        k = int(np.searchsorted(docs, doc))
+        if k >= len(docs) or docs[k] != doc:
+            return None
+        p = int(self.term_pos_start[tid]) + k
+        return self.pos_data[self.pos_offsets[p] : self.pos_offsets[p + 1]]
 
 
 @dataclass
@@ -172,6 +204,11 @@ class Segment:
             put(f"{key}.tile_max_tf", pf.tile_max_tf)
             put(f"{key}.tile_min_norm", pf.tile_min_norm)
             put(f"{key}.norms", pf.norms)
+            if pf.has_positions:
+                manifest["postings"][fname]["positions"] = True
+                put(f"{key}.term_pos_start", pf.term_pos_start)
+                put(f"{key}.pos_offsets", pf.pos_offsets)
+                put(f"{key}.pos_data", pf.pos_data)
         for fname, nf in self.numerics.items():
             key = _fkey(fname)
             put(f"num.{key}.values", nf.values)
@@ -227,6 +264,15 @@ class Segment:
                 tile_min_norm=data[f"{key}.tile_min_norm"],
                 norms=data[f"{key}.norms"],
                 stats=FieldStats(**meta["stats"]),
+                term_pos_start=(
+                    data[f"{key}.term_pos_start"] if meta.get("positions") else None
+                ),
+                pos_offsets=(
+                    data[f"{key}.pos_offsets"] if meta.get("positions") else None
+                ),
+                pos_data=(
+                    data[f"{key}.pos_data"] if meta.get("positions") else None
+                ),
             )
         numerics = {
             fname: NumericField(
@@ -336,10 +382,10 @@ class SegmentBuilder:
         ordinals: Dict[str, OrdinalField] = {}
         vectors: Dict[str, VectorField] = {}
 
-        # ---- indexed text fields → tiled postings with tf + norms ----
+        # ---- indexed text fields → tiled postings with tf + positions ----
         text_fields = sorted({f for d in docs for f in d.text_terms})
         for fname in text_fields:
-            inv: Dict[str, Dict[int, int]] = {}
+            inv_pos: Dict[str, Dict[int, List[int]]] = {}
             lengths = np.zeros(n, dtype=np.int64)
             doc_count = 0
             for local_id, d in enumerate(docs):
@@ -348,10 +394,17 @@ class SegmentBuilder:
                     continue
                 doc_count += 1
                 lengths[local_id] = d.field_lengths.get(fname, len(terms))
-                for term, _pos in terms:
-                    inv.setdefault(term, {})
-                    inv[term][local_id] = inv[term].get(local_id, 0) + 1
-            postings[fname] = self._build_postings(inv, lengths, n, doc_count)
+                for term, pos in terms:
+                    inv_pos.setdefault(term, {}).setdefault(local_id, []).append(
+                        pos
+                    )
+            inv = {
+                t: {d: len(ps) for d, ps in pl.items()}
+                for t, pl in inv_pos.items()
+            }
+            pf = self._build_postings(inv, lengths, n, doc_count)
+            self._attach_positions(pf, inv_pos)
+            postings[fname] = pf
 
         # ---- keyword fields → postings (tf=1) + ordinals ----
         kw_fields = sorted({f for d in docs for f in d.keyword_terms})
@@ -480,6 +533,36 @@ class SegmentBuilder:
             tile_min_norm=tile_min_norm,
             norms=norms,
             stats=stats,
+        )
+
+    @staticmethod
+    def _attach_positions(
+        pf: PostingsField, inv_pos: Dict[str, Dict[int, List[int]]]
+    ) -> None:
+        """Builds the compact-CSR position arrays aligned with posting
+        order: term t's posting k (k-th doc in sorted doc order) owns the
+        slice pos_offsets[term_pos_start[t]+k : +1] of pos_data."""
+        n_terms = len(pf.terms)
+        term_pos_start = np.zeros(n_terms, dtype=np.int64)
+        if n_terms > 1:
+            np.cumsum(pf.term_df[:-1].astype(np.int64), out=term_pos_start[1:])
+        total_postings = int(pf.term_df.sum())
+        pos_offsets = np.zeros(total_postings + 1, dtype=np.int64)
+        chunks: List[List[int]] = []
+        p = 0
+        for tid, term in enumerate(pf.terms):
+            plist = inv_pos[term]
+            for d in sorted(plist):
+                ps = sorted(plist[d])
+                chunks.append(ps)
+                pos_offsets[p + 1] = pos_offsets[p] + len(ps)
+                p += 1
+        pf.term_pos_start = term_pos_start
+        pf.pos_offsets = pos_offsets
+        pf.pos_data = (
+            np.concatenate([np.asarray(c, np.int32) for c in chunks])
+            if chunks
+            else np.zeros(0, np.int32)
         )
 
     @staticmethod

@@ -93,7 +93,7 @@ class BatchedScoreResult(NamedTuple):
     totals: jax.Array  # int32[B] number of matching docs
 
 
-def make_batched_bm25_scorer(doc_ids, tfs, inv_norm, n_docs: int, k: int):
+def make_batched_bm25_scorer(doc_ids, tfs, inv_norm, n_docs: int, k: int, live=None):
     """Builds a jitted batched scorer closed over HBM-resident postings.
 
     Scores B queries in one launch: gathers [B, T, 128] tiles, BM25s them
@@ -101,11 +101,14 @@ def make_batched_bm25_scorer(doc_ids, tfs, inv_norm, n_docs: int, k: int):
     returns per-query top-k. One compilation per (B, T) bucket.
 
     Args live on device: doc_ids/tfs int32[n_tiles, 128], inv_norm
-    float32[n_docs].
+    float32[n_docs]; optional live bool[n_docs] soft-delete bitmap folded
+    into the match mask (Lucene liveDocs).
     """
     doc_ids = jnp.asarray(doc_ids)
     tfs = jnp.asarray(tfs)
     inv_norm = jnp.asarray(inv_norm, jnp.float32)
+    live = jnp.asarray(live) if live is not None else None
+    k = min(k, n_docs)  # top_k cannot exceed the segment's doc count
 
     @jax.jit
     def score_batch(
@@ -120,6 +123,8 @@ def make_batched_bm25_scorer(doc_ids, tfs, inv_norm, n_docs: int, k: int):
         def one(rd, rt, w, v, m):
             scores, cnt = _score_tiles_inner(rd, rt, w, v, inv_norm, n_docs)
             mask = cnt >= jnp.maximum(m, 1)
+            if live is not None:
+                mask = mask & live
             s, d = topk_hits(scores, mask, k)
             return s, d, mask.sum().astype(jnp.int32)
 
@@ -150,19 +155,14 @@ def _score_tiles_inner(doc_rows, tf_rows, tile_weights, tile_valid, inv_norm, n_
 # ---------------- kNN ----------------
 
 
-@functools.partial(jax.jit, static_argnames=("similarity", "k"))
-def knn_topk(
+@functools.partial(jax.jit, static_argnames=("similarity",))
+def knn_scores(
     queries: jax.Array,  # float32[B, d]
     vectors: jax.Array,  # float32[N, d] (unit-normalized for cosine)
-    exists: jax.Array,  # bool[N]
     similarity: str,
-    k: int,
-) -> Tuple[jax.Array, jax.Array]:
-    """Brute-force kNN: one MXU matmul + top_k per query batch.
-
-    Score transforms mirror Lucene VectorSimilarityFunction as mapped by
-    DenseVectorFieldMapper (see models/similarity.py).
-    """
+) -> jax.Array:
+    """Dense [B, N] similarity scores: one MXU matmul + the Lucene
+    VectorSimilarityFunction transform (see models/similarity.py)."""
     if similarity == "l2_norm":
         # ||q - v||² = |q|² + |v|² - 2 q·v — matmul-friendly
         dots = queries @ vectors.T
@@ -181,5 +181,18 @@ def knn_topk(
             scores = jnp.where(dots < 0, 1.0 / (1.0 - dots), dots + 1.0)
         else:
             raise ValueError(f"unknown similarity [{similarity}]")
-    scores = jnp.where(exists[None, :], scores.astype(jnp.float32), -jnp.inf)
+    return scores.astype(jnp.float32)
+
+
+@functools.partial(jax.jit, static_argnames=("similarity", "k"))
+def knn_topk(
+    queries: jax.Array,  # float32[B, d]
+    vectors: jax.Array,  # float32[N, d] (unit-normalized for cosine)
+    exists: jax.Array,  # bool[N]
+    similarity: str,
+    k: int,
+) -> Tuple[jax.Array, jax.Array]:
+    """Brute-force kNN: one MXU matmul + top_k per query batch."""
+    scores = knn_scores(queries, vectors, similarity)
+    scores = jnp.where(exists[None, :], scores, -jnp.inf)
     return jax.lax.top_k(scores, k)

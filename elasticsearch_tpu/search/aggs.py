@@ -229,9 +229,12 @@ class AggCollector:
 
         def _hash64(term: str) -> int:
             b = term.encode("utf-8")
-            v = (murmurhash3_x86_32(b, seed=0) << 32) | murmurhash3_x86_32(
-                b, seed=0x9747B28C
-            )
+            # mask both halves unsigned BEFORE combining: murmur3_x86_32
+            # returns Java-signed ints, and a negative low word would
+            # sign-extend over (and erase) the high word
+            hi = murmurhash3_x86_32(b, seed=0) & 0xFFFFFFFF
+            lo = murmurhash3_x86_32(b, seed=0x9747B28C) & 0xFFFFFFFF
+            v = (hi << 32) | lo
             return v - (1 << 64) if v >= (1 << 63) else v  # wrap to int64
 
         f = node.params.get("field")

@@ -1,0 +1,268 @@
+"""Cross-request micro-batching dispatcher — the serving-path bridge to
+the batched TPU kernels.
+
+Reference analog: there is none in Elasticsearch — Lucene scores one
+query per thread. This is the north-star departure (BASELINE.json:
+"score query batches in parallel"): concurrent `_search` requests whose
+query compiles to a flat weighted-term plan are collected into ONE
+[B, T, 128] kernel launch per (segment, field) instead of B separate
+launches. The dispatcher uses continuous batching: while one batch is
+executing on device, arriving requests queue; the worker drains the
+whole queue the moment it frees up, so there is no linger timer and no
+added idle latency for a lone request.
+
+When a request does not need exact totals (track_total_hits: false) the
+group is scored through the block-max WAND scorer (ops/wand.py) instead
+— same results for top-k, a fraction of the HBM traffic.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ..index.mapping import TEXT
+from ..ops import scoring
+from . import dsl
+from .executor import Hit, TopDocs
+
+MAX_BATCH = 64
+
+
+@dataclass(frozen=True)
+class MatchPlan:
+    """A query reduced to flat weighted terms over one text field."""
+
+    field: str
+    terms: Tuple[str, ...]
+    msm: int  # minimum matching terms (1 = OR, len(terms) = AND)
+    boost: float
+    wand_ok: bool  # caller does not need exact totals → pruning allowed
+
+
+def extract_match_plan(
+    query, mappings, analysis, tth_capped: bool
+) -> Optional[MatchPlan]:
+    """Returns a MatchPlan when `query` is a match query over a text
+    field (the hot REST shape), else None → normal executor path."""
+    if not isinstance(query, dsl.MatchQuery):
+        return None
+    mf = mappings.get(query.field)
+    if mf is None or mf.type != TEXT:
+        return None
+    analyzer_name = query.analyzer or mf.search_analyzer or mf.analyzer
+    try:
+        terms = analysis.get(analyzer_name).terms(query.query)
+    except ValueError:
+        return None
+    if not terms:
+        return None
+    if query.operator == "and":
+        msm = len(terms)
+    else:
+        msm = max(
+            1, dsl.parse_minimum_should_match(query.minimum_should_match, len(terms))
+        )
+    wand_ok = tth_capped and query.boost == 1.0 and msm == 1
+    return MatchPlan(
+        field=query.field,
+        terms=tuple(terms),
+        msm=msm,
+        boost=query.boost,
+        wand_ok=wand_ok,
+    )
+
+
+class _Job:
+    __slots__ = ("executor", "plan", "k", "event", "result", "error")
+
+    def __init__(self, executor, plan: MatchPlan, k: int):
+        self.executor = executor
+        self.plan = plan
+        self.k = k
+        self.event = threading.Event()
+        self.result: Optional[TopDocs] = None
+        self.error: Optional[BaseException] = None
+
+
+class QueryBatcher:
+    """One dispatcher thread per index: REST worker threads submit jobs
+    and block; the worker scores whole groups in single launches."""
+
+    def __init__(self, max_batch: int = MAX_BATCH):
+        self.max_batch = max_batch
+        self._queue: "queue.Queue[_Job]" = queue.Queue()
+        self._thread: Optional[threading.Thread] = None
+        self._closed = False
+        self._lock = threading.Lock()
+        # observability: how many launches / jobs / batched jobs
+        self.stats = {"launches": 0, "jobs": 0, "max_batch_seen": 0}
+
+    def _ensure_thread(self):
+        with self._lock:
+            if self._thread is None or not self._thread.is_alive():
+                self._thread = threading.Thread(
+                    target=self._run, name="query-batcher", daemon=True
+                )
+                self._thread.start()
+
+    def close(self):
+        self._closed = True
+        if self._thread is not None:
+            self._queue.put(None)  # wake the worker
+        # fail anything still queued so no submitter blocks forever
+        while True:
+            try:
+                j = self._queue.get_nowait()
+            except queue.Empty:
+                break
+            if j is not None:
+                j.error = RuntimeError("query batcher closed")
+                j.event.set()
+
+    # ---- client side ----
+
+    def submit(self, executor, plan: MatchPlan, k: int) -> _Job:
+        if self._closed:
+            raise RuntimeError("query batcher closed")
+        job = _Job(executor, plan, k)
+        self._ensure_thread()
+        self._queue.put(job)
+        if self._closed:
+            # lost the race with close(): make sure nobody hangs
+            self.close()
+        return job
+
+    def execute(self, executor, plan: MatchPlan, k: int) -> TopDocs:
+        job = self.submit(executor, plan, k)
+        return self.wait(job)
+
+    @staticmethod
+    def wait(job: _Job) -> TopDocs:
+        job.event.wait()
+        if job.error is not None:
+            raise job.error
+        return job.result
+
+    # ---- worker side ----
+
+    def _run(self):
+        while not self._closed:
+            job = self._queue.get()
+            if job is None:
+                continue
+            if self._closed:
+                job.error = RuntimeError("query batcher closed")
+                job.event.set()
+                continue
+            batch = [job]
+            while len(batch) < self.max_batch:
+                try:
+                    j = self._queue.get_nowait()
+                except queue.Empty:
+                    break
+                if j is not None:
+                    batch.append(j)
+            self.stats["jobs"] += len(batch)
+            self.stats["max_batch_seen"] = max(
+                self.stats["max_batch_seen"], len(batch)
+            )
+            # group jobs that can share one launch
+            groups: Dict[Tuple, List[_Job]] = {}
+            for j in batch:
+                kb = max(16, scoring.next_bucket(j.k, 16))
+                key = (id(j.executor), j.plan.field, kb, j.plan.wand_ok)
+                groups.setdefault(key, []).append(j)
+            for (eid, field, kb, wand), jobs in groups.items():
+                try:
+                    self._run_group(jobs, field, kb, wand)
+                except BaseException as e:  # surface to all waiters
+                    for j in jobs:
+                        j.error = e
+                        j.event.set()
+
+    def _run_group(self, jobs: List[_Job], field: str, kb: int, wand: bool):
+        ex = jobs[0].executor
+        reader = ex.reader
+        n_segments = len(reader.segments)
+        # per segment: one batched launch over all jobs in the group
+        per_job_cands: List[List[Tuple[float, int, int]]] = [[] for _ in jobs]
+        totals = np.zeros(len(jobs), np.int64)
+        # pad the batch dimension to a power-of-two bucket too, or every
+        # distinct concurrent batch size would trigger its own XLA
+        # compile (the scorer's contract is one compile per (B, T) pair)
+        B = scoring.next_bucket(len(jobs), 1)
+        for si in range(n_segments):
+            if wand:
+                scorer = ex.wand_scorer(si, field, kb)
+                if scorer is not None:
+                    term_lists = [list(j.plan.terms) for j in jobs]
+                    term_lists += [[] for _ in range(B - len(jobs))]
+                    s, d, t, _stats = scorer.search_batch(term_lists)
+                    self.stats["launches"] += 1
+                    self._collect(jobs, per_job_cands, totals, si, s, d, t)
+                    continue
+                # fall through (deleted docs present / no postings)
+            scorer = ex.batched_scorer(si, field, kb)
+            if scorer is None:
+                continue
+            tiles = [
+                ex.term_tiles(si, field, list(j.plan.terms), j.plan.boost)
+                for j in jobs
+            ]
+            T = scoring.next_bucket(max((len(t[0]) for t in tiles), default=1))
+            ti = np.zeros((B, T), np.int32)
+            tw = np.zeros((B, T), np.float32)
+            tv = np.zeros((B, T), bool)
+            for bi, (idx, w) in enumerate(tiles):
+                t = len(idx)
+                ti[bi, :t] = idx
+                tw[bi, :t] = w
+                tv[bi, :t] = True
+            msm = np.ones(B, np.int32)
+            msm[: len(jobs)] = [j.plan.msm for j in jobs]
+            res = scorer(ti, tw, tv, msm)
+            self.stats["launches"] += 1
+            self._collect(
+                jobs,
+                per_job_cands,
+                totals,
+                si,
+                np.asarray(res.scores),
+                np.asarray(res.docs),
+                np.asarray(res.totals),
+            )
+        # merge across segments per job: score desc, (segment, doc) asc
+        for bi, j in enumerate(jobs):
+            cands = per_job_cands[bi]
+            cands.sort(key=lambda c: (-c[0], c[1], c[2]))
+            page = cands[: j.k]
+            hits = [
+                Hit(
+                    score=s,
+                    segment=si,
+                    local_doc=d,
+                    doc_id=reader.segments[si].doc_ids[d],
+                )
+                for s, si, d in page
+            ]
+            j.result = TopDocs(
+                total=int(totals[bi]),
+                hits=hits,
+                max_score=hits[0].score if hits else None,
+            )
+            j.event.set()
+
+    @staticmethod
+    def _collect(jobs, per_job_cands, totals, si, s, d, t):
+        for bi in range(len(jobs)):
+            srow = s[bi]
+            drow = d[bi]
+            finite = np.isfinite(srow)
+            for sc, doc in zip(srow[finite], drow[finite]):
+                per_job_cands[bi].append((float(sc), si, int(doc)))
+            totals[bi] += int(t[bi])

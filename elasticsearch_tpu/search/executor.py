@@ -482,14 +482,15 @@ class NumpyExecutor:
             mask |= m
         return mask, np.where(mask, np.float32(q.boost), 0).astype(np.float32)
 
-    def _exec_fuzzy(self, q: "dsl.FuzzyQuery", seg: Segment) -> Tuple[np.ndarray, np.ndarray]:
-        n = seg.num_docs
+    def _fuzzy_terms(self, q: "dsl.FuzzyQuery", seg: Segment) -> List[str]:
+        """FuzzyQuery expansion against the term dictionary (bounded by
+        max_expansions, Lucene FuzzyTermsEnum semantics)."""
         pf = seg.postings.get(q.field)
         if pf is None:
-            return np.zeros(n, bool), np.zeros(n, np.float32)
+            return []
         max_edits = _fuzziness_edits(q.fuzziness, q.value)
         prefix = q.value[: q.prefix_length]
-        cands = []
+        cands: List[str] = []
         for t in pf.terms:
             if abs(len(t) - len(q.value)) > max_edits:
                 continue
@@ -499,6 +500,11 @@ class NumpyExecutor:
                 cands.append(t)
                 if len(cands) >= q.max_expansions:
                     break
+        return cands
+
+    def _exec_fuzzy(self, q: "dsl.FuzzyQuery", seg: Segment) -> Tuple[np.ndarray, np.ndarray]:
+        n = seg.num_docs
+        cands = self._fuzzy_terms(q, seg)
         mask = np.zeros(n, bool)
         for t in cands:
             m, _ = self._score_term_dense(seg, q.field, t, 1.0)
@@ -676,17 +682,35 @@ class NumpyExecutor:
                        analyzer=analyzer_name, boost=q.boost),
             seg,
         )
-        # position verification against re-analyzed stored source
+        # position verification against the columnar position index
+        # (Lucene PositionsEnum semantics) — never re-analyzes _source
         qpos = [t.position for t in qtoks]
         rel = [p - qpos[0] for p in qpos]
         mask = np.zeros(n, bool)
+        pf = seg.postings.get(q.field)
+        if pf is not None and pf.has_positions:
+            tids = [pf.term_id(t) for t in terms]
+            for doc in np.nonzero(conj)[0]:
+                pos_of: Dict[str, List[int]] = {}
+                ok = True
+                for t, tid in zip(terms, tids):
+                    if t in pos_of:
+                        continue
+                    ps = pf.doc_positions(tid, int(doc)) if tid >= 0 else None
+                    if ps is None:
+                        ok = False
+                        break
+                    pos_of[t] = ps.tolist()
+                mask[doc] = ok and _phrase_match(pos_of, terms, rel, q.slop)
+            return mask, np.where(mask, scores, 0).astype(np.float32)
+        # legacy segments without stored positions: re-analyze _source
         for doc in np.nonzero(conj)[0]:
             src = seg.sources[doc] or {}
             value = _extract_field(src, q.field)
             ok = False
             for v in value:
                 toks = analyzer.analyze(str(v))
-                pos_of: Dict[str, List[int]] = {}
+                pos_of = {}
                 for t in toks:
                     pos_of.setdefault(t.text, []).append(t.position)
                 if _phrase_match(pos_of, terms, rel, q.slop):

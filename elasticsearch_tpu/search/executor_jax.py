@@ -59,6 +59,7 @@ class DeviceSegment:
         self.postings: Dict[str, DevicePostings] = {}
         self.numerics: Dict[str, Tuple[jax.Array, jax.Array]] = {}
         self.vectors: Dict[str, Tuple[jax.Array, jax.Array]] = {}
+        self.ordinals: Dict[str, Tuple[jax.Array, jax.Array]] = {}
         for fname, pf in seg.postings.items():
             self.postings[fname] = DevicePostings(pf, device)
         for fname, nf in seg.numerics.items():
@@ -71,6 +72,12 @@ class DeviceSegment:
             self.vectors[fname] = (
                 jax.device_put(mat, device),
                 jax.device_put(vf.exists, device),
+            )
+        for fname, of in seg.ordinals.items():
+            # multi-value ordinal CSR for device range/terms masks
+            self.ordinals[fname] = (
+                jax.device_put(of.mv_ords, device),
+                jax.device_put(of.mv_offsets.astype(np.int32), device),
             )
 
 
@@ -93,6 +100,12 @@ class JaxExecutor:
         # (match_phrase position verification)
         self._oracle = NumpyExecutor(reader, k1, b)
         self._inv_norm_cache: Dict[Tuple[int, str], jax.Array] = {}
+        self._id_maps: Dict[int, Dict[str, int]] = {}
+        # batched-scorer / block-max caches keyed (si, field, k): reused
+        # across requests for the lifetime of this executor (= one reader
+        # generation)
+        self._batched_scorers: Dict[Tuple[int, str, int], object] = {}
+        self._wand_scorers: Dict[Tuple[int, str, int], object] = {}
 
     # ---- per-(segment, field) dense inverse-norm array ----
 
@@ -227,12 +240,18 @@ class JaxExecutor:
         if isinstance(q, MultiMatchQuery):
             return self._exec_multi_match(q, si)
         if isinstance(q, MatchPhraseQuery):
-            # positions are host-side in round 1 → oracle result uploaded
-            hm, hs = self._oracle._exec(q, seg)
-            return jnp.asarray(hm), jnp.asarray(hs)
+            return self._exec_phrase(q, si)
         if isinstance(q, KnnQueryWrapper):
-            hm, hs = self._oracle._exec_knn(q.knn, si, seg)
-            return jnp.asarray(hm), jnp.asarray(hs)
+            return self._exec_knn_query(q.knn, si)
+        if isinstance(q, dsl.IdsQuery):
+            return self._exec_ids(q, si)
+        if isinstance(
+            q, (dsl.PrefixQuery, dsl.WildcardQuery, dsl.RegexpQuery, dsl.FuzzyQuery)
+        ):
+            # MultiTermQuery constant-score rewrite: dictionary expansion
+            # stays on the host (as the reference's rewrites do), but the
+            # expanded terms score as ONE device kernel launch
+            return self._exec_expanded(q, si)
         if isinstance(q, dsl.DisMaxQuery):
             masks, scores = [], []
             for sub in q.queries:
@@ -252,18 +271,16 @@ class JaxExecutor:
 
     # ---- text leaves via the tile kernel ----
 
-    def _field_terms_scored(
+    def term_tiles(
         self, si: int, field: str, terms: List[str], boost: float
-    ) -> Tuple[jax.Array, jax.Array]:
-        """(scores, match_counts) for a list of terms in one field."""
-        seg = self.reader.segments[si]
-        n = seg.num_docs
-        pf = seg.postings.get(field)
-        dp = self.device_segments[si].postings.get(field)
-        if pf is None or dp is None:
-            return jnp.zeros(n, jnp.float32), jnp.zeros(n, jnp.int32)
+    ) -> Tuple[List[int], List[float]]:
+        """Unpadded (tile indices, per-tile weights) for terms in one
+        field of one segment — the host-side query plan the kernels eat."""
+        pf = self.reader.segments[si].postings.get(field)
         tile_idx: List[int] = []
         tile_w: List[float] = []
+        if pf is None:
+            return tile_idx, tile_w
         for t in terms:
             tid = pf.term_id(t)
             if tid < 0:
@@ -273,6 +290,18 @@ class JaxExecutor:
             w = np.float32(boost) * np.float32(self._oracle._term_weight(field, t))
             tile_idx.extend(range(start, start + count))
             tile_w.extend([float(w)] * count)
+        return tile_idx, tile_w
+
+    def _field_terms_scored(
+        self, si: int, field: str, terms: List[str], boost: float
+    ) -> Tuple[jax.Array, jax.Array]:
+        """(scores, match_counts) for a list of terms in one field."""
+        seg = self.reader.segments[si]
+        n = seg.num_docs
+        dp = self.device_segments[si].postings.get(field)
+        if dp is None:
+            return jnp.zeros(n, jnp.float32), jnp.zeros(n, jnp.int32)
+        tile_idx, tile_w = self.term_tiles(si, field, terms, boost)
         if not tile_idx:
             return jnp.zeros(n, jnp.float32), jnp.zeros(n, jnp.int32)
         idx, w, v = scoring.pad_tiles(
@@ -285,6 +314,56 @@ class JaxExecutor:
             rows_doc, rows_tf, jnp.asarray(w), jnp.asarray(v), inv_norm, n
         )
         return scores, cnt
+
+    def batched_scorer(self, si: int, field: str, k: int):
+        """Cached jitted batched scorer over one segment's postings —
+        closes over the device arrays + live bitmap for this reader
+        generation. Returns None when the field has no postings."""
+        key = (si, field, k)
+        sc = self._batched_scorers.get(key)
+        if sc is None:
+            seg = self.reader.segments[si]
+            dp = self.device_segments[si].postings.get(field)
+            if dp is None:
+                return None
+            live = self.reader.live_docs[si]
+            sc = scoring.make_batched_bm25_scorer(
+                dp.doc_ids,
+                dp.tfs,
+                self._inv_norm(si, field, seg.num_docs),
+                seg.num_docs,
+                k,
+                live,
+            )
+            self._batched_scorers[key] = sc
+        return sc
+
+    def wand_scorer(self, si: int, field: str, k: int):
+        """Cached block-max WAND scorer (exact pruned top-k) for one
+        segment. Only valid when the segment has no deleted docs (the
+        block bounds don't account for liveDocs)."""
+        if self.reader.live_docs[si] is not None:
+            return None
+        key = (si, field, k)
+        sc = self._wand_scorers.get(key)
+        if sc is None:
+            from ..ops.wand import BlockMaxIndex, BlockMaxScorer
+
+            seg = self.reader.segments[si]
+            pf = seg.postings.get(field)
+            if pf is None:
+                return None
+            idx_key = (si, field)
+            bidx = getattr(self, "_wand_indexes", None)
+            if bidx is None:
+                self._wand_indexes = bidx = {}
+            index = bidx.get(idx_key)
+            if index is None:
+                index = BlockMaxIndex(pf, seg.num_docs, k1=self.k1, b=self.b)
+                bidx[idx_key] = index
+            sc = BlockMaxScorer(index, k=k)
+            self._wand_scorers[key] = sc
+        return sc
 
     def _exec_match(self, q: MatchQuery, si: int) -> Tuple[jax.Array, jax.Array]:
         seg = self.reader.segments[si]
@@ -308,13 +387,138 @@ class JaxExecutor:
             mask = cnt >= msm
         return mask, jnp.where(mask, scores, 0.0)
 
+    def _id_map(self, si: int) -> Dict[str, int]:
+        """_id → local doc hash map per segment (built once; the analog
+        of Lucene's per-segment terms dict on the _id field)."""
+        m = self._id_maps.get(si)
+        if m is None:
+            m = {d: i for i, d in enumerate(self.reader.segments[si].doc_ids)}
+            self._id_maps[si] = m
+        return m
+
+    def _exec_ids(self, q, si: int) -> Tuple[jax.Array, jax.Array]:
+        seg = self.reader.segments[si]
+        n = seg.num_docs
+        idmap = self._id_map(si)
+        mask = np.zeros(n, bool)
+        for v in q.values:
+            loc = idmap.get(str(v))
+            if loc is not None:
+                mask[loc] = True
+        dmask = jnp.asarray(mask)
+        return dmask, jnp.where(dmask, jnp.float32(q.boost), 0.0)
+
+    def _exec_expanded(self, q, si: int) -> Tuple[jax.Array, jax.Array]:
+        """prefix/wildcard/regexp/fuzzy: host term-dict expansion, then
+        the expanded terms score as one device launch (constant score)."""
+        seg = self.reader.segments[si]
+        n = seg.num_docs
+        if isinstance(q, dsl.FuzzyQuery):
+            terms = self._oracle._fuzzy_terms(q, seg)
+        else:
+            terms = self._oracle._expand_terms(q, seg)
+        if not terms:
+            return jnp.zeros(n, bool), jnp.zeros(n, jnp.float32)
+        _, cnt = self._field_terms_scored(si, q.field, terms, 1.0)
+        mask = cnt >= 1
+        return mask, jnp.where(mask, jnp.float32(q.boost), 0.0)
+
+    def _exec_knn_query(self, sec: KnnSection, si: int) -> Tuple[jax.Array, jax.Array]:
+        """knn-as-a-query-node: per-segment num_candidates cut (mirrors
+        NumpyExecutor._exec_knn), fully on device."""
+        seg = self.reader.segments[si]
+        n = seg.num_docs
+        dv = self.device_segments[si].vectors.get(sec.field)
+        if dv is None:
+            return jnp.zeros(n, bool), jnp.zeros(n, jnp.float32)
+        vectors, exists = dv
+        vf = seg.vectors[sec.field]
+        qv = jnp.asarray(np.asarray(sec.query_vector, np.float32))[None, :]
+        scores = scoring.knn_scores(qv, vectors, vf.similarity)[0]
+        mask = exists
+        if sec.filter is not None:
+            fm, _ = self._exec(sec.filter, si)
+            mask = mask & fm
+        live = self.reader.live_docs[si]
+        if live is not None:
+            mask = mask & jnp.asarray(live)
+        if sec.similarity is not None:
+            mask = mask & (scores >= jnp.float32(sec.similarity))
+        cand = min(sec.num_candidates, n)
+        masked = jnp.where(mask, scores, -jnp.inf)
+        kth = jax.lax.top_k(masked, cand)[0][-1]
+        # when fewer than `cand` docs match, kth is -inf and cuts nothing
+        # (same as the oracle's "only cut if cand < matches" branch)
+        mask = mask & (masked >= kth)
+        out = scores * jnp.float32(sec.boost)
+        return mask, jnp.where(mask, out, 0.0)
+
+    def _exec_phrase(
+        self, q: MatchPhraseQuery, si: int
+    ) -> Tuple[jax.Array, jax.Array]:
+        """Phrase = device conjunction scoring + host position verify
+        against the columnar position index (PositionsEnum analog). The
+        candidate set after the conjunction is small, so one device→host
+        sync of the mask mirrors ES's doc-at-a-time phrase scoring; BM25
+        weights stay on device and _source is never re-analyzed."""
+        from .executor import _phrase_match
+
+        seg = self.reader.segments[si]
+        n = seg.num_docs
+        mf = self.reader.mappings.get(q.field)
+        if mf is None or mf.type != TEXT:
+            return jnp.zeros(n, bool), jnp.zeros(n, jnp.float32)
+        analyzer_name = q.analyzer or mf.search_analyzer or mf.analyzer
+        qtoks = self.reader.analysis.get(analyzer_name).analyze(q.query)
+        terms = [t.text for t in qtoks]
+        if not terms:
+            return jnp.zeros(n, bool), jnp.zeros(n, jnp.float32)
+        conj, scores = self._exec_match(
+            MatchQuery(
+                field=q.field,
+                query=q.query,
+                operator="and",
+                analyzer=analyzer_name,
+                boost=q.boost,
+            ),
+            si,
+        )
+        pf = seg.postings.get(q.field)
+        if pf is None or not pf.has_positions:
+            # legacy segment without positions → oracle fallback
+            hm, hs = self._oracle._exec(q, seg)
+            return jnp.asarray(hm), jnp.asarray(hs)
+        qpos = [t.position for t in qtoks]
+        rel = [p - qpos[0] for p in qpos]
+        host_conj = np.asarray(conj)
+        mask = np.zeros(n, bool)
+        tids = [pf.term_id(t) for t in terms]
+        for doc in np.nonzero(host_conj)[0]:
+            pos_of = {}
+            ok = True
+            for t, tid in zip(terms, tids):
+                if t in pos_of:
+                    continue
+                ps = pf.doc_positions(tid, int(doc)) if tid >= 0 else None
+                if ps is None:
+                    ok = False
+                    break
+                pos_of[t] = ps.tolist()
+            mask[doc] = ok and _phrase_match(pos_of, terms, rel, q.slop)
+        dmask = jnp.asarray(mask)
+        return dmask, jnp.where(dmask, scores, 0.0)
+
     def _exec_term(self, q: TermQuery, si: int) -> Tuple[jax.Array, jax.Array]:
         seg = self.reader.segments[si]
         n = seg.num_docs
         mf = self.reader.mappings.get(q.field)
         if q.field == "_id":
-            hm, hs = self._oracle._exec_term(q, seg)
-            return jnp.asarray(hm), jnp.asarray(hs)
+            mask = np.zeros(n, bool)
+            loc = self._id_map(si).get(str(q.value))
+            if loc is not None:
+                mask[loc] = True
+            dmask = jnp.asarray(mask)
+            return dmask, jnp.where(dmask, jnp.float32(q.boost), 0.0)
         if mf is None:
             return jnp.zeros(n, bool), jnp.zeros(n, jnp.float32)
         if mf.type in (TEXT, KEYWORD):
@@ -369,8 +573,31 @@ class JaxExecutor:
         if mf is None:
             return jnp.zeros(n, bool), jnp.zeros(n, jnp.float32)
         if mf.type in (TEXT, KEYWORD):
-            hm, hs = self._oracle._exec_range(q, seg)
-            return jnp.asarray(hm), jnp.asarray(hs)
+            # host bisect on the sorted ord dictionary picks [lo, hi);
+            # the multi-value CSR membership test runs on device
+            of = seg.ordinals.get(q.field)
+            dof = self.device_segments[si].ordinals.get(q.field)
+            if of is None or dof is None:
+                return jnp.zeros(n, bool), jnp.zeros(n, jnp.float32)
+            import bisect
+
+            terms = of.ord_terms
+            lo, hi = 0, len(terms)
+            if q.gte is not None:
+                lo = bisect.bisect_left(terms, str(q.gte))
+            if q.gt is not None:
+                lo = max(lo, bisect.bisect_right(terms, str(q.gt)))
+            if q.lte is not None:
+                hi = min(hi, bisect.bisect_right(terms, str(q.lte)))
+            if q.lt is not None:
+                hi = min(hi, bisect.bisect_left(terms, str(q.lt)))
+            mv_ords, mv_offsets = dof
+            in_range = (mv_ords >= lo) & (mv_ords < hi)
+            csum = jnp.concatenate(
+                [jnp.zeros(1, jnp.int32), jnp.cumsum(in_range.astype(jnp.int32))]
+            )
+            mask = (csum[mv_offsets[1:]] - csum[mv_offsets[:-1]]) > 0
+            return mask, jnp.where(mask, jnp.float32(q.boost), 0.0)
         dn = self.device_segments[si].numerics.get(q.field)
         if dn is None:
             return jnp.zeros(n, bool), jnp.zeros(n, jnp.float32)
@@ -431,7 +658,11 @@ class JaxExecutor:
         if not fields:
             return jnp.zeros(n, bool), jnp.zeros(n, jnp.float32)
         per_field = [
-            self._exec_match(
+            self._exec_phrase(
+                MatchPhraseQuery(field=fn, query=q.query, boost=q.boost * fb), si
+            )
+            if q.type == "phrase"
+            else self._exec_match(
                 MatchQuery(field=fn, query=q.query, operator=q.operator, boost=q.boost * fb),
                 si,
             )

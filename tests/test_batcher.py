@@ -1,0 +1,195 @@
+"""Cross-request micro-batching dispatcher (search/batcher.py).
+
+The north-star serving idea: concurrent _search requests that reduce to
+flat weighted-term plans share ONE [B, T, 128] kernel launch. These
+tests check (a) batched results are hit-for-hit identical to the
+unbatched executor path, (b) concurrent submissions actually coalesce,
+(c) the WAND group (track_total_hits: false) returns the same top-k.
+"""
+
+import threading
+
+import numpy as np
+import pytest
+
+from elasticsearch_tpu.analysis import AnalysisRegistry
+from elasticsearch_tpu.cluster.indices import IndexService
+from elasticsearch_tpu.index.engine import ShardEngine
+from elasticsearch_tpu.index.mapping import Mappings
+from elasticsearch_tpu.search import dsl
+from elasticsearch_tpu.search.batcher import QueryBatcher, extract_match_plan
+from elasticsearch_tpu.search.executor_jax import JaxExecutor
+
+WORDS = [
+    "alpha", "beta", "gamma", "delta", "epsilon", "zeta", "eta", "theta",
+    "iota", "kappa", "lam", "mu", "nu", "xi", "omicron", "pi",
+]
+
+
+def make_service(n_docs=300, n_shards=1, seed=0):
+    rng = np.random.default_rng(seed)
+    svc = IndexService(
+        "b1",
+        settings={"number_of_shards": n_shards, "search.backend": "jax"},
+        mappings_json={"properties": {"body": {"type": "text"}}},
+    )
+    for i in range(n_docs):
+        k = int(rng.integers(3, 12))
+        words = rng.choice(WORDS, size=k, p=_zipf(len(WORDS)))
+        svc.index_doc(str(i), {"body": " ".join(words)})
+    svc.refresh()
+    return svc
+
+
+def _zipf(n):
+    w = 1.0 / np.arange(1, n + 1)
+    return w / w.sum()
+
+
+@pytest.fixture(scope="module")
+def service():
+    return make_service()
+
+
+class TestPlanExtraction:
+    def test_match_query_plan(self, service):
+        q = dsl.parse_query({"match": {"body": "alpha beta"}})
+        plan = extract_match_plan(q, service.mappings, service.analysis, False)
+        assert plan is not None
+        assert plan.terms == ("alpha", "beta") and plan.msm == 1
+
+    def test_and_operator_msm(self, service):
+        q = dsl.parse_query(
+            {"match": {"body": {"query": "alpha beta", "operator": "and"}}}
+        )
+        plan = extract_match_plan(q, service.mappings, service.analysis, False)
+        assert plan.msm == 2
+
+    def test_non_match_not_planned(self, service):
+        q = dsl.parse_query({"bool": {"must": [{"match": {"body": "alpha"}}]}})
+        assert (
+            extract_match_plan(q, service.mappings, service.analysis, False) is None
+        )
+
+    def test_wand_requires_capped_totals(self, service):
+        q = dsl.parse_query({"match": {"body": "alpha beta"}})
+        assert not extract_match_plan(
+            q, service.mappings, service.analysis, tth_capped=False
+        ).wand_ok
+        assert extract_match_plan(
+            q, service.mappings, service.analysis, tth_capped=True
+        ).wand_ok
+
+
+class TestBatchedParity:
+    def test_single_request_matches_executor_path(self, service):
+        body = {"query": {"match": {"body": "alpha gamma"}}, "size": 7}
+        batched = service.search(body)
+        # force the unbatched path by adding min_score=0 (not batchable)
+        unbatched = service.search({**body, "min_score": 0})
+        bh = [(h["_id"], round(h["_score"], 4)) for h in batched["hits"]["hits"]]
+        uh = [(h["_id"], round(h["_score"], 4)) for h in unbatched["hits"]["hits"]]
+        assert bh == uh
+        assert (
+            batched["hits"]["total"]["value"] == unbatched["hits"]["total"]["value"]
+        )
+
+    def test_and_operator_parity(self, service):
+        body = {
+            "query": {"match": {"body": {"query": "alpha beta", "operator": "and"}}},
+            "size": 5,
+        }
+        batched = service.search(body)
+        unbatched = service.search({**body, "min_score": 0})
+        assert [h["_id"] for h in batched["hits"]["hits"]] == [
+            h["_id"] for h in unbatched["hits"]["hits"]
+        ]
+
+    def test_multi_shard_merge(self):
+        svc = make_service(n_docs=200, n_shards=3, seed=1)
+        body = {"query": {"match": {"body": "alpha"}}, "size": 10}
+        batched = svc.search(body)
+        unbatched = svc.search({**body, "min_score": 0})
+        assert [h["_id"] for h in batched["hits"]["hits"]] == [
+            h["_id"] for h in unbatched["hits"]["hits"]
+        ]
+
+    def test_wand_group_same_topk(self, service):
+        body = {
+            "query": {"match": {"body": "alpha gamma epsilon"}},
+            "size": 10,
+            "track_total_hits": False,
+        }
+        wand = service.search(body)
+        exact = service.search({**body, "track_total_hits": True})
+        assert [h["_id"] for h in wand["hits"]["hits"]] == [
+            h["_id"] for h in exact["hits"]["hits"]
+        ]
+        assert "total" not in wand["hits"]
+
+    def test_deleted_docs_respected(self):
+        svc = make_service(n_docs=50, seed=2)
+        top = svc.search({"query": {"match": {"body": "alpha"}}, "size": 1})
+        victim = top["hits"]["hits"][0]["_id"]
+        svc.delete_doc(victim)
+        svc.refresh()
+        after = svc.search({"query": {"match": {"body": "alpha"}}, "size": 50})
+        assert victim not in [h["_id"] for h in after["hits"]["hits"]]
+
+
+class TestConcurrentCoalescing:
+    def test_concurrent_requests_share_launches(self, service):
+        # warm the compile caches first so the batch window isn't skewed
+        service.search({"query": {"match": {"body": "alpha"}}, "size": 5})
+        batcher = service._batcher
+        assert batcher is not None
+        base_jobs = batcher.stats["jobs"]
+
+        results = {}
+        errs = []
+
+        def one(i):
+            try:
+                results[i] = service.search(
+                    {"query": {"match": {"body": WORDS[i % 8]}}, "size": 5}
+                )
+            except Exception as e:  # pragma: no cover
+                errs.append(e)
+
+        threads = [threading.Thread(target=one, args=(i,)) for i in range(24)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert not errs
+        assert len(results) == 24
+        assert batcher.stats["jobs"] - base_jobs == 24
+        # at least one launch must have carried more than one job
+        assert batcher.stats["max_batch_seen"] > 1
+
+
+class TestDirectBatcher:
+    def test_batch_of_plans_matches_individual(self, service):
+        ex = service._executor(service.shards[0])
+        assert isinstance(ex, JaxExecutor)
+        batcher = QueryBatcher()
+        plans = [
+            extract_match_plan(
+                dsl.parse_query({"match": {"body": w}}),
+                service.mappings,
+                service.analysis,
+                False,
+            )
+            for w in WORDS[:6]
+        ]
+        jobs = [batcher.submit(ex, p, 10) for p in plans]
+        tds = [QueryBatcher.wait(j) for j in jobs]
+        for p, td in zip(plans, tds):
+            ref = ex.search(
+                dsl.MatchQuery(field="body", query=p.terms[0]), size=10
+            )
+            assert [(h.doc_id, round(h.score, 4)) for h in td.hits] == [
+                (h.doc_id, round(h.score, 4)) for h in ref.hits
+            ]
+            assert td.total == ref.total
+        batcher.close()

@@ -1,0 +1,151 @@
+"""Columnar positions: phrase matching without _source re-analysis.
+
+Reference analog: Lucene postings PositionsEnum (SURVEY.md §2.5 postings
+row) — positions are decoded once at index build into compact CSR arrays,
+and match_phrase/slop verify against those arrays. The round-1 design
+re-analyzed stored _source per candidate doc; these tests pin the new
+behavior: the query phase never touches seg.sources.
+"""
+
+import numpy as np
+import pytest
+
+from elasticsearch_tpu.analysis import AnalysisRegistry
+from elasticsearch_tpu.index.engine import ShardEngine
+from elasticsearch_tpu.index.mapping import Mappings
+from elasticsearch_tpu.search import dsl
+from elasticsearch_tpu.search.executor import NumpyExecutor
+from elasticsearch_tpu.search.executor_jax import JaxExecutor
+
+MAPPINGS = {
+    "properties": {
+        "body": {"type": "text"},
+        "title": {"type": "text"},
+    }
+}
+
+DOCS = [
+    ("1", {"body": "the quick brown fox jumps", "title": "quick fox"}),
+    ("2", {"body": "the brown quick fox", "title": "brown fox news"}),
+    ("3", {"body": "quick brown dogs and a fox", "title": "lazy dog"}),
+    ("4", {"body": ["quick brown", "fox jumps"], "title": "split values"}),
+    ("5", {"body": "fox quick brown", "title": "other"}),
+]
+
+
+@pytest.fixture
+def engine():
+    e = ShardEngine(Mappings(MAPPINGS), AnalysisRegistry())
+    for did, src in DOCS:
+        e.index(did, src)
+    e.refresh()
+    return e
+
+
+def ids(reader, td):
+    return [h.doc_id for h in td.hits]
+
+
+class TestColumnarPositions:
+    def test_positions_stored_and_sorted(self, engine):
+        seg = engine.segments[0]
+        pf = seg.postings["body"]
+        assert pf.has_positions
+        tid = pf.term_id("quick")
+        # doc 0: "the quick brown fox jumps" → quick at position 1
+        assert pf.doc_positions(tid, 0).tolist() == [1]
+        # absent doc → None
+        docs = pf.term_docs(tid)
+        assert 0 in docs.tolist()
+
+    def test_phrase_no_source_access(self, engine):
+        """The query phase must not read seg.sources for phrase queries."""
+        reader = engine.reader()
+        for seg in reader.segments:
+            seg.sources = _Poison()  # any access raises
+        ex = NumpyExecutor(reader)
+        q = dsl.parse_query({"match_phrase": {"body": "quick brown"}})
+        td = ex.search(q, size=10)
+        assert sorted(ids(reader, td)) == ["1", "3", "4", "5"]
+
+    def test_phrase_multivalue_gap_blocks_cross_value_match(self, engine):
+        # doc 4 has ["quick brown", "fox jumps"]: "brown fox" must NOT
+        # match across the array boundary (position_increment_gap=100)
+        reader = engine.reader()
+        ex = NumpyExecutor(reader)
+        q = dsl.parse_query({"match_phrase": {"body": "brown fox"}})
+        assert sorted(ids(reader, ex.search(q, size=10))) == ["1"]
+
+    def test_phrase_slop(self, engine):
+        reader = engine.reader()
+        ex = NumpyExecutor(reader)
+        # slop=1 lets one gap in: "quick fox" matches "quick brown fox"
+        q = dsl.parse_query(
+            {"match_phrase": {"body": {"query": "quick fox", "slop": 1}}}
+        )
+        assert "1" in ids(reader, ex.search(q, size=10))
+        q0 = dsl.parse_query({"match_phrase": {"body": "quick fox"}})
+        assert "1" not in ids(reader, ex.search(q0, size=10))
+
+    def test_jax_phrase_parity_and_no_source_access(self, engine):
+        reader = engine.reader()
+        oracle_ids = sorted(
+            ids(
+                reader,
+                NumpyExecutor(reader).search(
+                    dsl.parse_query({"match_phrase": {"body": "quick brown"}}),
+                    size=10,
+                ),
+            )
+        )
+        for seg in reader.segments:
+            seg.sources = _Poison()
+        jx = JaxExecutor(reader)
+        td = jx.search(
+            dsl.parse_query({"match_phrase": {"body": "quick brown"}}), size=10
+        )
+        assert sorted(ids(reader, td)) == oracle_ids == ["1", "3", "4", "5"]
+
+    def test_jax_multi_match_phrase_parity(self, engine):
+        reader = engine.reader()
+        q = dsl.parse_query(
+            {
+                "multi_match": {
+                    "query": "quick fox",
+                    "fields": ["body", "title"],
+                    "type": "phrase",
+                }
+            }
+        )
+        o = NumpyExecutor(reader).search(q, size=10)
+        j = JaxExecutor(reader).search(q, size=10)
+        assert [(h.doc_id, round(h.score, 4)) for h in o.hits] == [
+            (h.doc_id, round(h.score, 4)) for h in j.hits
+        ]
+        assert ids(reader, o)  # sanity: matches exist ("quick fox" in title of 1)
+
+    def test_positions_survive_save_load(self, engine, tmp_path):
+        seg = engine.segments[0]
+        seg.save(str(tmp_path / "seg"))
+        from elasticsearch_tpu.index.segment import Segment
+
+        seg2 = Segment.load(str(tmp_path / "seg"))
+        pf2 = seg2.postings["body"]
+        assert pf2.has_positions
+        pf = seg.postings["body"]
+        np.testing.assert_array_equal(pf.pos_data, pf2.pos_data)
+        np.testing.assert_array_equal(pf.pos_offsets, pf2.pos_offsets)
+        tid = pf2.term_id("fox")
+        # doc 4 (array): fox is first token of the second value → 101 + ~1
+        ps = pf2.doc_positions(tid, 3)
+        assert ps is not None and len(ps) == 1
+
+
+class _Poison:
+    """Sentinel that raises on any element access."""
+
+    def __getitem__(self, i):
+        raise AssertionError("query phase accessed seg.sources")
+
+    def __len__(self):
+        return 0
