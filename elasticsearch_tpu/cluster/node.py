@@ -1,0 +1,626 @@
+"""Multi-node cluster: membership, master, state publication, routing.
+
+Reference analogs (SURVEY.md §2.7, §3.5): `Coordinator`/Zen2 election +
+`PublicationTransportHandler` state publication, `PeerFinder` seed-host
+discovery, `ShardRouting`/`AllocationService` shard→node assignment,
+`TransportSearchAction` scatter/gather and `TransportShardBulkAction`
+write routing. Per SURVEY §2.7's prescription for a fixed-topology TPU
+pod, consensus is simplified to a deterministic single-writer design:
+the master is the lowest node id among discovered peers, cluster state
+is a versioned JSON snapshot published over the transport, and nodes
+apply states monotonically by version. (Quorum voting/pre-vote — the
+Raft safety machinery — is intentionally out of scope for this tier;
+the reference's InternalTestCluster-style tests exercise the same
+join/publish/apply surface.)
+
+Data plane vs control plane: scoring stays on-device per node
+(executor_jax), only metadata/doc blobs ride this DCN path.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from typing import Dict, List, Optional, Tuple
+
+from ..analysis import AnalysisRegistry
+from ..index.engine import ShardEngine, VersionConflictError
+from ..index.mapping import Mappings
+from ..search import dsl
+from ..transport.service import TransportError, TransportService
+from ..utils.murmur3 import shard_id as route_shard_id
+
+
+class NodeError(Exception):
+    pass
+
+
+class NotMasterError(NodeError):
+    pass
+
+
+class _LocalIndex:
+    """Per-node view of one index: metadata + the locally-owned shards."""
+
+    def __init__(self, name: str, meta: dict, data_path: Optional[str]):
+        self.name = name
+        self.meta = meta
+        self.mappings = Mappings(meta.get("mappings") or {})
+        analysis_cfg = (meta.get("settings") or {}).get("analysis")
+        self.analysis = AnalysisRegistry(
+            {"analysis": analysis_cfg} if analysis_cfg else None
+        )
+        self.data_path = data_path
+        self.shards: Dict[int, ShardEngine] = {}
+        # executor cache: shard -> (generation, executor)
+        self._executors: Dict[int, tuple] = {}
+
+    @property
+    def num_shards(self) -> int:
+        return int(self.meta.get("num_shards", 1))
+
+    def backend(self) -> str:
+        return str((self.meta.get("settings") or {}).get("search.backend", "jax"))
+
+    def ensure_shard(self, sid: int) -> ShardEngine:
+        eng = self.shards.get(sid)
+        if eng is None:
+            path = (
+                os.path.join(self.data_path, self.name, str(sid))
+                if self.data_path
+                else None
+            )
+            eng = ShardEngine(self.mappings, self.analysis, path=path, shard_id=sid)
+            self.shards[sid] = eng
+        return eng
+
+    def executor(self, sid: int):
+        eng = self.shards[sid]
+        cached = self._executors.get(sid)
+        if cached is not None and cached[0] == eng.change_generation:
+            return cached[1]
+        reader = eng.reader()
+        if self.backend() == "jax":
+            from ..search.executor_jax import JaxExecutor
+
+            ex = JaxExecutor(reader)
+        else:
+            from ..search.executor import NumpyExecutor
+
+            ex = NumpyExecutor(reader)
+        self._executors[sid] = (eng.change_generation, ex)
+        return ex
+
+    def close(self):
+        for eng in self.shards.values():
+            eng.close()
+
+
+class TpuNode:
+    """One cluster node: transport endpoint + local shards + coordinator.
+
+    Every public document/search method can be called on ANY node (the
+    coordinating-node model): the call routes to owning nodes over the
+    transport, exactly `TransportBulkAction`/`TransportSearchAction`.
+    """
+
+    def __init__(
+        self,
+        name: str,
+        seeds: Optional[List[Tuple[str, int]]] = None,
+        data_path: Optional[str] = None,
+        cluster_name: str = "elasticsearch-tpu",
+        port: int = 0,
+    ):
+        self.name = name
+        self.seeds = [tuple(s) for s in (seeds or [])]
+        self.data_path = data_path
+        self.transport = TransportService(name, cluster_name, port=port)
+        self.state: dict = {"version": 0, "master": None, "nodes": {}, "indices": {}}
+        self._state_lock = threading.RLock()
+        self.indices: Dict[str, _LocalIndex] = {}
+        self._closed = False
+        self._register_handlers()
+
+    # ------------------------------------------------------------------
+    # lifecycle, discovery, election (PeerFinder + simplified Zen2)
+    # ------------------------------------------------------------------
+
+    def start(self) -> "TpuNode":
+        self.transport.start()
+        peers: Dict[str, Tuple[str, int]] = {self.name: self.transport.address}
+        for addr in self.seeds:
+            if addr == self.transport.address:
+                continue
+            nid = self.transport.ping(addr)
+            if nid is not None:
+                peers[nid] = addr
+        master = min(peers)  # deterministic: lowest node id wins
+        if master == self.name:
+            # GatewayMetaState analog: a restarting master recovers its
+            # persisted index metadata (routing entries to dead nodes are
+            # reconciled by the replication tier)
+            persisted = self._load_persisted_state()
+            with self._state_lock:
+                self.state = {
+                    "version": (persisted or {}).get("version", 0) + 1,
+                    "master": self.name,
+                    "nodes": {
+                        self.name: {"address": list(self.transport.address)}
+                    },
+                    "indices": (persisted or {}).get("indices", {}),
+                }
+                self._apply_state(self.state)
+        else:
+            state = self.transport.send(
+                peers[master],
+                "cluster:join",
+                {"node": self.name, "address": list(self.transport.address)},
+            )
+            self._apply_state(state)
+        return self
+
+    def close(self):
+        self._closed = True
+        for li in self.indices.values():
+            li.close()
+        self.transport.close()
+
+    @property
+    def address(self) -> Tuple[str, int]:
+        return self.transport.address
+
+    def is_master(self) -> bool:
+        return self.state.get("master") == self.name
+
+    # ------------------------------------------------------------------
+    # handlers
+    # ------------------------------------------------------------------
+
+    def _register_handlers(self):
+        t = self.transport
+        t.register_handler("internal:ping", lambda p: {"node": self.name})
+        t.register_handler("cluster:join", self._handle_join)
+        t.register_handler("cluster:state/publish", self._handle_publish)
+        t.register_handler("cluster:state/get", lambda p: self.state)
+        t.register_handler("cluster:mapping/update", self._handle_mapping_update)
+        t.register_handler("indices:admin/create", self._handle_create_index)
+        t.register_handler("indices:admin/delete", self._handle_delete_index)
+        t.register_handler("indices:admin/refresh", self._handle_refresh)
+        t.register_handler("indices:data/write/shard_ops", self._handle_shard_ops)
+        t.register_handler("indices:data/read/get", self._handle_get)
+        t.register_handler("indices:data/read/search_shard", self._handle_search_shard)
+
+    def _handle_join(self, p: dict) -> dict:
+        with self._state_lock:
+            if not self.is_master():
+                raise NotMasterError(f"[{self.name}] is not the master")
+            new = _copy_state(self.state)
+            new["nodes"][p["node"]] = {"address": p["address"]}
+            new["version"] += 1
+            self._publish(new)
+            return self.state
+
+    def _handle_publish(self, p: dict) -> dict:
+        self._apply_state(p)
+        return {"ack": True, "node": self.name}
+
+    def _publish(self, new_state: dict):
+        """Master applies locally then pushes to every other node
+        (PublicationTransportHandler; single-phase — see module note)."""
+        self._apply_state(new_state)
+        for nid, info in new_state["nodes"].items():
+            if nid == self.name:
+                continue
+            try:
+                self.transport.send(
+                    tuple(info["address"]), "cluster:state/publish", new_state
+                )
+            except TransportError:
+                pass  # node-left handling arrives with replication tier
+
+    def _apply_state(self, state: dict):
+        """ClusterApplierService.onNewClusterState: monotonic by version;
+        creates/removes local shards to match the routing table."""
+        with self._state_lock:
+            if state["version"] <= self.state.get("version", 0) and state[
+                "version"
+            ] != 1:
+                return
+            self.state = state
+            for iname, meta in state["indices"].items():
+                li = self.indices.get(iname)
+                if li is None:
+                    li = _LocalIndex(iname, meta, self.data_path)
+                    self.indices[iname] = li
+                else:
+                    # merge published mapping updates into the live
+                    # Mappings object the engines share
+                    new_mappings = meta.get("mappings") or {}
+                    if new_mappings != li.mappings.to_json():
+                        li.mappings.merge(new_mappings)
+                    li.meta = meta
+                for sid_s, owner in meta.get("routing", {}).items():
+                    if owner == self.name:
+                        li.ensure_shard(int(sid_s))
+            for iname in list(self.indices):
+                if iname not in state["indices"]:
+                    self.indices.pop(iname).close()
+            self._persist_state()
+
+    def _state_path(self) -> Optional[str]:
+        if self.data_path is None:
+            return None
+        return os.path.join(self.data_path, "_cluster_state.json")
+
+    def _persist_state(self):
+        """PersistedClusterStateService analog: every applied state is
+        durable so a restarted node can recover metadata."""
+        path = self._state_path()
+        if path is None:
+            return
+        import json
+
+        os.makedirs(self.data_path, exist_ok=True)
+        tmp = path + ".tmp"
+        with open(tmp, "w", encoding="utf-8") as f:
+            json.dump(self.state, f)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, path)
+
+    def _load_persisted_state(self) -> Optional[dict]:
+        path = self._state_path()
+        if path is None:
+            return None
+        import json
+
+        try:
+            with open(path, encoding="utf-8") as f:
+                return json.load(f)
+        except (FileNotFoundError, json.JSONDecodeError):
+            return None
+
+    # ------------------------------------------------------------------
+    # index admin
+    # ------------------------------------------------------------------
+
+    def _handle_create_index(self, p: dict) -> dict:
+        with self._state_lock:
+            if not self.is_master():
+                raise NotMasterError(f"[{self.name}] is not the master")
+            name = p["name"]
+            body = p.get("body") or {}
+            if name in self.state["indices"]:
+                raise NodeError(f"index [{name}] already exists")
+            settings = dict(body.get("settings") or {})
+            settings = {
+                (k[len("index.") :] if k.startswith("index.") else k): v
+                for k, v in _flatten(settings).items()
+            }
+            num_shards = int(settings.get("number_of_shards", 1))
+            nodes = sorted(self.state["nodes"])
+            # round-robin allocation over the sorted node set
+            # (BalancedShardsAllocator, radically simplified)
+            routing = {
+                str(s): nodes[s % len(nodes)] for s in range(num_shards)
+            }
+            new = _copy_state(self.state)
+            new["indices"][name] = {
+                "settings": settings,
+                "mappings": body.get("mappings") or {},
+                "num_shards": num_shards,
+                "routing": routing,
+            }
+            new["version"] += 1
+            self._publish(new)
+            return {"acknowledged": True, "index": name, "routing": routing}
+
+    def _handle_mapping_update(self, p: dict) -> dict:
+        """Dynamic-mapping updates round-trip through the master and ride
+        the next published state (SURVEY.md §3.2: 'may round-trip to
+        MASTER for dynamic mapping')."""
+        with self._state_lock:
+            if not self.is_master():
+                raise NotMasterError(f"[{self.name}] is not the master")
+            name = p["index"]
+            if name not in self.state["indices"]:
+                raise NodeError(f"no such index [{name}]")
+            new = _copy_state(self.state)
+            merged = Mappings(new["indices"][name].get("mappings") or {})
+            merged.merge(p["mappings"])
+            new["indices"][name]["mappings"] = merged.to_json()
+            new["version"] += 1
+            self._publish(new)
+            return {"acknowledged": True}
+
+    def _handle_delete_index(self, p: dict) -> dict:
+        with self._state_lock:
+            if not self.is_master():
+                raise NotMasterError(f"[{self.name}] is not the master")
+            name = p["name"]
+            if name not in self.state["indices"]:
+                raise NodeError(f"no such index [{name}]")
+            new = _copy_state(self.state)
+            del new["indices"][name]
+            new["version"] += 1
+            self._publish(new)
+            return {"acknowledged": True}
+
+    def _handle_refresh(self, p: dict) -> dict:
+        li = self.indices.get(p["index"])
+        n = 0
+        if li is not None:
+            for eng in li.shards.values():
+                eng.refresh()
+                n += 1
+        return {"refreshed_shards": n}
+
+    # ------------------------------------------------------------------
+    # document ops (shard-routed, TransportShardBulkAction analog)
+    # ------------------------------------------------------------------
+
+    def _handle_shard_ops(self, p: dict) -> dict:
+        li = self.indices.get(p["index"])
+        if li is None:
+            raise NodeError(f"no such index [{p['index']}] on [{self.name}]")
+        sid = int(p["shard"])
+        eng = li.shards.get(sid)
+        if eng is None:
+            raise NodeError(
+                f"shard [{p['index']}][{sid}] not allocated to [{self.name}]"
+            )
+        results = []
+        for op in p["ops"]:
+            try:
+                if op["op"] == "index":
+                    r = eng.index(
+                        op["id"], op["source"], op_type=op.get("op_type", "index")
+                    )
+                    results.append(
+                        {
+                            "ok": True,
+                            "result": r.result,
+                            "_version": r.version,
+                            "_seq_no": r.seq_no,
+                        }
+                    )
+                elif op["op"] == "delete":
+                    r = eng.delete(op["id"])
+                    results.append({"ok": True, "result": r.result})
+                else:
+                    results.append({"ok": False, "error": f"bad op {op['op']}"})
+            except VersionConflictError as e:
+                results.append(
+                    {
+                        "ok": False,
+                        "error": str(e),
+                        "etype": "version_conflict_engine_exception",
+                    }
+                )
+        # dynamic mapping changes must reach the master (and thus every
+        # coordinator + the persisted state) before they are lost to a
+        # restart — compare against the applied metadata and round-trip
+        mj = li.mappings.to_json()
+        if mj != (li.meta.get("mappings") or {}):
+            li.meta["mappings"] = mj
+            try:
+                payload = {"index": p["index"], "mappings": mj}
+                if self.is_master():
+                    self._handle_mapping_update(payload)
+                else:
+                    self.transport.send(
+                        self._master_addr(), "cluster:mapping/update", payload
+                    )
+            except TransportError:
+                pass  # retried implicitly on the next write
+        return {"results": results}
+
+    def _handle_get(self, p: dict) -> dict:
+        li = self.indices.get(p["index"])
+        if li is None:
+            raise NodeError(f"no such index [{p['index']}]")
+        eng = li.shards.get(int(p["shard"]))
+        if eng is None:
+            raise NodeError("shard not here")
+        doc = eng.get(p["id"])
+        return {"found": doc is not None, "doc": doc}
+
+    # ------------------------------------------------------------------
+    # shard-level search (SearchService.executeQueryPhase analog; the
+    # fetch phase is folded into the query response — hits carry _source)
+    # ------------------------------------------------------------------
+
+    def _handle_search_shard(self, p: dict) -> dict:
+        li = self.indices.get(p["index"])
+        if li is None:
+            raise NodeError(f"no such index [{p['index']}]")
+        sid = int(p["shard"])
+        if sid not in li.shards:
+            raise NodeError("shard not here")
+        body = p.get("body") or {}
+        ex = li.executor(sid)
+        query = dsl.parse_query(body["query"]) if "query" in body else None
+        size = int(body.get("size", 10)) + int(body.get("from", 0))
+        td = ex.search(query, size=size)
+        reader = ex.reader
+        hits = []
+        for h in td.hits:
+            src = reader.segments[h.segment].sources[h.local_doc]
+            hits.append({"_id": h.doc_id, "_score": h.score, "_source": src})
+        return {
+            "total": td.total,
+            "max_score": td.max_score,
+            "hits": hits,
+        }
+
+    # ------------------------------------------------------------------
+    # coordinator API (callable on any node)
+    # ------------------------------------------------------------------
+
+    def _master_addr(self) -> Tuple[str, int]:
+        m = self.state.get("master")
+        if m == self.name:
+            return self.transport.address
+        info = self.state["nodes"].get(m)
+        if info is None:
+            raise NodeError("no known master")
+        return tuple(info["address"])
+
+    def _call(self, node_id: str, action: str, payload, timeout: float = 30.0):
+        """Local shortcut or transport hop (the `NodeClient` pattern)."""
+        if node_id == self.name:
+            return self.transport._handlers[action](payload)
+        info = self.state["nodes"].get(node_id)
+        if info is None:
+            raise NodeError(f"unknown node [{node_id}]")
+        return self.transport.send(tuple(info["address"]), action, payload, timeout)
+
+    def create_index(self, name: str, body: Optional[dict] = None) -> dict:
+        payload = {"name": name, "body": body or {}}
+        if self.is_master():
+            return self._handle_create_index(payload)
+        return self.transport.send(
+            self._master_addr(), "indices:admin/create", payload
+        )
+
+    def delete_index(self, name: str) -> dict:
+        payload = {"name": name}
+        if self.is_master():
+            return self._handle_delete_index(payload)
+        return self.transport.send(
+            self._master_addr(), "indices:admin/delete", payload
+        )
+
+    def _index_meta(self, index: str) -> dict:
+        meta = self.state["indices"].get(index)
+        if meta is None:
+            raise NodeError(f"no such index [{index}]")
+        return meta
+
+    def _owner(self, index: str, doc_id: str, routing: Optional[str] = None):
+        meta = self._index_meta(index)
+        sid = route_shard_id(
+            routing if routing is not None else doc_id, meta["num_shards"]
+        )
+        return sid, meta["routing"][str(sid)]
+
+    def index_doc(
+        self, index: str, doc_id: str, source: dict, op_type: str = "index"
+    ) -> dict:
+        sid, owner = self._owner(index, doc_id)
+        out = self._call(
+            owner,
+            "indices:data/write/shard_ops",
+            {
+                "index": index,
+                "shard": sid,
+                "ops": [
+                    {"op": "index", "id": doc_id, "source": source, "op_type": op_type}
+                ],
+            },
+        )
+        return out["results"][0]
+
+    def delete_doc(self, index: str, doc_id: str) -> dict:
+        sid, owner = self._owner(index, doc_id)
+        out = self._call(
+            owner,
+            "indices:data/write/shard_ops",
+            {"index": index, "shard": sid, "ops": [{"op": "delete", "id": doc_id}]},
+        )
+        return out["results"][0]
+
+    def bulk(self, index: str, ops: List[dict]) -> List[dict]:
+        """ops: [{"op": "index"|"delete", "id": ..., "source": ...}];
+        grouped by owning shard, one transport hop per shard."""
+        meta = self._index_meta(index)
+        by_shard: Dict[int, List[Tuple[int, dict]]] = {}
+        for i, op in enumerate(ops):
+            sid = route_shard_id(op["id"], meta["num_shards"])
+            by_shard.setdefault(sid, []).append((i, op))
+        results: List[Optional[dict]] = [None] * len(ops)
+        for sid, items in by_shard.items():
+            owner = meta["routing"][str(sid)]
+            out = self._call(
+                owner,
+                "indices:data/write/shard_ops",
+                {"index": index, "shard": sid, "ops": [op for _, op in items]},
+            )
+            for (i, _), r in zip(items, out["results"]):
+                results[i] = r
+        return results  # type: ignore[return-value]
+
+    def get_doc(self, index: str, doc_id: str) -> Optional[dict]:
+        sid, owner = self._owner(index, doc_id)
+        out = self._call(
+            owner, "indices:data/read/get", {"index": index, "shard": sid, "id": doc_id}
+        )
+        return out["doc"] if out["found"] else None
+
+    def refresh(self, index: str) -> None:
+        meta = self._index_meta(index)
+        for nid in {o for o in meta["routing"].values()}:
+            self._call(nid, "indices:admin/refresh", {"index": index})
+
+    def search(self, index: str, body: Optional[dict] = None) -> dict:
+        """Scatter to one copy of every shard, gather, merge by
+        (score desc, shard asc, rank asc) — SearchPhaseController."""
+        import time as _time
+
+        t0 = _time.perf_counter()
+        body = body or {}
+        meta = self._index_meta(index)
+        size = int(body.get("size", 10))
+        from_ = int(body.get("from", 0))
+        shard_pages = []
+        for sid_s, owner in sorted(meta["routing"].items(), key=lambda kv: int(kv[0])):
+            page = self._call(
+                owner,
+                "indices:data/read/search_shard",
+                {"index": index, "shard": int(sid_s), "body": body},
+            )
+            shard_pages.append(page)
+        cands = []
+        for si, page in enumerate(shard_pages):
+            for rank, h in enumerate(page["hits"]):
+                cands.append((-(h["_score"] or 0.0), si, rank, h))
+        cands.sort(key=lambda c: c[:3])
+        total = sum(p["total"] for p in shard_pages)
+        window = cands[from_ : from_ + size]
+        hits = [
+            {"_index": index, "_id": h["_id"], "_score": h["_score"], "_source": h["_source"]}
+            for _, _, _, h in window
+        ]
+        max_score = max(
+            (p["max_score"] for p in shard_pages if p["max_score"] is not None),
+            default=None,
+        )
+        n = len(shard_pages)
+        return {
+            "took": int((_time.perf_counter() - t0) * 1000),
+            "timed_out": False,
+            "_shards": {"total": n, "successful": n, "skipped": 0, "failed": 0},
+            "hits": {
+                "total": {"value": total, "relation": "eq"},
+                "max_score": max_score,
+                "hits": hits,
+            },
+        }
+
+
+def _copy_state(state: dict) -> dict:
+    import json
+
+    return json.loads(json.dumps(state))
+
+
+def _flatten(d: dict, prefix: str = "") -> dict:
+    out = {}
+    for k, v in d.items():
+        key = f"{prefix}.{k}" if prefix else k
+        if isinstance(v, dict):
+            out.update(_flatten(v, key))
+        else:
+            out[key] = v
+    return out

@@ -1,0 +1,180 @@
+"""Multi-node cluster over real localhost TCP (InternalTestCluster
+analog, SURVEY.md §4): discovery, join, state publication, routed
+writes, scatter/gather search — all cross-node.
+
+The VERDICT round-1 acceptance test is here: create an index on node A,
+bulk through node B, search from node A.
+"""
+
+import pytest
+
+from elasticsearch_tpu.cluster.node import NodeError, TpuNode
+
+
+def make_cluster(n, tmp_path=None, **kw):
+    """Starts n nodes; node-0 (lowest id) becomes master."""
+    nodes = []
+    first = TpuNode(
+        "node-0",
+        data_path=str(tmp_path / "node-0") if tmp_path else None,
+        **kw,
+    ).start()
+    nodes.append(first)
+    for i in range(1, n):
+        nodes.append(
+            TpuNode(
+                f"node-{i}",
+                seeds=[first.address],
+                data_path=str(tmp_path / f"node-{i}") if tmp_path else None,
+                **kw,
+            ).start()
+        )
+    return nodes
+
+
+@pytest.fixture
+def cluster():
+    nodes = make_cluster(2)
+    yield nodes
+    for n in nodes:
+        n.close()
+
+
+@pytest.fixture
+def cluster3():
+    nodes = make_cluster(3)
+    yield nodes
+    for n in nodes:
+        n.close()
+
+
+class TestMembership:
+    def test_join_and_state_convergence(self, cluster):
+        a, b = cluster
+        assert a.is_master() and not b.is_master()
+        assert set(a.state["nodes"]) == {"node-0", "node-1"}
+        assert b.state["nodes"] == a.state["nodes"]
+        assert b.state["version"] == a.state["version"]
+
+    def test_three_nodes(self, cluster3):
+        a, b, c = cluster3
+        assert set(c.state["nodes"]) == {"node-0", "node-1", "node-2"}
+
+
+class TestDistributedIndex:
+    def test_create_on_a_bulk_on_b_search_from_a(self, cluster):
+        a, b = cluster
+        # create through the NON-master (routes to master, publishes back)
+        r = b.create_index(
+            "dist",
+            {
+                "settings": {"number_of_shards": 4},
+                "mappings": {"properties": {"body": {"type": "text"}}},
+            },
+        )
+        assert r["acknowledged"]
+        # shards spread across both nodes
+        owners = set(r["routing"].values())
+        assert owners == {"node-0", "node-1"}
+        # both nodes hold their shards locally
+        assert set(a.indices["dist"].shards) | set(
+            b.indices["dist"].shards
+        ) == {0, 1, 2, 3}
+
+        docs = {
+            "1": "the quick brown fox",
+            "2": "lazy brown dog",
+            "3": "quick dog runs fast",
+            "4": "slow green turtle",
+            "5": "quick silver fox",
+        }
+        results = b.bulk(
+            "dist",
+            [{"op": "index", "id": k, "source": {"body": v}} for k, v in docs.items()],
+        )
+        assert all(r["ok"] and r["result"] == "created" for r in results)
+        a.refresh("dist")
+
+        resp = a.search("dist", {"query": {"match": {"body": "quick"}}})
+        ids = {h["_id"] for h in resp["hits"]["hits"]}
+        assert ids == {"1", "3", "5"}
+        assert resp["hits"]["total"]["value"] == 3
+        # and from the other coordinator too
+        resp_b = b.search("dist", {"query": {"match": {"body": "quick"}}})
+        assert {h["_id"] for h in resp_b["hits"]["hits"]} == ids
+
+    def test_get_and_delete_cross_node(self, cluster):
+        a, b = cluster
+        a.create_index("kv", {"settings": {"number_of_shards": 3}})
+        for i in range(10):
+            a.index_doc("kv", f"d{i}", {"n": i})
+        for i in range(10):
+            doc = b.get_doc("kv", f"d{i}")
+            assert doc is not None and doc["_source"]["n"] == i
+        assert b.delete_doc("kv", "d3")["result"] == "deleted"
+        assert a.get_doc("kv", "d3") is None
+
+    def test_score_parity_with_single_node(self, cluster):
+        """Distributed BM25 must match a single-shard single-node index
+        when every shard holds the full stats? No — per-shard IDF; here
+        we pin the weaker, true invariant: same docs, same coordinator
+        order regardless of which node coordinates."""
+        a, b = cluster
+        a.create_index("par", {"settings": {"number_of_shards": 2}})
+        for i, t in enumerate(
+            ["alpha beta", "alpha gamma", "beta gamma", "alpha alpha"]
+        ):
+            b.index_doc("par", str(i), {"body": t})
+        b.refresh("par")
+        ra = a.search("par", {"query": {"match": {"body": "alpha"}}})
+        rb = b.search("par", {"query": {"match": {"body": "alpha"}}})
+        assert [h["_id"] for h in ra["hits"]["hits"]] == [
+            h["_id"] for h in rb["hits"]["hits"]
+        ]
+
+    def test_duplicate_create_rejected(self, cluster):
+        a, b = cluster
+        a.create_index("dup")
+        with pytest.raises(Exception) as ei:
+            b.create_index("dup")
+        assert "already exists" in str(ei.value)
+
+    def test_delete_index_removes_everywhere(self, cluster):
+        a, b = cluster
+        a.create_index("tmp", {"settings": {"number_of_shards": 2}})
+        assert "tmp" in a.indices and "tmp" in b.indices
+        b.delete_index("tmp")
+        assert "tmp" not in a.indices and "tmp" not in b.indices
+        with pytest.raises(NodeError):
+            a.search("tmp", {})
+
+
+class TestPersistence:
+    def test_node_restart_recovers_local_shards(self, tmp_path):
+        nodes = make_cluster(2, tmp_path)
+        a, b = nodes
+        try:
+            a.create_index("pers", {"settings": {"number_of_shards": 2}})
+            for i in range(6):
+                a.index_doc("pers", str(i), {"body": f"doc number {i}"})
+            a.refresh("pers")
+            for li in b.indices.values():
+                for eng in li.shards.values():
+                    eng.flush()
+            b_docs = sum(e.num_docs for e in b.indices["pers"].shards.values())
+        finally:
+            b.close()
+        # restart node-1 with the same data path; rejoin and recover
+        b2 = TpuNode(
+            "node-1", seeds=[a.address], data_path=str(tmp_path / "node-1")
+        ).start()
+        try:
+            b2_docs = sum(
+                e.num_docs for e in b2.indices["pers"].shards.values()
+            )
+            assert b2_docs == b_docs
+            resp = a.search("pers", {"query": {"match": {"body": "doc"}}})
+            assert resp["hits"]["total"]["value"] == 6
+        finally:
+            b2.close()
+            a.close()

@@ -1,0 +1,105 @@
+"""Transport layer: frames, correlation, errors, timeouts, handshake.
+
+Reference analog: TransportService/TcpTransport behavior
+(SURVEY.md §2.7) — named handlers, request-id correlation, version
+handshake, remote-exception propagation, receive timeouts. Real TCP on
+localhost ephemeral ports (the InternalTestCluster philosophy: real
+RPC, one process).
+"""
+
+import threading
+import time
+
+import pytest
+
+from elasticsearch_tpu.transport import (
+    ConnectTransportError,
+    ReceiveTimeoutTransportError,
+    RemoteTransportError,
+    TransportService,
+)
+
+
+@pytest.fixture
+def pair():
+    a = TransportService("node-a").start()
+    b = TransportService("node-b").start()
+    yield a, b
+    a.close()
+    b.close()
+
+
+class TestTransport:
+    def test_request_response(self, pair):
+        a, b = pair
+        b.register_handler("echo", lambda p: {"echo": p, "from": "node-b"})
+        out = a.send(b.address, "echo", {"x": 1})
+        assert out == {"echo": {"x": 1}, "from": "node-b"}
+
+    def test_concurrent_correlation(self, pair):
+        a, b = pair
+
+        def slow_id(p):
+            time.sleep(0.01 * (5 - p["i"] % 5))
+            return {"i": p["i"]}
+
+        b.register_handler("slow", slow_id)
+        results = {}
+        errs = []
+
+        def call(i):
+            try:
+                results[i] = a.send(b.address, "slow", {"i": i})["i"]
+            except Exception as e:
+                errs.append(e)
+
+        ts = [threading.Thread(target=call, args=(i,)) for i in range(20)]
+        [t.start() for t in ts]
+        [t.join() for t in ts]
+        assert not errs
+        assert results == {i: i for i in range(20)}
+
+    def test_remote_exception_propagates(self, pair):
+        a, b = pair
+
+        def boom(p):
+            raise ValueError("kaboom")
+
+        b.register_handler("boom", boom)
+        with pytest.raises(RemoteTransportError) as ei:
+            a.send(b.address, "boom", {})
+        assert "kaboom" in str(ei.value)
+        assert ei.value.etype == "ValueError"
+
+    def test_unknown_action(self, pair):
+        a, b = pair
+        with pytest.raises(RemoteTransportError) as ei:
+            a.send(b.address, "nope", {})
+        assert ei.value.etype == "action_not_found_transport_exception"
+
+    def test_timeout(self, pair):
+        a, b = pair
+        b.register_handler("hang", lambda p: time.sleep(5))
+        with pytest.raises(ReceiveTimeoutTransportError):
+            a.send(b.address, "hang", {}, timeout=0.2)
+
+    def test_connect_refused(self, pair):
+        a, _ = pair
+        with pytest.raises(ConnectTransportError):
+            a.send(("127.0.0.1", 1), "echo", {}, timeout=1)
+
+    def test_cluster_name_mismatch(self):
+        a = TransportService("a", cluster_name="c1").start()
+        b = TransportService("b", cluster_name="c2").start()
+        try:
+            with pytest.raises(ConnectTransportError):
+                a.send(b.address, "x", {})
+        finally:
+            a.close()
+            b.close()
+
+    def test_ping(self, pair):
+        a, b = pair
+        b.register_handler("internal:ping", lambda p: {"node": "node-b"})
+        assert a.ping(b.address) == "node-b"
+        assert a.ping(("127.0.0.1", 1)) is None
