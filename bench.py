@@ -28,11 +28,18 @@ All diagnostics go to stderr; stdout is exactly the one JSON line.
 from __future__ import annotations
 
 import json
+import os
 import sys
 import threading
 import time
 
 import numpy as np
+
+# Persistent XLA compilation cache: the serving path compiles a fixed
+# handful of programs (fixed-shape chunked kernels); cache them across
+# runs so repeat benchmarks skip warmup compilation entirely.
+os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", "/tmp/es_tpu_xla_cache")
+os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "1")
 
 
 def log(*a):
@@ -237,18 +244,18 @@ def main():
     svc_np = make_service(seg, "numpy")
     queries = make_queries(term_df)
 
-    # warmup: compile the (B, T, k) shape buckets
+    # warmup: the fixed-shape kernel set is small (chunk scorer,
+    # threshold, finalize) and independent of query shape — a few
+    # queries compile everything the measured run needs
     log("warmup/compile…")
-    for q in queries[:48]:
-        svc_jax.search({"query": {"match": {"body": q}}, "size": K})
     for q in queries[:8]:
-        svc_jax.search(
-            {
-                "query": {"match": {"body": q}},
-                "size": K,
-                "track_total_hits": False,
-            }
-        )
+        svc_jax.search({"query": {"match": {"body": q}}, "size": K})
+    svc_jax.search(
+        {"query": {"match": {"body": queries[0]}}, "size": K, "track_total_hits": False}
+    )
+    svc_jax.search(
+        {"query": {"match": {"body": queries[0]}}, "size": K, "track_total_hits": True}
+    )
     log(f"warm ({time.perf_counter()-t0:.1f}s)")
 
     # headline: serving path with exact totals (the default)

@@ -275,10 +275,13 @@ class IndexService:
         shard_sort_values: List[List[List]] = []
         profile = bool(body.get("profile"))
         shard_profiles = []
-        tth = body.get("track_total_hits", True)
+        # ES default: totals tracked accurately up to 10_000, pruning
+        # allowed past it (SearchSourceBuilder.TRACK_TOTAL_HITS_ACCURATE
+        # default of 10_000 in RestSearchAction)
+        tth = body.get("track_total_hits", 10_000)
         # ---- batched fast path: flat match plans on the jax backend go
-        # through the cross-request micro-batching dispatcher (one
-        # [B,T,128] launch across concurrent requests) ----
+        # through the cross-request micro-batching dispatcher (shared
+        # fixed-shape launches across concurrent requests) ----
         if (
             query is not None
             and knn is None
@@ -292,9 +295,7 @@ class IndexService:
         ):
             from ..search.batcher import extract_match_plan
 
-            plan = extract_match_plan(
-                query, self.mappings, self.analysis, tth_capped=(tth is False)
-            )
+            plan = extract_match_plan(query, self.mappings, self.analysis, tth)
             if plan is not None:
                 batched = self._search_batched(plan, from_ + size)
                 if batched is not None:
@@ -416,14 +417,14 @@ class IndexService:
         self.search_stats["query_time_in_millis"] += took
         self.search_stats["fetch_total"] += 1
         hits_obj: dict = {"max_score": max_score, "hits": out_hits}
-        tth = body.get("track_total_hits", True)
+        gte_shard = any(td.relation == "gte" for td in shard_results)
         if tth is True:
             hits_obj["total"] = {"value": total, "relation": "eq"}
         elif tth is not False:
             limit = int(tth)
             hits_obj["total"] = {
                 "value": min(total, limit),
-                "relation": "gte" if total > limit else "eq",
+                "relation": "gte" if (total > limit or gte_shard) else "eq",
             }
         resp = {
             "took": took,

@@ -139,18 +139,20 @@ class TpuNode:
         if master == self.name:
             # GatewayMetaState analog: a restarting master recovers its
             # persisted index metadata (routing entries to dead nodes are
-            # reconciled by the replication tier)
+            # reconciled by the replication tier). The recovered state is
+            # built as a NEW dict and applied while self.state still
+            # holds the version-0 placeholder, so the monotonic check in
+            # _apply_state sees a genuine version increase (applying
+            # self.state against itself would early-return and lose the
+            # recovered indices).
             persisted = self._load_persisted_state()
-            with self._state_lock:
-                self.state = {
-                    "version": (persisted or {}).get("version", 0) + 1,
-                    "master": self.name,
-                    "nodes": {
-                        self.name: {"address": list(self.transport.address)}
-                    },
-                    "indices": (persisted or {}).get("indices", {}),
-                }
-                self._apply_state(self.state)
+            recovered = {
+                "version": (persisted or {}).get("version", 0) + 1,
+                "master": self.name,
+                "nodes": {self.name: {"address": list(self.transport.address)}},
+                "indices": (persisted or {}).get("indices", {}),
+            }
+            self._apply_state(recovered)
         else:
             state = self.transport.send(
                 peers[master],
@@ -223,9 +225,7 @@ class TpuNode:
         """ClusterApplierService.onNewClusterState: monotonic by version;
         creates/removes local shards to match the routing table."""
         with self._state_lock:
-            if state["version"] <= self.state.get("version", 0) and state[
-                "version"
-            ] != 1:
+            if state["version"] <= self.state.get("version", 0):
                 return
             self.state = state
             for iname, meta in state["indices"].items():
@@ -403,7 +403,6 @@ class TpuNode:
         # restart — compare against the applied metadata and round-trip
         mj = li.mappings.to_json()
         if mj != (li.meta.get("mappings") or {}):
-            li.meta["mappings"] = mj
             try:
                 payload = {"index": p["index"], "mappings": mj}
                 if self.is_master():
@@ -412,8 +411,11 @@ class TpuNode:
                     self.transport.send(
                         self._master_addr(), "cluster:mapping/update", payload
                     )
+                # only record success AFTER the master acked — a failed
+                # send leaves meta stale so the next write retries
+                li.meta["mappings"] = mj
             except TransportError:
-                pass  # retried implicitly on the next write
+                pass  # genuinely retried on the next write now
         return {"results": results}
 
     def _handle_get(self, p: dict) -> dict:

@@ -93,45 +93,149 @@ class BatchedScoreResult(NamedTuple):
     totals: jax.Array  # int32[B] number of matching docs
 
 
-def make_batched_bm25_scorer(doc_ids, tfs, inv_norm, n_docs: int, k: int, live=None):
-    """Builds a jitted batched scorer closed over HBM-resident postings.
+# ---------------------------------------------------------------------------
+# Fixed-shape chunked batched scorer — the serving hot path.
+#
+# The round-2 lesson: compiling one XLA program per (B, T) bucket melts
+# down at corpus scale (T grows with term df under Zipf; warmup was 14
+# minutes). The fix is the standard TPU serving recipe: FIX every shape.
+# The batch dimension is always BPAD rows (short batches pad with invalid
+# rows — the accumulator init they waste is microseconds), and tile lists
+# of any length stream through launches of exactly TCHUNK tiles per row,
+# accumulating into a DONATED dense per-doc accumulator. The whole
+# serving path therefore compiles a handful of programs total, once,
+# regardless of corpus size, term frequency, or concurrency.
+# ---------------------------------------------------------------------------
 
-    Scores B queries in one launch: gathers [B, T, 128] tiles, BM25s them
-    on the VPU, scatter-adds per query, applies minimum-should-match, and
-    returns per-query top-k. One compilation per (B, T) bucket.
+BPAD = 32  # fixed query rows per launch
+TCHUNK = 512  # fixed tiles per row per launch
 
-    Args live on device: doc_ids/tfs int32[n_tiles, 128], inv_norm
-    float32[n_docs]; optional live bool[n_docs] soft-delete bitmap folded
-    into the match mask (Lucene liveDocs).
+
+@functools.partial(jax.jit, donate_argnums=(3,))
+def _chunk_add(doc_ids, tfs, inv_norm, acc, ti, tw, tv):
+    """acc[B, n+1] += BM25 contributions of one [B, TCHUNK] tile chunk."""
+    tgt, s, _ = _chunk_scores(doc_ids, tfs, inv_norm, ti, tw, tv)
+    return jax.vmap(lambda a, d, v: a.at[d.ravel()].add(v.ravel()))(acc, tgt, s)
+
+
+@functools.partial(jax.jit, donate_argnums=(3, 4))
+def _chunk_add_cnt(doc_ids, tfs, inv_norm, acc, cnt, ti, tw, tv):
+    """Like _chunk_add but also counts matching terms per doc (for
+    minimum_should_match / operator=and semantics)."""
+    tgt, s, valid = _chunk_scores(doc_ids, tfs, inv_norm, ti, tw, tv)
+    acc = jax.vmap(lambda a, d, v: a.at[d.ravel()].add(v.ravel()))(acc, tgt, s)
+    cnt = jax.vmap(lambda c, d, v: c.at[d.ravel()].add(v.ravel().astype(jnp.int32)))(
+        cnt, tgt, valid
+    )
+    return acc, cnt
+
+
+def _chunk_scores(doc_ids, tfs, inv_norm, ti, tw, tv):
+    n_docs = inv_norm.shape[0]
+    rows_d = doc_ids[ti]  # [B, TC, 128]
+    rows_t = tfs[ti]
+    valid = (rows_d >= 0) & tv[:, :, None]
+    tgt = jnp.where(valid, rows_d, n_docs)  # padding → overflow slot
+    inv = inv_norm[jnp.clip(rows_d, 0, max(n_docs - 1, 0))]
+    w = tw[:, :, None]
+    s = w - w / (jnp.float32(1.0) + rows_t.astype(jnp.float32) * inv)
+    s = jnp.where(valid, s, 0.0)
+    return tgt, s, valid
+
+
+@functools.partial(jax.jit, static_argnames=("k", "block_size"))
+def _threshold(acc, live, k, block_size):
+    """(theta[B], accmax[B, n_blocks]) after the essential-terms pass.
+
+    theta = kth best accumulated score over matching LIVE docs (the
+    top-k floor the pruning bound must beat); accmax keeps deleted docs
+    in — an overestimate is a sound upper bound."""
+    a = acc[:, :-1]
+    n = a.shape[1]
+    masked = jnp.where(a > 0, a, -jnp.inf)
+    if live is not None:
+        masked = jnp.where(live[None, :], masked, -jnp.inf)
+    theta = jax.lax.top_k(masked, min(k, n))[0][:, -1]
+    n_blocks = -(-n // block_size)
+    pad = n_blocks * block_size - n
+    ap = jnp.pad(a, ((0, 0), (0, pad)))
+    accmax = ap.reshape(a.shape[0], n_blocks, block_size).max(axis=2)
+    return theta, accmax
+
+
+@functools.partial(jax.jit, static_argnames=("k",))
+def _finalize(acc, cnt, live, msm, k):
+    """(scores[B,k], docs[B,k], totals[B]); score desc / doc asc."""
+    a = acc[:, :-1]
+    n = a.shape[1]
+    if cnt is None:
+        mask = a > 0
+    else:
+        mask = cnt[:, :-1] >= jnp.maximum(msm, 1)[:, None]
+    if live is not None:
+        mask = mask & live[None, :]
+    masked = jnp.where(mask, a, -jnp.inf)
+    s, d = jax.lax.top_k(masked, min(k, n))
+    return s, d, mask.sum(axis=1, dtype=jnp.int32)
+
+
+class ChunkedScorer:
+    """Batched BM25 scoring over one segment's tiled postings with fixed
+    launch shapes (see module comment above).
+
+    Reference analog: the per-leaf BM25 scoring loop
+    (BM25Similarity.score inside Weight.scorer iteration); the dense
+    [BPAD, n_docs] accumulator replaces the doc-at-a-time heap, and the
+    threshold/finalize split is the WAND phase boundary.
     """
-    doc_ids = jnp.asarray(doc_ids)
-    tfs = jnp.asarray(tfs)
-    inv_norm = jnp.asarray(inv_norm, jnp.float32)
-    live = jnp.asarray(live) if live is not None else None
-    k = min(k, n_docs)  # top_k cannot exceed the segment's doc count
 
-    @jax.jit
-    def score_batch(
-        tile_idx: jax.Array,  # int32[B, T]
-        tile_weights: jax.Array,  # float32[B, T]
-        tile_valid: jax.Array,  # bool[B, T]
-        msm: jax.Array,  # int32[B] min matching terms (1 = OR, n_terms = AND)
-    ) -> BatchedScoreResult:
-        rows_doc = doc_ids[tile_idx]  # [B, T, 128]
-        rows_tf = tfs[tile_idx]
+    def __init__(self, doc_ids, tfs, inv_norm, live=None, block_size: int = 4096):
+        self.doc_ids = jnp.asarray(doc_ids)
+        self.tfs = jnp.asarray(tfs)
+        self.inv_norm = jnp.asarray(inv_norm, jnp.float32)
+        self.live = jnp.asarray(live) if live is not None else None
+        self.n_docs = int(self.inv_norm.shape[0])
+        self.block_size = block_size
 
-        def one(rd, rt, w, v, m):
-            scores, cnt = _score_tiles_inner(rd, rt, w, v, inv_norm, n_docs)
-            mask = cnt >= jnp.maximum(m, 1)
-            if live is not None:
-                mask = mask & live
-            s, d = topk_hits(scores, mask, k)
-            return s, d, mask.sum().astype(jnp.int32)
+    def new_acc(self, with_cnt: bool):
+        acc = jnp.zeros((BPAD, self.n_docs + 1), jnp.float32)
+        cnt = jnp.zeros((BPAD, self.n_docs + 1), jnp.int32) if with_cnt else None
+        return acc, cnt
 
-        s, d, t = jax.vmap(one)(rows_doc, rows_tf, tile_weights, tile_valid, msm)
-        return BatchedScoreResult(s, d, t)
+    def score_into(self, acc, cnt, tile_lists, weight_lists):
+        """Streams per-row tile/weight lists (≤ BPAD rows, any length)
+        through TCHUNK-wide launches into the donated accumulators."""
+        t_max = max((len(t) for t in tile_lists), default=0)
+        for c0 in range(0, t_max, TCHUNK):
+            ti = np.zeros((BPAD, TCHUNK), np.int32)
+            tw = np.zeros((BPAD, TCHUNK), np.float32)
+            tv = np.zeros((BPAD, TCHUNK), bool)
+            for j, (tl, wl) in enumerate(zip(tile_lists, weight_lists)):
+                sl = tl[c0 : c0 + TCHUNK]
+                m = len(sl)
+                if m:
+                    ti[j, :m] = sl
+                    tw[j, :m] = wl[c0 : c0 + TCHUNK]
+                    tv[j, :m] = True
+            if cnt is None:
+                acc = _chunk_add(self.doc_ids, self.tfs, self.inv_norm, acc, ti, tw, tv)
+            else:
+                acc, cnt = _chunk_add_cnt(
+                    self.doc_ids, self.tfs, self.inv_norm, acc, cnt, ti, tw, tv
+                )
+        return acc, cnt
 
-    return score_batch
+    def threshold(self, acc, k: int):
+        theta, accmax = _threshold(
+            acc, self.live, k=min(k, self.n_docs), block_size=self.block_size
+        )
+        return np.asarray(theta), np.asarray(accmax)
+
+    def finalize(self, acc, cnt, msm: np.ndarray, k: int):
+        s, d, tot = _finalize(
+            acc, cnt, self.live, jnp.asarray(msm, jnp.int32), k=min(k, self.n_docs)
+        )
+        return np.asarray(s), np.asarray(d), np.asarray(tot)
 
 
 def _score_tiles_inner(doc_rows, tf_rows, tile_weights, tile_valid, inv_norm, n_docs):

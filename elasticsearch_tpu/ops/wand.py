@@ -1,4 +1,4 @@
-"""Block-max pruned BM25 top-k — the TPU formulation of WAND/MaxScore.
+"""Block-max pruning structures — the TPU formulation of WAND/MaxScore.
 
 Reference analog: org.apache.lucene.search.WANDScorer + MaxScoreCache +
 BlockMaxConjunctionScorer (SURVEY.md §2.5, §5): skip whole postings
@@ -6,342 +6,262 @@ blocks whose score upper bound cannot reach the current top-k floor —
 "the single most important algorithmic optimization in the scoring
 loop". Lucene's version is a sequential pointer-chasing loop; that shape
 is TPU-hostile, so the algorithm is restructured (same bound math,
-different control flow) into two dense passes with a threshold broadcast
-between them, exactly the mapping SURVEY.md §5 prescribes:
+different control flow) into two dense passes with one threshold
+broadcast between them, exactly the mapping SURVEY.md §5 prescribes:
 
   1. tiles are DOC-BLOCK ALIGNED for frequent ("hot") terms: a tile
      never crosses a global doc-range boundary of ``block_size`` docs,
-     so every tile has a doc block id and a static score upper bound
-     (from tile_max_tf / tile_min_norm — monotone BM25 bound);
-  2. PHASE A scores all rare-term tiles plus nothing else (rare terms
-     have the highest impact-per-posting; this is MaxScore's "essential
-     terms" set) → per-query threshold θ = kth best score;
-  3. the surviving-tile test is pure arithmetic: a hot tile can be
-     skipped iff  accmax[block] + Σ_t B_t[block]  <  θ, where accmax is
-     the per-block max of the phase-A accumulator and B_t[block] is
-     term t's max tile bound in that block (a doc contributes at most
-     one posting per term per block, so the sum is a sound per-doc
-     upper bound);
-  4. PHASE B gathers only surviving tiles (host-compacted to the next
-     power-of-two bucket — the "mask tiles below the kth-score
-     threshold" broadcast) and adds them into the same accumulator;
+     so every hot tile has a doc-block id and a static score upper
+     bound (monotone BM25: max_tf with the min norm byte of the tile);
+  2. PHASE A scores all rare-term tiles (rare terms have the highest
+     impact-per-posting — MaxScore's "essential terms") through the
+     fixed-shape ChunkedScorer → per-query threshold θ = kth score;
+  3. the survival test is pure arithmetic: a hot tile is skippable iff
+     accmax[block] + Σ_t B_t[block] < θ, where accmax is the per-block
+     max of the phase-A accumulator and B_t[block] is term t's max tile
+     bound in that block (one posting per term per doc per block, so
+     the sum is a sound per-doc upper bound);
+  4. PHASE B streams only surviving tiles into the same accumulator;
      final exact top-k. Results are EXACT, not approximate.
 
-Exactness is asserted against the unpruned scorer in tests; the win is
-HBM traffic: broad OR queries typically gather a small fraction of the
-hot tiles in phase B.
+Split of responsibilities (the round-3 redesign):
+
+  * ``BlockMaxTiling`` — the retiled postings + sidecars. Pure
+    structure, independent of collection statistics, built ONCE per
+    immutable segment (vectorized NumPy, no per-posting Python loop)
+    and cached on the PostingsField, so refresh generations don't
+    re-upload or re-tile.
+  * ``BlockMaxIndex`` — per reader generation: SHARD-level BM25 weights
+    and norm cache (Lucene CollectionStatistics — segment-level stats
+    here would make pruned and unpruned scores diverge in multi-segment
+    shards) applied to the tiling to get per-tile bounds and the
+    per-(hot term, block) MaxScoreCache table.
+
+Deletions do NOT disable pruning: bounds computed without liveDocs only
+overestimate (a deleted doc can only remove a candidate), so the skip
+test stays sound; the scorer masks deleted docs in θ and in the final
+collection (ops/scoring.py ChunkedScorer).
 """
 
 from __future__ import annotations
 
 from dataclasses import dataclass
-from typing import Dict, List, Optional, Tuple
+from typing import Dict, List, Optional
 
-import jax
 import jax.numpy as jnp
 import numpy as np
 
 from ..index.segment import INVALID_DOC, TILE, PostingsField
-from ..models import bm25
-from .scoring import _score_tiles_inner, next_bucket
+
+_TILING_ATTR = "_bmx_tiling"
 
 
 @dataclass
-class _TermPlan:
+class TermPlan:
     term_id: int
-    weight: float  # boost * idf
+    weight: float  # boost * shard-level idf
     tile_start: int
     tile_count: int
     hot: bool
-    max_bound: float  # weight * max tile factor
+
+
+@dataclass
+class BlockMaxTiling:
+    """Doc-block-aligned tiled postings for one field of one segment
+    (structure only — see module docstring)."""
+
+    doc_ids: jnp.ndarray  # int32[n_tiles, TILE] (device)
+    tfs: jnp.ndarray  # int32[n_tiles, TILE] (device)
+    tile_term: np.ndarray  # int32[n_tiles] local term id
+    tile_block: np.ndarray  # int32[n_tiles] doc block (hot tiles only)
+    tile_max_tf: np.ndarray  # int32[n_tiles]
+    tile_min_norm: np.ndarray  # uint8[n_tiles]
+    term_tile_start: np.ndarray  # int32[n_terms]
+    term_tile_count: np.ndarray  # int32[n_terms]
+    term_hot: np.ndarray  # bool[n_terms]
+    terms: List[str]  # reference to the segment's term dictionary
+    n_docs: int
+    block_size: int
+    n_blocks: int
+
+
+def get_tiling(
+    pf: PostingsField,
+    n_docs: int,
+    block_size: int = 4096,
+    hot_min_postings_per_block: int = 32,
+) -> BlockMaxTiling:
+    """Cached block-aligned retiling of one PostingsField (immutable)."""
+    key = (block_size, hot_min_postings_per_block)
+    cache = getattr(pf, _TILING_ATTR, None)
+    if cache is None:
+        cache = {}
+        setattr(pf, _TILING_ATTR, cache)
+    tiling = cache.get(key)
+    if tiling is None:
+        tiling = _build_tiling(pf, n_docs, block_size, hot_min_postings_per_block)
+        cache[key] = tiling
+    return tiling
+
+
+def _build_tiling(
+    pf: PostingsField, n_docs: int, block_size: int, hot_min: int
+) -> BlockMaxTiling:
+    n_terms = len(pf.terms)
+    n_blocks = max(1, -(-n_docs // block_size))
+    starts = pf.term_tile_start.astype(np.int64)
+    counts = pf.term_tile_count.astype(np.int64)
+
+    # flat posting stream in (term, doc) order (fully vectorized)
+    tile_order = (
+        np.arange(int(counts.sum()), dtype=np.int64)
+        - np.repeat(np.cumsum(counts) - counts, counts)
+        + np.repeat(starts, counts)
+    )
+    rows_d = pf.doc_ids[tile_order].ravel()
+    rows_t = pf.tfs[tile_order].ravel()
+    term_of_post = np.repeat(np.arange(n_terms, dtype=np.int64), counts * TILE)
+    valid = rows_d >= 0
+    docs = rows_d[valid].astype(np.int64)
+    tfs_flat = rows_t[valid]
+    term_of = term_of_post[valid]
+
+    term_df = pf.term_df.astype(np.int64)
+    term_hot = term_df >= hot_min * n_blocks
+
+    if len(docs) == 0:
+        return BlockMaxTiling(
+            doc_ids=jnp.full((1, TILE), INVALID_DOC, jnp.int32),
+            tfs=jnp.zeros((1, TILE), jnp.int32),
+            tile_term=np.zeros(1, np.int32),
+            tile_block=np.full(1, -1, np.int32),
+            tile_max_tf=np.zeros(1, np.int32),
+            tile_min_norm=np.full(1, 255, np.uint8),
+            term_tile_start=np.zeros(n_terms, np.int32),
+            term_tile_count=np.zeros(n_terms, np.int32),
+            term_hot=term_hot,
+            terms=pf.terms,
+            n_docs=n_docs,
+            block_size=block_size,
+            n_blocks=n_blocks,
+        )
+
+    # group = (term,) for rare terms, (term, doc block) for hot terms;
+    # keys are monotone because docs ascend within each term
+    blk = docs // block_size
+    key = term_of * n_blocks + np.where(term_hot[term_of], blk, 0)
+    newgrp = np.r_[True, key[1:] != key[:-1]]
+    group_id = np.cumsum(newgrp) - 1
+    group_start = np.nonzero(newgrp)[0]
+    group_size = np.diff(np.r_[group_start, len(docs)])
+    rank = np.arange(len(docs), dtype=np.int64) - group_start[group_id]
+    tiles_per_group = -(-group_size // TILE)
+    group_tile_off = np.cumsum(tiles_per_group) - tiles_per_group
+    tile_of_post = group_tile_off[group_id] + rank // TILE
+    slot = tile_of_post * TILE + rank % TILE
+    n_tiles = int(tiles_per_group.sum())
+
+    new_docs = np.full(n_tiles * TILE, INVALID_DOC, np.int32)
+    new_tfs = np.zeros(n_tiles * TILE, np.int32)
+    new_docs[slot] = docs
+    new_tfs[slot] = tfs_flat
+
+    tile_term = np.zeros(n_tiles, np.int32)
+    tile_block = np.full(n_tiles, -1, np.int32)
+    tile_term[tile_of_post] = term_of
+    hot_posts = term_hot[term_of]
+    tile_block[tile_of_post[hot_posts]] = blk[hot_posts]
+    tile_max_tf = np.zeros(n_tiles, np.int32)
+    np.maximum.at(tile_max_tf, tile_of_post, tfs_flat)
+    tile_min_norm = np.full(n_tiles, 255, np.uint8)
+    np.minimum.at(tile_min_norm, tile_of_post, pf.norms[docs])
+
+    term_tile_count = np.bincount(tile_term, minlength=n_terms).astype(np.int32)
+    term_tile_start = (np.cumsum(term_tile_count) - term_tile_count).astype(np.int32)
+
+    return BlockMaxTiling(
+        doc_ids=jnp.asarray(new_docs.reshape(n_tiles, TILE)),
+        tfs=jnp.asarray(new_tfs.reshape(n_tiles, TILE)),
+        tile_term=tile_term,
+        tile_block=tile_block,
+        tile_max_tf=tile_max_tf,
+        tile_min_norm=tile_min_norm,
+        term_tile_start=term_tile_start,
+        term_tile_count=term_tile_count,
+        term_hot=term_hot,
+        terms=pf.terms,
+        n_docs=n_docs,
+        block_size=block_size,
+        n_blocks=n_blocks,
+    )
 
 
 class BlockMaxIndex:
-    """Doc-block-aligned tiled postings for one field of one segment.
+    """Per-generation bound tables over a BlockMaxTiling.
 
-    Rebuilds the term tiles so hot-term tiles never span a doc-block
-    boundary, and precomputes per-tile score-bound factors
-    ``1 - 1/(1 + max_tf * max_inv_norm)`` (score = w * factor bound).
+    ``weights`` must be SHARD-level BM25 idf per local term id and
+    ``norm_cache`` the shard-level 256-entry inverse-norm cache
+    (IndexSearcher.collectionStatistics — NOT per-segment stats), so
+    pruned scores are identical to the unpruned executor path.
     """
 
     def __init__(
-        self,
-        pf: PostingsField,
-        n_docs: int,
-        k1: float = bm25.DEFAULT_K1,
-        b: float = bm25.DEFAULT_B,
-        block_size: int = 4096,
-        hot_min_postings_per_block: int = 32,
+        self, tiling: BlockMaxTiling, weights: np.ndarray, norm_cache: np.ndarray
     ):
-        self.pf = pf
-        self.n_docs = n_docs
-        self.block_size = block_size
-        self.n_blocks = max(1, -(-n_docs // block_size))
-        st = pf.stats
-        doc_count = st.doc_count or 1
-        avgdl = bm25.avg_field_length(st.sum_total_term_freq, doc_count)
-        self.cache = bm25.norm_inverse_cache(avgdl, k1, b)
-        self.inv_norm = self.cache[pf.norms.astype(np.int64)].astype(np.float32)
-        self.weights = np.array(
-            [bm25.idf(doc_count, int(df)) for df in pf.term_df], np.float32
+        self.tiling = tiling
+        self.weights = np.asarray(weights, np.float32)
+        # LENGTH_TABLE is strictly increasing, so the cache is monotone
+        # decreasing in the norm byte: max inv-norm of a tile = cache at
+        # the tile's min norm byte. Bound per tile:
+        #   w * (1 - 1/(1 + max_tf * max_inv))   (monotone BM25)
+        max_inv = norm_cache[tiling.tile_min_norm.astype(np.int64)].astype(np.float32)
+        factor = 1.0 - 1.0 / (1.0 + tiling.tile_max_tf.astype(np.float32) * max_inv)
+        self.tile_bounds = self.weights[tiling.tile_term] * factor
+        # MaxScoreCache analog: per (hot term, block) max tile bound
+        hot_ids = np.nonzero(tiling.term_hot)[0]
+        self._hot_rank = {int(t): r for r, t in enumerate(hot_ids)}
+        self.term_block_bounds = np.zeros(
+            (len(hot_ids), tiling.n_blocks), np.float32
         )
-
-        hot_df_threshold = hot_min_postings_per_block * self.n_blocks
-        doc_rows: List[np.ndarray] = []
-        tf_rows: List[np.ndarray] = []
-        bounds: List[float] = []
-        blocks: List[int] = []
-        self.terms: List[_TermPlan] = []
-        next_tile = 0
-        for tid in range(len(pf.terms)):
-            s0 = int(pf.term_tile_start[tid])
-            cnt = int(pf.term_tile_count[tid])
-            rows_d = pf.doc_ids[s0 : s0 + cnt].ravel()
-            rows_t = pf.tfs[s0 : s0 + cnt].ravel()
-            valid = rows_d >= 0
-            docs = rows_d[valid]
-            tfs = rows_t[valid]
-            hot = len(docs) >= hot_df_threshold
-            w = float(self.weights[tid])
-            if hot:
-                # split postings at doc-block boundaries, tile each chunk
-                blk = docs // self.block_size
-                chunk_starts = np.nonzero(np.r_[True, blk[1:] != blk[:-1]])[0]
-                chunk_ends = np.r_[chunk_starts[1:], len(docs)]
-            else:
-                chunk_starts = np.array([0])
-                chunk_ends = np.array([len(docs)])
-            t0 = next_tile
-            max_factor = 0.0
-            for cs, ce in zip(chunk_starts, chunk_ends):
-                cd, ct = docs[cs:ce], tfs[cs:ce]
-                n_t = -(-len(cd) // TILE)
-                pad = n_t * TILE - len(cd)
-                if pad:
-                    cd = np.r_[cd, np.full(pad, INVALID_DOC, np.int32)]
-                    ct = np.r_[ct, np.zeros(pad, np.int32)]
-                cd = cd.reshape(n_t, TILE)
-                ct = ct.reshape(n_t, TILE)
-                for r in range(n_t):
-                    vmask = cd[r] >= 0
-                    max_tf = float(ct[r].max())
-                    inv = self.inv_norm[np.clip(cd[r], 0, n_docs - 1)]
-                    max_inv = float(inv[vmask].max()) if vmask.any() else 0.0
-                    factor = 1.0 - 1.0 / (1.0 + max_tf * max_inv)
-                    max_factor = max(max_factor, factor)
-                    doc_rows.append(cd[r])
-                    tf_rows.append(ct[r])
-                    bounds.append(w * factor)
-                    blocks.append(int(cd[r][vmask][0] // self.block_size) if vmask.any() else 0)
-                next_tile += n_t
-            self.terms.append(
-                _TermPlan(tid, w, t0, next_tile - t0, hot, w * max_factor)
+        for r, tid in enumerate(hot_ids):
+            s0 = int(tiling.term_tile_start[tid])
+            c = int(tiling.term_tile_count[tid])
+            sl = slice(s0, s0 + c)
+            np.maximum.at(
+                self.term_block_bounds[r], tiling.tile_block[sl], self.tile_bounds[sl]
             )
-        if doc_rows:
-            self.doc_ids = jnp.asarray(np.stack(doc_rows))
-            self.tfs = jnp.asarray(np.stack(tf_rows))
-        else:
-            self.doc_ids = jnp.full((1, TILE), INVALID_DOC, jnp.int32)
-            self.tfs = jnp.zeros((1, TILE), jnp.int32)
-        self.tile_bounds = np.asarray(bounds, np.float32)
-        self.tile_blocks = np.asarray(blocks, np.int32)
-        self.inv_norm_dev = jnp.asarray(self.inv_norm)
-        self._term_index = {t: i for i, t in enumerate(pf.terms)}
-        # dense per-(hot term, block) max tile bound, precomputed once —
-        # the MaxScoreCache analog (static per segment, not per query)
-        self.term_block_bounds: Dict[int, np.ndarray] = {}
-        for tp in self.terms:
-            if not tp.hot:
-                continue
-            sl = slice(tp.tile_start, tp.tile_start + tp.tile_count)
-            bt = np.zeros(self.n_blocks, np.float32)
-            np.maximum.at(bt, self.tile_blocks[sl], self.tile_bounds[sl])
-            self.term_block_bounds[tp.term_id] = bt
+        self._term_index = {t: i for i, t in enumerate(tiling.terms)}
 
-    # ------------------------------------------------------------------
-
-    def plan(self, terms: List[str], boost: float = 1.0) -> List[_TermPlan]:
+    def plan(self, terms: List[str], boost: float = 1.0) -> List[TermPlan]:
         out = []
         for t in terms:
             tid = self._term_index.get(t)
-            if tid is not None:
-                tp = self.terms[tid]
-                if boost != 1.0:
-                    tp = _TermPlan(
-                        tp.term_id,
-                        tp.weight * boost,
-                        tp.tile_start,
-                        tp.tile_count,
-                        tp.hot,
-                        tp.max_bound * boost,
-                    )
-                out.append(tp)
+            if tid is None or int(self.tiling.term_tile_count[tid]) == 0:
+                continue
+            out.append(
+                TermPlan(
+                    term_id=tid,
+                    weight=float(self.weights[tid]) * boost,
+                    tile_start=int(self.tiling.term_tile_start[tid]),
+                    tile_count=int(self.tiling.term_tile_count[tid]),
+                    hot=bool(self.tiling.term_hot[tid]),
+                )
+            )
         return out
 
+    def block_bounds(self, p: TermPlan) -> np.ndarray:
+        """Σ-able per-block upper bound for one hot term (boost folded)."""
+        base = self.term_block_bounds[self._hot_rank[p.term_id]]
+        w = float(self.weights[p.term_id])
+        scale = p.weight / w if w else 0.0
+        return base if scale == 1.0 else base * np.float32(scale)
 
-class BlockMaxScorer:
-    """Two-phase pruned scorer over one BlockMaxIndex (OR queries, top-k).
-
-    Scoring batches share compiled shapes via power-of-two tile buckets;
-    the phase-A→B threshold sync is one small device→host transfer per
-    batch (the ES analog: per-segment scorers consult MaxScoreCache
-    between blocks — here the 'block' is the whole phase)."""
-
-    def __init__(self, index: BlockMaxIndex, k: int = 10):
-        self.idx = index
-        self.k = k
-
-        n_docs = index.n_docs
-        block_size = index.block_size
-        n_blocks = index.n_blocks
-
-        @jax.jit
-        def phase_a(tile_idx, tile_w, tile_v):
-            def one(ti, tw, tv):
-                rows_d = index.doc_ids[ti]
-                rows_t = index.tfs[ti]
-                scores, cnt = _score_tiles_inner(
-                    rows_d, rows_t, tw, tv, index.inv_norm_dev, n_docs
-                )
-                mask = cnt >= 1
-                masked = jnp.where(mask, scores, -jnp.inf)
-                top_s, _ = jax.lax.top_k(masked, min(self.k, n_docs))
-                theta = top_s[-1]
-                # per-block max of the accumulator (for the skip test)
-                pad = n_blocks * block_size - n_docs
-                acc_p = jnp.pad(scores, (0, pad))
-                accmax = acc_p.reshape(n_blocks, block_size).max(axis=1)
-                return scores, cnt, theta, accmax
-
-            return jax.vmap(one)(tile_idx, tile_w, tile_v)
-
-        @jax.jit
-        def phase_b(acc, cnt, tile_idx, tile_w, tile_v):
-            def one(a, c, ti, tw, tv):
-                rows_d = index.doc_ids[ti]
-                rows_t = index.tfs[ti]
-                s2, c2 = _score_tiles_inner(
-                    rows_d, rows_t, tw, tv, index.inv_norm_dev, n_docs
-                )
-                a = a + s2
-                c = c + c2
-                mask = c >= 1
-                masked = jnp.where(mask, a, -jnp.inf)
-                s, d = jax.lax.top_k(masked, min(self.k, n_docs))
-                return s, d, mask.sum().astype(jnp.int32)
-
-            return jax.vmap(one)(acc, cnt, tile_idx, tile_w, tile_v)
-
-        @jax.jit
-        def finalize(acc, cnt):
-            def one(a, c):
-                mask = c >= 1
-                masked = jnp.where(mask, a, -jnp.inf)
-                s, d = jax.lax.top_k(masked, min(self.k, n_docs))
-                return s, d, mask.sum().astype(jnp.int32)
-
-            return jax.vmap(one)(acc, cnt)
-
-        self._phase_a = phase_a
-        self._phase_b = phase_b
-        self._finalize = finalize
-
-    # ------------------------------------------------------------------
-
-    def search_batch(
-        self, term_lists: List[List[str]]
-    ) -> Tuple[np.ndarray, np.ndarray, np.ndarray, dict]:
-        """Returns (scores[B,k], docs[B,k], totals[B], stats)."""
-        idx = self.idx
-        B = len(term_lists)
-        plans = [idx.plan(terms) for terms in term_lists]
-
-        # ---- phase A: all rare-term tiles (the essential set) ----
-        a_tiles: List[List[int]] = []
-        a_w: List[List[float]] = []
-        hot_terms: List[List[_TermPlan]] = []
-        t_max = 1
-        for ps in plans:
-            tl: List[int] = []
-            wl: List[float] = []
-            hots: List[_TermPlan] = []
-            for p in ps:
-                if p.hot:
-                    hots.append(p)
-                else:
-                    tl.extend(range(p.tile_start, p.tile_start + p.tile_count))
-                    wl.extend([p.weight] * p.tile_count)
-            # the essential set must be non-empty or θ is -inf and nothing
-            # prunes: promote the cheapest hot term into phase A
-            if not tl and hots:
-                hots.sort(key=lambda p: p.tile_count)
-                p = hots.pop(0)
-                tl.extend(range(p.tile_start, p.tile_start + p.tile_count))
-                wl.extend([p.weight] * p.tile_count)
-            a_tiles.append(tl)
-            a_w.append(wl)
-            hot_terms.append(hots)
-            t_max = max(t_max, len(tl))
-        T_a = next_bucket(t_max)
-        ti, tw, tv = _pad_batch(a_tiles, a_w, B, T_a)
-        acc, cnt, theta, accmax = self._phase_a(ti, tw, tv)
-
-        if not any(hot_terms):
-            s, d, tot = self._finalize(acc, cnt)
-            return (
-                np.asarray(s),
-                np.asarray(d),
-                np.asarray(tot),
-                {"phase_b_tiles": 0, "hot_tiles_total": 0},
-            )
-
-        theta_h = np.asarray(theta)  # ---- the threshold broadcast ----
-        accmax_h = np.asarray(accmax)
-
-        # ---- survival test per hot tile (vectorized bound math) ----
-        b_tiles: List[List[int]] = []
-        b_w: List[List[float]] = []
-        t_max = 1
-        total_hot = 0
-        survived = 0
-        for bi, hots in enumerate(hot_terms):
-            tl: List[int] = []
-            wl: List[float] = []
-            if hots:
-                # Σ_t B_t[block] from the precomputed per-term block bounds
-                sum_bounds = np.zeros(idx.n_blocks, np.float32)
-                for p in hots:
-                    base_w = float(self.idx.weights[p.term_id]) or 1.0
-                    sum_bounds += idx.term_block_bounds[p.term_id] * (
-                        p.weight / base_w
-                    )
-                for p in hots:
-                    sl = slice(p.tile_start, p.tile_start + p.tile_count)
-                    blk = idx.tile_blocks[sl]
-                    total_hot += p.tile_count
-                    potential = accmax_h[bi][blk] + sum_bounds[blk]
-                    keep = potential >= theta_h[bi]
-                    kept_tiles = np.arange(sl.start, sl.stop)[keep]
-                    tl.extend(kept_tiles.tolist())
-                    wl.extend([p.weight] * len(kept_tiles))
-                    survived += len(kept_tiles)
-            b_tiles.append(tl)
-            b_w.append(wl)
-            t_max = max(t_max, len(tl))
-        T_b = next_bucket(t_max)
-        ti, tw, tv = _pad_batch(b_tiles, b_w, B, T_b)
-        s, d, tot = self._phase_b(acc, cnt, ti, tw, tv)
-        stats = {"phase_b_tiles": survived, "hot_tiles_total": total_hot}
-        return np.asarray(s), np.asarray(d), np.asarray(tot), stats
-
-
-def _pad_batch(tiles, weights, B, T):
-    ti = np.zeros((B, T), np.int32)
-    tw = np.zeros((B, T), np.float32)
-    tv = np.zeros((B, T), bool)
-    for bi in range(B):
-        t = len(tiles[bi])
-        ti[bi, :t] = tiles[bi]
-        tw[bi, :t] = weights[bi]
-        tv[bi, :t] = True
-    return ti, tw, tv
+    def surviving_tiles(
+        self, p: TermPlan, potential: np.ndarray, theta: float
+    ) -> np.ndarray:
+        """Tile ids of one hot term whose block could still beat theta.
+        ``potential`` is accmax_row + Σ_t block_bounds per block."""
+        sl = slice(p.tile_start, p.tile_start + p.tile_count)
+        blocks = self.tiling.tile_block[sl]
+        keep = potential[blocks] >= theta
+        return np.arange(sl.start, sl.stop, dtype=np.int64)[keep]

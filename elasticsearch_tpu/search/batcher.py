@@ -4,16 +4,22 @@ the batched TPU kernels.
 Reference analog: there is none in Elasticsearch — Lucene scores one
 query per thread. This is the north-star departure (BASELINE.json:
 "score query batches in parallel"): concurrent `_search` requests whose
-query compiles to a flat weighted-term plan are collected into ONE
-[B, T, 128] kernel launch per (segment, field) instead of B separate
+query compiles to a flat weighted-term plan are collected into shared
+fixed-shape kernel launches per (segment, field) instead of B separate
 launches. The dispatcher uses continuous batching: while one batch is
 executing on device, arriving requests queue; the worker drains the
 whole queue the moment it frees up, so there is no linger timer and no
 added idle latency for a lone request.
 
-When a request does not need exact totals (track_total_hits: false) the
-group is scored through the block-max WAND scorer (ops/wand.py) instead
-— same results for top-k, a fraction of the HBM traffic.
+Collection mode follows ES semantics (QueryPhase + WANDScorer:
+totalHitsThreshold defaults to 10_000): unless the caller asks for
+exact totals (`track_total_hits: true`), block-max pruning is the
+DEFAULT — hot-term postings blocks that cannot reach the top-k floor
+are never gathered. Pruning is engaged per shard only when the capped
+total can still be reported truthfully (some term's doc_freq minus the
+shard's deleted docs already proves ≥ cap matches); the response then
+carries relation "gte" exactly like Lucene's TotalHits.GREATER_THAN_OR_
+EQUAL_TO.
 """
 
 from __future__ import annotations
@@ -21,16 +27,17 @@ from __future__ import annotations
 import queue
 import threading
 from dataclasses import dataclass
-from typing import Dict, List, Optional, Tuple
+from typing import Dict, List, Optional, Tuple, Union
 
 import numpy as np
 
 from ..index.mapping import TEXT
 from ..ops import scoring
+from ..ops.scoring import BPAD
 from . import dsl
 from .executor import Hit, TopDocs
 
-MAX_BATCH = 64
+MAX_BATCH = BPAD
 
 
 @dataclass(frozen=True)
@@ -41,11 +48,20 @@ class MatchPlan:
     terms: Tuple[str, ...]
     msm: int  # minimum matching terms (1 = OR, len(terms) = AND)
     boost: float
-    wand_ok: bool  # caller does not need exact totals → pruning allowed
+    # None = exact totals required; 0 = totals not tracked at all
+    # (track_total_hits: false); N > 0 = totals capped at N (the ES
+    # default is 10_000)
+    tth_cap: Optional[int]
+
+    @property
+    def wand_ok(self) -> bool:
+        """Pruning is sound only for pure disjunctions without an exact
+        total requirement (WANDScorer: minShouldMatch == 1)."""
+        return self.msm == 1 and self.tth_cap is not None
 
 
 def extract_match_plan(
-    query, mappings, analysis, tth_capped: bool
+    query, mappings, analysis, tth: Union[bool, int] = 10_000
 ) -> Optional[MatchPlan]:
     """Returns a MatchPlan when `query` is a match query over a text
     field (the hot REST shape), else None → normal executor path."""
@@ -67,13 +83,18 @@ def extract_match_plan(
         msm = max(
             1, dsl.parse_minimum_should_match(query.minimum_should_match, len(terms))
         )
-    wand_ok = tth_capped and query.boost == 1.0 and msm == 1
+    if tth is True:
+        cap: Optional[int] = None
+    elif tth is False:
+        cap = 0
+    else:
+        cap = max(1, int(tth))
     return MatchPlan(
         field=query.field,
         terms=tuple(terms),
         msm=msm,
         boost=query.boost,
-        wand_ok=wand_ok,
+        tth_cap=cap,
     )
 
 
@@ -91,16 +112,21 @@ class _Job:
 
 class QueryBatcher:
     """One dispatcher thread per index: REST worker threads submit jobs
-    and block; the worker scores whole groups in single launches."""
+    and block; the worker scores whole groups in shared launches."""
 
     def __init__(self, max_batch: int = MAX_BATCH):
-        self.max_batch = max_batch
+        self.max_batch = min(max_batch, BPAD)
         self._queue: "queue.Queue[_Job]" = queue.Queue()
         self._thread: Optional[threading.Thread] = None
         self._closed = False
         self._lock = threading.Lock()
         # observability: how many launches / jobs / batched jobs
-        self.stats = {"launches": 0, "jobs": 0, "max_batch_seen": 0}
+        self.stats = {
+            "launches": 0,
+            "jobs": 0,
+            "max_batch_seen": 0,
+            "pruned_jobs": 0,
+        }
 
     def _ensure_thread(self):
         with self._lock:
@@ -115,13 +141,16 @@ class QueryBatcher:
         if self._thread is not None:
             self._queue.put(None)  # wake the worker
         # fail anything still queued so no submitter blocks forever
+        self._drain_queue(RuntimeError("query batcher closed"))
+
+    def _drain_queue(self, err: BaseException):
         while True:
             try:
                 j = self._queue.get_nowait()
             except queue.Empty:
                 break
-            if j is not None:
-                j.error = RuntimeError("query batcher closed")
+            if j is not None and not j.event.is_set():
+                j.error = err
                 j.event.set()
 
     # ---- client side ----
@@ -142,8 +171,9 @@ class QueryBatcher:
         return self.wait(job)
 
     @staticmethod
-    def wait(job: _Job) -> TopDocs:
-        job.event.wait()
+    def wait(job: _Job, timeout: Optional[float] = None) -> TopDocs:
+        if not job.event.wait(timeout):
+            raise TimeoutError("batched query did not complete in time")
         if job.error is not None:
             raise job.error
         return job.result
@@ -151,94 +181,140 @@ class QueryBatcher:
     # ---- worker side ----
 
     def _run(self):
-        while not self._closed:
-            job = self._queue.get()
-            if job is None:
-                continue
-            if self._closed:
-                job.error = RuntimeError("query batcher closed")
-                job.event.set()
-                continue
-            batch = [job]
-            while len(batch) < self.max_batch:
-                try:
-                    j = self._queue.get_nowait()
-                except queue.Empty:
-                    break
-                if j is not None:
-                    batch.append(j)
-            self.stats["jobs"] += len(batch)
-            self.stats["max_batch_seen"] = max(
-                self.stats["max_batch_seen"], len(batch)
-            )
-            # group jobs that can share one launch
-            groups: Dict[Tuple, List[_Job]] = {}
-            for j in batch:
-                kb = max(16, scoring.next_bucket(j.k, 16))
-                key = (id(j.executor), j.plan.field, kb, j.plan.wand_ok)
-                groups.setdefault(key, []).append(j)
-            for (eid, field, kb, wand), jobs in groups.items():
-                try:
-                    self._run_group(jobs, field, kb, wand)
-                except BaseException as e:  # surface to all waiters
-                    for j in jobs:
-                        j.error = e
-                        j.event.set()
+        try:
+            while not self._closed:
+                job = self._queue.get()
+                if job is None:
+                    continue
+                if self._closed:
+                    if not job.event.is_set():
+                        job.error = RuntimeError("query batcher closed")
+                        job.event.set()
+                    continue
+                batch = [job]
+                while len(batch) < self.max_batch:
+                    try:
+                        j = self._queue.get_nowait()
+                    except queue.Empty:
+                        break
+                    if j is not None:
+                        batch.append(j)
+                self.stats["jobs"] += len(batch)
+                self.stats["max_batch_seen"] = max(
+                    self.stats["max_batch_seen"], len(batch)
+                )
+                # group jobs that can share launches (same reader
+                # generation, field, and top-k compile bucket)
+                groups: Dict[Tuple, List[_Job]] = {}
+                for j in batch:
+                    kb = 16 if j.k <= 16 else scoring.next_bucket(j.k, 16)
+                    key = (id(j.executor), j.plan.field, kb)
+                    groups.setdefault(key, []).append(j)
+                for (eid, field, kb), jobs in groups.items():
+                    try:
+                        self._run_group(jobs, field, kb)
+                    except BaseException as e:  # surface to all waiters
+                        for j in jobs:
+                            if not j.event.is_set():
+                                j.error = e
+                                j.event.set()
+        finally:
+            # the dispatcher thread is exiting (close() or a crash
+            # outside the per-group guard): nobody may block forever
+            self._drain_queue(RuntimeError("query batcher worker exited"))
 
-    def _run_group(self, jobs: List[_Job], field: str, kb: int, wand: bool):
+    def _run_group(self, jobs: List[_Job], field: str, kb: int):
         ex = jobs[0].executor
         reader = ex.reader
-        n_segments = len(reader.segments)
-        # per segment: one batched launch over all jobs in the group
+        nj = len(jobs)
+        # shard-level pruning eligibility: a capped total may only be
+        # shortcut to (cap, gte) when ≥ cap live matches are guaranteed
+        # up front (doc_freq of some term minus deleted docs)
+        prune: List[bool] = []
+        for j in jobs:
+            ok = j.plan.wand_ok
+            if ok and j.plan.tth_cap:
+                max_df = max(
+                    (ex.shard_df(field, t) for t in j.plan.terms), default=0
+                )
+                ok = max_df - ex.deleted_count >= j.plan.tth_cap
+            prune.append(ok)
+        with_cnt = any(j.plan.msm > 1 for j in jobs)
         per_job_cands: List[List[Tuple[float, int, int]]] = [[] for _ in jobs]
-        totals = np.zeros(len(jobs), np.int64)
-        # pad the batch dimension to a power-of-two bucket too, or every
-        # distinct concurrent batch size would trigger its own XLA
-        # compile (the scorer's contract is one compile per (B, T) pair)
-        B = scoring.next_bucket(len(jobs), 1)
-        for si in range(n_segments):
-            if wand:
-                scorer = ex.wand_scorer(si, field, kb)
-                if scorer is not None:
-                    term_lists = [list(j.plan.terms) for j in jobs]
-                    term_lists += [[] for _ in range(B - len(jobs))]
-                    s, d, t, _stats = scorer.search_batch(term_lists)
-                    self.stats["launches"] += 1
-                    self._collect(jobs, per_job_cands, totals, si, s, d, t)
-                    continue
-                # fall through (deleted docs present / no postings)
-            scorer = ex.batched_scorer(si, field, kb)
-            if scorer is None:
+        totals = np.zeros(nj, np.int64)
+        pruned_flags = [False] * nj
+        empty_i = np.empty(0, np.int64)
+        empty_w = np.empty(0, np.float32)
+        for si in range(len(reader.segments)):
+            bmx = ex.block_index(si, field)
+            cs = ex.chunked_scorer(si, field)
+            if bmx is None or cs is None:
                 continue
-            tiles = [
-                ex.term_tiles(si, field, list(j.plan.terms), j.plan.boost)
-                for j in jobs
-            ]
-            T = scoring.next_bucket(max((len(t[0]) for t in tiles), default=1))
-            ti = np.zeros((B, T), np.int32)
-            tw = np.zeros((B, T), np.float32)
-            tv = np.zeros((B, T), bool)
-            for bi, (idx, w) in enumerate(tiles):
-                t = len(idx)
-                ti[bi, :t] = idx
-                tw[bi, :t] = w
-                tv[bi, :t] = True
-            msm = np.ones(B, np.int32)
-            msm[: len(jobs)] = [j.plan.msm for j in jobs]
-            res = scorer(ti, tw, tv, msm)
+            acc, cnt = cs.new_acc(with_cnt)
+            a_tiles: List[np.ndarray] = []
+            a_w: List[np.ndarray] = []
+            deferred: List[list] = []
+            for ji, j in enumerate(jobs):
+                plans = bmx.plan(list(j.plan.terms), j.plan.boost)
+                tl, wl, hots = [], [], []
+                for p in plans:
+                    if prune[ji] and p.hot:
+                        hots.append(p)
+                    else:
+                        tl.append(
+                            np.arange(
+                                p.tile_start, p.tile_start + p.tile_count, dtype=np.int64
+                            )
+                        )
+                        wl.append(np.full(p.tile_count, p.weight, np.float32))
+                if not tl and hots:
+                    # the essential set must be non-empty or θ is -inf
+                    # and nothing prunes: promote the cheapest hot term
+                    hots.sort(key=lambda p: p.tile_count)
+                    p = hots.pop(0)
+                    tl.append(
+                        np.arange(
+                            p.tile_start, p.tile_start + p.tile_count, dtype=np.int64
+                        )
+                    )
+                    wl.append(np.full(p.tile_count, p.weight, np.float32))
+                a_tiles.append(np.concatenate(tl) if tl else empty_i)
+                a_w.append(np.concatenate(wl) if wl else empty_w)
+                deferred.append(hots)
+            acc, cnt = cs.score_into(acc, cnt, a_tiles, a_w)
             self.stats["launches"] += 1
-            self._collect(
-                jobs,
-                per_job_cands,
-                totals,
-                si,
-                np.asarray(res.scores),
-                np.asarray(res.docs),
-                np.asarray(res.totals),
-            )
+            if any(deferred):
+                # ---- the threshold broadcast + survival test ----
+                theta, accmax = cs.threshold(acc, kb)
+                b_tiles: List[np.ndarray] = []
+                b_w: List[np.ndarray] = []
+                for ji, hots in enumerate(deferred):
+                    tl, wl = [], []
+                    if hots:
+                        sum_bounds = np.zeros(bmx.tiling.n_blocks, np.float32)
+                        for p in hots:
+                            sum_bounds += bmx.block_bounds(p)
+                        potential = accmax[ji] + sum_bounds
+                        for p in hots:
+                            kept = bmx.surviving_tiles(p, potential, theta[ji])
+                            if len(kept) < p.tile_count:
+                                pruned_flags[ji] = True
+                            if len(kept):
+                                tl.append(kept)
+                                wl.append(
+                                    np.full(len(kept), p.weight, np.float32)
+                                )
+                    b_tiles.append(np.concatenate(tl) if tl else empty_i)
+                    b_w.append(np.concatenate(wl) if wl else empty_w)
+                acc, cnt = cs.score_into(acc, cnt, b_tiles, b_w)
+                self.stats["launches"] += 1
+            msm = np.ones(BPAD, np.int32)
+            msm[:nj] = [j.plan.msm for j in jobs]
+            s, d, tot = cs.finalize(acc, cnt, msm, kb)
+            self._collect(jobs, per_job_cands, totals, si, s, d, tot)
         # merge across segments per job: score desc, (segment, doc) asc
-        for bi, j in enumerate(jobs):
-            cands = per_job_cands[bi]
+        for ji, j in enumerate(jobs):
+            cands = per_job_cands[ji]
             cands.sort(key=lambda c: (-c[0], c[1], c[2]))
             page = cands[: j.k]
             hits = [
@@ -250,19 +326,29 @@ class QueryBatcher:
                 )
                 for s, si, d in page
             ]
+            total = int(totals[ji])
+            relation = "eq"
+            if pruned_flags[ji]:
+                self.stats["pruned_jobs"] += 1
+                if j.plan.tth_cap:
+                    # pruned tiles mean the collected count is a lower
+                    # bound; eligibility proved ≥ cap live matches
+                    total = max(total, j.plan.tth_cap)
+                    relation = "gte"
             j.result = TopDocs(
-                total=int(totals[bi]),
+                total=total,
                 hits=hits,
                 max_score=hits[0].score if hits else None,
+                relation=relation,
             )
             j.event.set()
 
     @staticmethod
     def _collect(jobs, per_job_cands, totals, si, s, d, t):
-        for bi in range(len(jobs)):
-            srow = s[bi]
-            drow = d[bi]
+        for ji in range(len(jobs)):
+            srow = s[ji]
+            drow = d[ji]
             finite = np.isfinite(srow)
             for sc, doc in zip(srow[finite], drow[finite]):
-                per_job_cands[bi].append((float(sc), si, int(doc)))
-            totals[bi] += int(t[bi])
+                per_job_cands[ji].append((float(sc), si, int(doc)))
+            totals[ji] += int(t[ji])

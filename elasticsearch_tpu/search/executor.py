@@ -77,6 +77,10 @@ class TopDocs:
     total: int
     hits: List[Hit]
     max_score: Optional[float] = None
+    # Lucene TotalHits.Relation: "eq" when total is exact, "gte" when a
+    # pruned collection proved at least `total` matches (WANDScorer under
+    # totalHitsThreshold)
+    relation: str = "eq"
 
 
 class ShardReader:

@@ -50,35 +50,64 @@ class DevicePostings:
         self.tfs = jax.device_put(pf.tfs, device)
 
 
+class _LazyDeviceMap:
+    """Per-field device uploads, materialized on first use. Uploading
+    every field of every segment eagerly (round 2) burns HBM and makes
+    executor regeneration after refresh O(index) instead of O(touched
+    fields)."""
+
+    def __init__(self, names, build):
+        self._names = set(names)
+        self._build = build
+        self._cache: Dict[str, object] = {}
+
+    def get(self, name, default=None):
+        if name not in self._names:
+            return default
+        v = self._cache.get(name)
+        if v is None:
+            v = self._build(name)
+            self._cache[name] = v
+        return v
+
+    def __getitem__(self, name):
+        v = self.get(name)
+        if v is None:
+            raise KeyError(name)
+        return v
+
+
 class DeviceSegment:
-    """Device-resident mirror of a Segment's hot arrays."""
+    """Device-resident mirror of a Segment's hot arrays (lazy per field)."""
 
     def __init__(self, seg: Segment, device=None):
         self.seg = seg
         self.device = device
-        self.postings: Dict[str, DevicePostings] = {}
-        self.numerics: Dict[str, Tuple[jax.Array, jax.Array]] = {}
-        self.vectors: Dict[str, Tuple[jax.Array, jax.Array]] = {}
-        self.ordinals: Dict[str, Tuple[jax.Array, jax.Array]] = {}
-        for fname, pf in seg.postings.items():
-            self.postings[fname] = DevicePostings(pf, device)
-        for fname, nf in seg.numerics.items():
-            self.numerics[fname] = (
-                jax.device_put(nf.values, device),
-                jax.device_put(nf.exists, device),
-            )
-        for fname, vf in seg.vectors.items():
+        self.postings = _LazyDeviceMap(
+            seg.postings, lambda f: DevicePostings(seg.postings[f], device)
+        )
+        self.numerics = _LazyDeviceMap(
+            seg.numerics,
+            lambda f: (
+                jax.device_put(seg.numerics[f].values, device),
+                jax.device_put(seg.numerics[f].exists, device),
+            ),
+        )
+
+        def _vec(f):
+            vf = seg.vectors[f]
             mat = vf.unit_vectors if vf.similarity == "cosine" else vf.vectors
-            self.vectors[fname] = (
-                jax.device_put(mat, device),
-                jax.device_put(vf.exists, device),
-            )
-        for fname, of in seg.ordinals.items():
-            # multi-value ordinal CSR for device range/terms masks
-            self.ordinals[fname] = (
-                jax.device_put(of.mv_ords, device),
-                jax.device_put(of.mv_offsets.astype(np.int32), device),
-            )
+            return (jax.device_put(mat, device), jax.device_put(vf.exists, device))
+
+        self.vectors = _LazyDeviceMap(seg.vectors, _vec)
+        # multi-value ordinal CSR for device range/terms masks
+        self.ordinals = _LazyDeviceMap(
+            seg.ordinals,
+            lambda f: (
+                jax.device_put(seg.ordinals[f].mv_ords, device),
+                jax.device_put(seg.ordinals[f].mv_offsets.astype(np.int32), device),
+            ),
+        )
 
 
 class JaxExecutor:
@@ -101,11 +130,16 @@ class JaxExecutor:
         self._oracle = NumpyExecutor(reader, k1, b)
         self._inv_norm_cache: Dict[Tuple[int, str], jax.Array] = {}
         self._id_maps: Dict[int, Dict[str, int]] = {}
-        # batched-scorer / block-max caches keyed (si, field, k): reused
+        # block-max / chunked-scorer caches keyed (si, field): reused
         # across requests for the lifetime of this executor (= one reader
-        # generation)
-        self._batched_scorers: Dict[Tuple[int, str, int], object] = {}
-        self._wand_scorers: Dict[Tuple[int, str, int], object] = {}
+        # generation). The underlying tilings + device arrays are cached
+        # on the immutable segments and survive executor regeneration.
+        self._block_indexes: Dict[Tuple[int, str], object] = {}
+        self._chunked_scorers: Dict[Tuple[int, str], object] = {}
+        self._seg_weights: Dict[Tuple[int, str], np.ndarray] = {}
+        self._df_maps: Dict[str, Dict[str, int]] = {}
+        self._shard_dfs: Dict[Tuple[str, str], int] = {}
+        self._deleted_count: Optional[int] = None
 
     # ---- per-(segment, field) dense inverse-norm array ----
 
@@ -315,55 +349,93 @@ class JaxExecutor:
         )
         return scores, cnt
 
-    def batched_scorer(self, si: int, field: str, k: int):
-        """Cached jitted batched scorer over one segment's postings —
-        closes over the device arrays + live bitmap for this reader
-        generation. Returns None when the field has no postings."""
-        key = (si, field, k)
-        sc = self._batched_scorers.get(key)
-        if sc is None:
-            seg = self.reader.segments[si]
-            dp = self.device_segments[si].postings.get(field)
-            if dp is None:
-                return None
-            live = self.reader.live_docs[si]
-            sc = scoring.make_batched_bm25_scorer(
-                dp.doc_ids,
-                dp.tfs,
-                self._inv_norm(si, field, seg.num_docs),
-                seg.num_docs,
-                k,
-                live,
-            )
-            self._batched_scorers[key] = sc
-        return sc
+    # ---- serving-path scorer plumbing (batcher entry points) ----
 
-    def wand_scorer(self, si: int, field: str, k: int):
-        """Cached block-max WAND scorer (exact pruned top-k) for one
-        segment. Only valid when the segment has no deleted docs (the
-        block bounds don't account for liveDocs)."""
-        if self.reader.live_docs[si] is not None:
-            return None
-        key = (si, field, k)
-        sc = self._wand_scorers.get(key)
-        if sc is None:
-            from ..ops.wand import BlockMaxIndex, BlockMaxScorer
+    def _segment_weights(self, si: int, field: str) -> np.ndarray:
+        """float32[n_terms] SHARD-level BM25 idf per local term id of one
+        segment (IndexSearcher.collectionStatistics — same stats the
+        unpruned path uses, so batched/pruned scores match the oracle)."""
+        key = (si, field)
+        w = self._seg_weights.get(key)
+        if w is None:
+            pf = self.reader.segments[si].postings[field]
+            dc, _ = self.reader.field_stats(field)
+            if len(self.reader.segments) == 1:
+                df = pf.term_df.astype(np.float64)
+            else:
+                dfmap = self._df_map(field)
+                df = np.array([dfmap.get(t, 0) for t in pf.terms], np.float64)
+            # same float path as bm25.idf (float64 math, float32 result)
+            w = np.float32(np.log(1.0 + (dc - df + 0.5) / (df + 0.5)))
+            self._seg_weights[key] = w
+        return w
+
+    def _df_map(self, field: str) -> Dict[str, int]:
+        m = self._df_maps.get(field)
+        if m is None:
+            m = {}
+            for seg in self.reader.segments:
+                pf = seg.postings.get(field)
+                if pf is not None:
+                    for t, d in zip(pf.terms, pf.term_df.tolist()):
+                        m[t] = m.get(t, 0) + int(d)
+            self._df_maps[field] = m
+        return m
+
+    def shard_df(self, field: str, term: str) -> int:
+        key = (field, term)
+        df = self._shard_dfs.get(key)
+        if df is None:
+            df, _ = self.reader.term_stats(field, term)
+            self._shard_dfs[key] = df
+        return df
+
+    @property
+    def deleted_count(self) -> int:
+        if self._deleted_count is None:
+            self._deleted_count = int(
+                sum(int((~l).sum()) for l in self.reader.live_docs if l is not None)
+            )
+        return self._deleted_count
+
+    def block_index(self, si: int, field: str):
+        """Cached BlockMaxIndex (shard-level stats over the segment's
+        block-aligned tiling) — None when the field has no postings."""
+        key = (si, field)
+        bmx = self._block_indexes.get(key)
+        if bmx is None:
+            from ..ops.wand import BlockMaxIndex, get_tiling
 
             seg = self.reader.segments[si]
             pf = seg.postings.get(field)
             if pf is None:
                 return None
-            idx_key = (si, field)
-            bidx = getattr(self, "_wand_indexes", None)
-            if bidx is None:
-                self._wand_indexes = bidx = {}
-            index = bidx.get(idx_key)
-            if index is None:
-                index = BlockMaxIndex(pf, seg.num_docs, k1=self.k1, b=self.b)
-                bidx[idx_key] = index
-            sc = BlockMaxScorer(index, k=k)
-            self._wand_scorers[key] = sc
-        return sc
+            tiling = get_tiling(pf, seg.num_docs)
+            bmx = BlockMaxIndex(
+                tiling, self._segment_weights(si, field), self._oracle._field_cache(field)
+            )
+            self._block_indexes[key] = bmx
+        return bmx
+
+    def chunked_scorer(self, si: int, field: str):
+        """Cached fixed-shape ChunkedScorer over the block-aligned tiling
+        of one segment (the batcher's launch engine)."""
+        key = (si, field)
+        cs = self._chunked_scorers.get(key)
+        if cs is None:
+            bmx = self.block_index(si, field)
+            if bmx is None:
+                return None
+            seg = self.reader.segments[si]
+            cs = scoring.ChunkedScorer(
+                bmx.tiling.doc_ids,
+                bmx.tiling.tfs,
+                self._inv_norm(si, field, seg.num_docs),
+                self.reader.live_docs[si],
+                block_size=bmx.tiling.block_size,
+            )
+            self._chunked_scorers[key] = cs
+        return cs
 
     def _exec_match(self, q: MatchQuery, si: int) -> Tuple[jax.Array, jax.Array]:
         seg = self.reader.segments[si]

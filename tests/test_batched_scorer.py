@@ -1,14 +1,14 @@
-"""The batched BM25 scorer (one [B,T,128] launch for B queries) must agree
-with the oracle per query — this is the benchmark hot path."""
+"""The fixed-shape chunked batched scorer must agree with the oracle per
+query — this is the benchmark hot path (ops/scoring.py ChunkedScorer over
+the block-aligned tiling of ops/wand.py)."""
 
 import numpy as np
-import jax.numpy as jnp
 
 from elasticsearch_tpu.analysis import AnalysisRegistry
 from elasticsearch_tpu.index.mapping import DocumentParser, Mappings
 from elasticsearch_tpu.index.segment import SegmentBuilder
-from elasticsearch_tpu.models import bm25
-from elasticsearch_tpu.ops import scoring
+from elasticsearch_tpu.ops.scoring import BPAD, TCHUNK, ChunkedScorer
+from elasticsearch_tpu.ops.wand import BlockMaxIndex, get_tiling
 from elasticsearch_tpu.search import dsl
 from elasticsearch_tpu.search.executor import NumpyExecutor, ShardReader
 
@@ -30,18 +30,39 @@ def build(n_docs=150, seed=13):
     return ShardReader([seg], mappings, analysis), seg
 
 
-def test_batched_matches_oracle():
-    reader, seg = build()
+def plan_tiles(bmx, terms, weights):
+    """All tiles of the given terms (exact path: nothing deferred)."""
+    tl, wl = [], []
+    for p in bmx.plan(terms):
+        tl.append(np.arange(p.tile_start, p.tile_start + p.tile_count))
+        wl.append(np.full(p.tile_count, p.weight, np.float32))
+    return (
+        np.concatenate(tl) if tl else np.empty(0, np.int64),
+        np.concatenate(wl) if wl else np.empty(0, np.float32),
+    )
+
+
+def make_scorer(reader, seg, live=None):
     oracle = NumpyExecutor(reader)
     pf = seg.postings["body"]
-    n = seg.num_docs
+    tiling = get_tiling(pf, seg.num_docs)
+    weights = np.float32(
+        np.log(
+            1.0
+            + (pf.stats.doc_count - pf.term_df.astype(np.float64) + 0.5)
+            / (pf.term_df.astype(np.float64) + 0.5)
+        )
+    )
+    bmx = BlockMaxIndex(tiling, weights, oracle._field_cache("body"))
+    inv_norm = oracle._field_cache("body")[pf.norms.astype(np.int64)]
+    cs = ChunkedScorer(tiling.doc_ids, tiling.tfs, inv_norm, live)
+    return oracle, bmx, cs
+
+
+def test_chunked_matches_oracle():
+    reader, seg = build()
+    oracle, bmx, cs = make_scorer(reader, seg)
     k = 10
-
-    # per-doc inverse-norm array
-    cache = oracle._field_cache("body")
-    inv_norm = cache[pf.norms.astype(np.int64)]
-
-    scorer = scoring.make_batched_bm25_scorer(pf.doc_ids, pf.tfs, inv_norm, n, k)
 
     queries = [
         ("red", "or"),
@@ -53,37 +74,19 @@ def test_batched_matches_oracle():
         ("green blue teal pink", "or"),
         ("red red green", "or"),  # duplicate term, each occurrence scores
     ]
-    T = 16
-    B = len(queries)
-    tile_idx = np.zeros((B, T), np.int32)
-    tile_w = np.zeros((B, T), np.float32)
-    tile_v = np.zeros((B, T), bool)
-    msm = np.zeros(B, np.int32)
-    for qi, (text, op) in enumerate(queries):
+    tiles, ws, msms = [], [], []
+    for text, op in queries:
         terms = text.split()
-        idx_list, w_list = [], []
-        for t in terms:
-            tid = pf.term_id(t)
-            assert tid >= 0
-            s0, c0 = int(pf.term_tile_start[tid]), int(pf.term_tile_count[tid])
-            w = float(oracle._term_weight("body", t))
-            idx_list.extend(range(s0, s0 + c0))
-            w_list.extend([w] * c0)
-        idx, w, v = scoring.pad_tiles(
-            np.asarray(idx_list, np.int32), np.asarray(w_list, np.float32), bucket=T
-        )
-        tile_idx[qi], tile_w[qi], tile_v[qi] = idx, w, v
-        msm[qi] = len(terms) if op == "and" else 1
+        tl, wl = plan_tiles(bmx, terms, None)
+        tiles.append(tl)
+        ws.append(wl)
+        msms.append(len(set(terms)) if op == "and" else 1)
 
-    res = scorer(
-        jnp.asarray(tile_idx),
-        jnp.asarray(tile_w),
-        jnp.asarray(tile_v),
-        jnp.asarray(msm),
-    )
-    scores = np.asarray(res.scores)
-    docs = np.asarray(res.docs)
-    totals = np.asarray(res.totals)
+    acc, cnt = cs.new_acc(with_cnt=True)
+    acc, cnt = cs.score_into(acc, cnt, tiles, ws)
+    msm = np.ones(BPAD, np.int32)
+    msm[: len(queries)] = msms
+    scores, docs, totals = cs.finalize(acc, cnt, msm, k)
 
     for qi, (text, op) in enumerate(queries):
         q = dsl.parse_query({"match": {"body": {"query": text, "operator": op}}})
@@ -95,6 +98,52 @@ def test_batched_matches_oracle():
             np.testing.assert_allclose(
                 scores[qi, j], ref.hits[j].score, rtol=1e-5, atol=1e-6
             )
-        # beyond the real hits, scores must be -inf
         for j in range(n_hits, k):
             assert np.isneginf(scores[qi, j])
+
+
+def test_chunking_splits_long_tile_lists():
+    """A tile list longer than TCHUNK must produce identical results to
+    a single-launch equivalent (accumulation across launches)."""
+    reader, seg = build(n_docs=400, seed=3)
+    oracle, bmx, cs = make_scorer(reader, seg)
+    # all terms at once → tile count comfortably above 1 for every term;
+    # force tiny chunks by monkeypatching is invasive — instead repeat
+    # the whole term set many times so len(tiles) > TCHUNK
+    tl, wl = plan_tiles(bmx, VOCAB, None)
+    reps = (TCHUNK // max(1, len(tl))) + 2
+    # repeating tiles n times scores every posting n times — compare
+    # against the same repetition through the oracle-equivalent math:
+    # weights scale linearly per repetition for OR queries
+    tiles = [np.tile(tl, reps)]
+    ws = [np.tile(wl, reps)]
+    assert len(tiles[0]) > TCHUNK
+    acc, cnt = cs.new_acc(with_cnt=False)
+    acc, cnt = cs.score_into(acc, cnt, tiles, ws)
+    s_multi, d_multi, _ = cs.finalize(acc, cnt, np.ones(BPAD, np.int32), 10)
+
+    q = dsl.parse_query({"match": {"body": " ".join(VOCAB * reps)}})
+    ref = oracle.search(q, size=10)
+    for j in range(min(10, ref.total)):
+        assert d_multi[0, j] == ref.hits[j].local_doc
+        np.testing.assert_allclose(
+            s_multi[0, j], ref.hits[j].score, rtol=1e-4
+        )
+
+
+def test_live_docs_masked():
+    reader, seg = build(n_docs=80, seed=5)
+    live = np.ones(seg.num_docs, bool)
+    oracle0, bmx, cs0 = make_scorer(reader, seg)
+    tl, wl = plan_tiles(bmx, ["red"], None)
+    acc, cnt = cs0.new_acc(False)
+    acc, _ = cs0.score_into(acc, cnt, [tl], [wl])
+    s, d, tot = cs0.finalize(acc, None, np.ones(BPAD, np.int32), 5)
+    victim = int(d[0, 0])
+    live[victim] = False
+    _, _, cs1 = make_scorer(reader, seg, live=live)
+    acc, cnt = cs1.new_acc(False)
+    acc, _ = cs1.score_into(acc, cnt, [tl], [wl])
+    s1, d1, tot1 = cs1.finalize(acc, None, np.ones(BPAD, np.int32), 5)
+    assert victim not in d1[0].tolist()
+    assert tot1[0] == tot[0] - 1

@@ -71,13 +71,25 @@ class TestPlanExtraction:
             extract_match_plan(q, service.mappings, service.analysis, False) is None
         )
 
-    def test_wand_requires_capped_totals(self, service):
+    def test_wand_eligibility(self, service):
         q = dsl.parse_query({"match": {"body": "alpha beta"}})
+        # exact totals requested → no pruning
         assert not extract_match_plan(
-            q, service.mappings, service.analysis, tth_capped=False
+            q, service.mappings, service.analysis, True
+        ).wand_ok
+        # uncounted and capped (the ES default of 10_000) → pruning ok
+        assert extract_match_plan(
+            q, service.mappings, service.analysis, False
         ).wand_ok
         assert extract_match_plan(
-            q, service.mappings, service.analysis, tth_capped=True
+            q, service.mappings, service.analysis, 10_000
+        ).wand_ok
+        qa = dsl.parse_query(
+            {"match": {"body": {"query": "alpha beta", "operator": "and"}}}
+        )
+        # conjunctions need match counts → no pruning
+        assert not extract_match_plan(
+            qa, service.mappings, service.analysis, False
         ).wand_ok
 
 

@@ -178,3 +178,35 @@ class TestPersistence:
         finally:
             b2.close()
             a.close()
+
+    def test_master_restart_recovers_metadata(self, tmp_path):
+        """A restarted MASTER must recover its persisted index metadata
+        and re-create its local shards (the round-2 regression: start()
+        applied the recovered state against itself, the monotonic check
+        early-returned for any version != 1, and every subsequent op
+        failed with 'no such index')."""
+        a = TpuNode("node-0", data_path=str(tmp_path / "node-0")).start()
+        try:
+            a.create_index("solo", {"settings": {"number_of_shards": 2}})
+            for i in range(5):
+                a.index_doc("solo", str(i), {"body": f"persisted doc {i}"})
+            a.refresh("solo")
+            for li in a.indices.values():
+                for eng in li.shards.values():
+                    eng.flush()
+        finally:
+            a.close()
+        # several restart generations bump the state version well past 1
+        for gen in range(2):
+            a2 = TpuNode("node-0", data_path=str(tmp_path / "node-0")).start()
+            try:
+                assert "solo" in a2.state["indices"], "metadata lost on restart"
+                assert "solo" in a2.indices, "local index not re-created"
+                assert sum(
+                    e.num_docs for e in a2.indices["solo"].shards.values()
+                ) == 5
+                assert a2.get_doc("solo", "3")["_source"]["body"] == "persisted doc 3"
+                resp = a2.search("solo", {"query": {"match": {"body": "persisted"}}})
+                assert resp["hits"]["total"]["value"] == 5
+            finally:
+                a2.close()

@@ -1,4 +1,4 @@
-"""Block-max WAND exactness + Pallas int8 kNN kernel tests."""
+"""Block-max pruning exactness + Pallas int8 kNN kernel tests."""
 
 import numpy as np
 import pytest
@@ -6,10 +6,9 @@ import pytest
 from elasticsearch_tpu.analysis import AnalysisRegistry
 from elasticsearch_tpu.index.mapping import DocumentParser, Mappings
 from elasticsearch_tpu.index.segment import SegmentBuilder
-from elasticsearch_tpu.models import bm25
 from elasticsearch_tpu.ops.pallas_knn import QuantizedVectors, quantize_int8
-from elasticsearch_tpu.ops.scoring import make_batched_bm25_scorer, next_bucket
-from elasticsearch_tpu.ops.wand import BlockMaxIndex, BlockMaxScorer
+from elasticsearch_tpu.ops.scoring import BPAD, ChunkedScorer
+from elasticsearch_tpu.ops.wand import BlockMaxIndex, get_tiling
 
 
 def build_segment(n_docs=3000, vocab=300, seed=11):
@@ -34,65 +33,110 @@ def seg():
     return build_segment()
 
 
-def dense_reference(seg, term_lists, k):
+def make_index(seg, block_size=512, hot_min=8, live=None):
+    from elasticsearch_tpu.models import bm25
+
     pf = seg.postings["body"]
     st = pf.stats
     avgdl = bm25.avg_field_length(st.sum_total_term_freq, st.doc_count or 1)
     cache = bm25.norm_inverse_cache(avgdl)
-    inv_norm = cache[pf.norms.astype(np.int64)].astype(np.float32)
-    weights = {
-        t: float(bm25.idf(st.doc_count, int(pf.term_df[i])))
-        for i, t in enumerate(pf.terms)
-    }
-    scorer = make_batched_bm25_scorer(pf.doc_ids, pf.tfs, inv_norm, seg.num_docs, k)
-    B = len(term_lists)
-    t_max = 1
-    plans = []
+    df = pf.term_df.astype(np.float64)
+    weights = np.float32(np.log(1.0 + (st.doc_count - df + 0.5) / (df + 0.5)))
+    tiling = get_tiling(pf, seg.num_docs, block_size, hot_min)
+    bmx = BlockMaxIndex(tiling, weights, cache)
+    inv_norm = cache[pf.norms.astype(np.int64)]
+    cs = ChunkedScorer(
+        tiling.doc_ids, tiling.tfs, inv_norm, live, block_size=block_size
+    )
+    return bmx, cs
+
+
+def all_tiles(bmx, terms):
+    tl, wl = [], []
+    for p in bmx.plan(terms):
+        tl.append(np.arange(p.tile_start, p.tile_start + p.tile_count))
+        wl.append(np.full(p.tile_count, p.weight, np.float32))
+    return (
+        np.concatenate(tl) if tl else np.empty(0, np.int64),
+        np.concatenate(wl) if wl else np.empty(0, np.float32),
+    )
+
+
+def exact_search(bmx, cs, term_lists, k):
+    """Reference: score every tile of every term (no pruning)."""
+    tiles = []
+    ws = []
     for terms in term_lists:
-        idxs, ws = [], []
-        for t in terms:
-            tid = pf.term_id(t)
-            if tid < 0:
-                continue
-            s0 = int(pf.term_tile_start[tid])
-            c = int(pf.term_tile_count[tid])
-            idxs.extend(range(s0, s0 + c))
-            ws.extend([weights[t]] * c)
-        plans.append((idxs, ws))
-        t_max = max(t_max, len(idxs))
-    T = next_bucket(t_max)
-    ti = np.zeros((B, T), np.int32)
-    tw = np.zeros((B, T), np.float32)
-    tv = np.zeros((B, T), bool)
-    for bi, (idxs, ws) in enumerate(plans):
-        ti[bi, : len(idxs)] = idxs
-        tw[bi, : len(ws)] = ws
-        tv[bi, : len(idxs)] = True
-    out = scorer(ti, tw, tv, np.ones(B, np.int32))
-    return np.asarray(out.scores), np.asarray(out.docs), np.asarray(out.totals)
+        tl, wl = all_tiles(bmx, terms)
+        tiles.append(tl)
+        ws.append(wl)
+    acc, cnt = cs.new_acc(False)
+    acc, _ = cs.score_into(acc, cnt, tiles, ws)
+    return cs.finalize(acc, None, np.ones(BPAD, np.int32), k)
+
+
+def pruned_search(bmx, cs, term_lists, k):
+    """The batcher's two-phase pruned flow (search/batcher.py mirror)."""
+    a_tiles, a_w, deferred = [], [], []
+    for terms in term_lists:
+        tl, wl, hots = [], [], []
+        for p in bmx.plan(terms):
+            if p.hot:
+                hots.append(p)
+            else:
+                tl.append(np.arange(p.tile_start, p.tile_start + p.tile_count))
+                wl.append(np.full(p.tile_count, p.weight, np.float32))
+        if not tl and hots:
+            hots.sort(key=lambda p: p.tile_count)
+            p = hots.pop(0)
+            tl.append(np.arange(p.tile_start, p.tile_start + p.tile_count))
+            wl.append(np.full(p.tile_count, p.weight, np.float32))
+        a_tiles.append(np.concatenate(tl) if tl else np.empty(0, np.int64))
+        a_w.append(np.concatenate(wl) if wl else np.empty(0, np.float32))
+        deferred.append(hots)
+    acc, cnt = cs.new_acc(False)
+    acc, _ = cs.score_into(acc, cnt, a_tiles, a_w)
+    stats = {"hot_tiles_total": 0, "phase_b_tiles": 0}
+    if any(deferred):
+        theta, accmax = cs.threshold(acc, k)
+        b_tiles, b_w = [], []
+        for ji, hots in enumerate(deferred):
+            tl, wl = [], []
+            if hots:
+                sum_bounds = np.zeros(bmx.tiling.n_blocks, np.float32)
+                for p in hots:
+                    sum_bounds += bmx.block_bounds(p)
+                potential = accmax[ji] + sum_bounds
+                for p in hots:
+                    stats["hot_tiles_total"] += p.tile_count
+                    kept = bmx.surviving_tiles(p, potential, theta[ji])
+                    stats["phase_b_tiles"] += len(kept)
+                    if len(kept):
+                        tl.append(kept)
+                        wl.append(np.full(len(kept), p.weight, np.float32))
+            b_tiles.append(np.concatenate(tl) if tl else np.empty(0, np.int64))
+            b_w.append(np.concatenate(wl) if wl else np.empty(0, np.float32))
+        acc, _ = cs.score_into(acc, None, b_tiles, b_w)
+    s, d, tot = cs.finalize(acc, None, np.ones(BPAD, np.int32), k)
+    return s, d, tot, stats
 
 
 class TestBlockMaxWand:
     def test_exact_topk_vs_dense(self, seg):
         k = 10
-        idx = BlockMaxIndex(
-            seg.postings["body"], seg.num_docs, block_size=512,
-            hot_min_postings_per_block=8,
-        )
-        assert any(t.hot for t in idx.terms), "corpus should have hot terms"
-        scorer = BlockMaxScorer(idx, k=k)
-        rng = np.random.default_rng(5)
+        bmx, cs = make_index(seg)
+        assert bool(bmx.tiling.term_hot.any()), "corpus should have hot terms"
         pf = seg.postings["body"]
+        rng = np.random.default_rng(5)
         queries = []
         for _ in range(16):
             n = int(rng.integers(1, 4))
-            # mix of hot (common, low index) and rare terms
             terms = [f"w{int(rng.integers(0, 10))}"] + [
                 f"w{int(rng.integers(10, 300))}" for _ in range(n)
             ]
             queries.append([t for t in terms if pf.term_id(t) >= 0])
-        s, d, tot, stats = scorer.search_batch(queries)
-        rs, rd, rtot = dense_reference(seg, queries, k)
+        s, d, tot, stats = pruned_search(bmx, cs, queries, k)
+        rs, rd, rtot = exact_search(bmx, cs, queries, k)
         for bi in range(len(queries)):
             n_hits = int((rs[bi] > -np.inf).sum())
             nn = min(n_hits, k)
@@ -105,25 +149,34 @@ class TestBlockMaxWand:
             assert tot[bi] <= rtot[bi]
 
     def test_pruning_happens(self, seg):
-        idx = BlockMaxIndex(
-            seg.postings["body"], seg.num_docs, block_size=512,
-            hot_min_postings_per_block=8,
-        )
-        scorer = BlockMaxScorer(idx, k=5)
+        bmx, cs = make_index(seg)
         # rare term + very common term: common term's tiles should prune
         queries = [["w200", "w0"]] * 4
-        s, d, tot, stats = scorer.search_batch(queries)
+        s, d, tot, stats = pruned_search(bmx, cs, queries, 5)
         assert stats["hot_tiles_total"] > 0
         assert stats["phase_b_tiles"] < stats["hot_tiles_total"]
 
     def test_pure_rare_query_no_phase_b(self, seg):
-        idx = BlockMaxIndex(
-            seg.postings["body"], seg.num_docs, block_size=512,
-            hot_min_postings_per_block=8,
-        )
-        scorer = BlockMaxScorer(idx, k=5)
-        s, d, tot, stats = scorer.search_batch([["w250"], ["w299"]])
+        bmx, cs = make_index(seg)
+        s, d, tot, stats = pruned_search(bmx, cs, [["w250"], ["w299"]], 5)
         assert stats["hot_tiles_total"] == 0
+
+    def test_pruning_exact_with_deleted_docs(self, seg):
+        """Deletions must not break pruned exactness: stale (pre-delete)
+        bounds only overestimate, and θ/collection mask deleted docs."""
+        k = 10
+        rng = np.random.default_rng(9)
+        live = np.ones(seg.num_docs, bool)
+        live[rng.choice(seg.num_docs, size=seg.num_docs // 5, replace=False)] = False
+        bmx, cs = make_index(seg, live=live)
+        queries = [["w0", "w150"], ["w1", "w2", "w250"], ["w3"], ["w0", "w1"]]
+        s, d, tot, stats = pruned_search(bmx, cs, queries, k)
+        rs, rd, rtot = exact_search(bmx, cs, queries, k)
+        for bi in range(len(queries)):
+            nn = min(int((rs[bi] > -np.inf).sum()), k)
+            np.testing.assert_allclose(s[bi][:nn], rs[bi][:nn], rtol=1e-5)
+            np.testing.assert_array_equal(d[bi][:nn], rd[bi][:nn])
+            assert not np.isin(d[bi][:nn], np.nonzero(~live)[0]).any()
 
 
 class TestInt8Quantization:
