@@ -48,8 +48,10 @@ def log(*a):
 
 N_DOCS = 1_000_000
 VOCAB = 50_000
-N_QUERIES = 2048
-THREADS = 32
+N_QUERIES = 4096
+THREADS = 192  # enough in-flight requests to keep several fused
+# batches pipelined through the device tunnel (see ops/scoring.py)
+ORACLE_THREADS = 32  # the CPU oracle is GIL-bound; more threads only thrash
 K = 10
 SEED = 42
 AVG_LEN = (15, 35)  # uniform doc length range (tokens)
@@ -270,7 +272,9 @@ def main():
 
     # measured CPU baseline: NumPy oracle, same path, same harness
     n_base = 96
-    base_qps, base_p50, _ = run_load(svc_np, queries[:n_base])
+    base_qps, base_p50, _ = run_load(
+        svc_np, queries[:n_base], threads=ORACLE_THREADS
+    )
     log(f"cpu oracle: {base_qps:.1f} QPS, p50={base_p50:.2f}ms")
 
     # parity gate

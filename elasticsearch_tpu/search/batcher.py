@@ -110,14 +110,21 @@ class _Job:
         self.error: Optional[BaseException] = None
 
 
-class QueryBatcher:
-    """One dispatcher thread per index: REST worker threads submit jobs
-    and block; the worker scores whole groups in shared launches."""
+WORKERS = 6  # parallel dispatcher pipelines (the device tunnel overlaps
+# concurrent round trips — see ops/scoring.py module comment)
 
-    def __init__(self, max_batch: int = MAX_BATCH):
+
+class QueryBatcher:
+    """Dispatcher pipelines per index: REST worker threads submit jobs
+    and block; workers score whole groups in shared one-round-trip
+    launches. Several workers run concurrently so device round trips
+    overlap (continuous batching × pipelining)."""
+
+    def __init__(self, max_batch: int = MAX_BATCH, workers: int = WORKERS):
         self.max_batch = min(max_batch, BPAD)
+        self.workers = workers
         self._queue: "queue.Queue[_Job]" = queue.Queue()
-        self._thread: Optional[threading.Thread] = None
+        self._threads: List[threading.Thread] = []
         self._closed = False
         self._lock = threading.Lock()
         # observability: how many launches / jobs / batched jobs
@@ -126,20 +133,25 @@ class QueryBatcher:
             "jobs": 0,
             "max_batch_seen": 0,
             "pruned_jobs": 0,
+            "fused_jobs": 0,
         }
 
     def _ensure_thread(self):
         with self._lock:
-            if self._thread is None or not self._thread.is_alive():
-                self._thread = threading.Thread(
-                    target=self._run, name="query-batcher", daemon=True
+            self._threads = [t for t in self._threads if t.is_alive()]
+            while len(self._threads) < self.workers:
+                t = threading.Thread(
+                    target=self._run,
+                    name=f"query-batcher-{len(self._threads)}",
+                    daemon=True,
                 )
-                self._thread.start()
+                t.start()
+                self._threads.append(t)
 
     def close(self):
         self._closed = True
-        if self._thread is not None:
-            self._queue.put(None)  # wake the worker
+        for _ in self._threads:
+            self._queue.put(None)  # wake the workers
         # fail anything still queued so no submitter blocks forever
         self._drain_queue(RuntimeError("query batcher closed"))
 
@@ -246,6 +258,22 @@ class QueryBatcher:
         empty_i = np.empty(0, np.int64)
         empty_w = np.empty(0, np.float32)
         for si in range(len(reader.segments)):
+            # ---- fused single-round-trip path (large segments) ----
+            fs = ex.fused_scorer(si, field)
+            if fs is not None:
+                fplans = [
+                    ex.fused_plan(
+                        fs, si, field, j.plan.terms, j.plan.boost, j.plan.msm
+                    )
+                    for j in jobs
+                ]
+                if all(p is not None for p in fplans):
+                    s, d, tot = fs.search(fplans, kb, with_cnt)
+                    self.stats["launches"] += 1
+                    self.stats["fused_jobs"] += nj
+                    self._collect(jobs, per_job_cands, totals, si, s, d, tot)
+                    continue
+            # ---- chunked path (small segments / slot overflow) ----
             bmx = ex.block_index(si, field)
             cs = ex.chunked_scorer(si, field)
             if bmx is None or cs is None:

@@ -43,6 +43,12 @@ from .dsl import (
 )
 from .executor import Hit, NumpyExecutor, ShardReader, TopDocs, _coerce_numeric
 
+# segments below this size score through the shared-shape chunked path;
+# above it the per-segment fused program + dense hot rows pay off
+FUSED_MIN_DOCS = 100_000
+# HBM budget for dense hot-term tf rows, bytes (uint8 per doc per term)
+DENSE_ROWS_HBM_BUDGET = 512 * 1024 * 1024
+
 
 class DevicePostings:
     def __init__(self, pf, device=None):
@@ -136,6 +142,7 @@ class JaxExecutor:
         # on the immutable segments and survive executor regeneration.
         self._block_indexes: Dict[Tuple[int, str], object] = {}
         self._chunked_scorers: Dict[Tuple[int, str], object] = {}
+        self._fused_scorers: Dict[Tuple[int, str], object] = {}
         self._seg_weights: Dict[Tuple[int, str], np.ndarray] = {}
         self._df_maps: Dict[str, Dict[str, int]] = {}
         self._shard_dfs: Dict[Tuple[str, str], int] = {}
@@ -436,6 +443,106 @@ class JaxExecutor:
             )
             self._chunked_scorers[key] = cs
         return cs
+
+    def fused_scorer(self, si: int, field: str):
+        """Cached single-round-trip FusedScorer for one large segment
+        (ops/scoring.py module comment: on the measured hardware, one
+        fused call with dense hot-term rows beats multi-phase pruning).
+        None for small segments (the chunked path compiles shared shapes
+        there) or fields without postings."""
+        key = (si, field)
+        if key in self._fused_scorers:
+            return self._fused_scorers[key]
+        seg = self.reader.segments[si]
+        pf = seg.postings.get(field)
+        fs = None
+        if pf is not None and seg.num_docs >= FUSED_MIN_DOCS:
+            n = seg.num_docs
+            dp = self.device_segments[si].postings[field]
+            n_terms = len(pf.terms)
+            # per-term max tf (dense rows are uint8: terms with a larger
+            # tf anywhere stay sparse for exactness)
+            counts = pf.term_tile_count.astype(np.int64)
+            starts = pf.term_tile_start.astype(np.int64)
+            tile_of = (
+                np.arange(int(counts.sum()), dtype=np.int64)
+                - np.repeat(np.cumsum(counts) - counts, counts)
+                + np.repeat(starts, counts)
+            )
+            term_of_tile = np.repeat(np.arange(n_terms, dtype=np.int64), counts)
+            term_max_tf = np.zeros(n_terms, np.int64)
+            np.maximum.at(term_max_tf, term_of_tile, pf.tile_max_tf[tile_of])
+            hot_mask = (pf.term_df.astype(np.int64) >= max(1024, n // 128)) & (
+                term_max_tf <= scoring.DENSE_TF_MAX
+            )
+            hot_ids = np.nonzero(hot_mask)[0]
+            # HBM budget for dense rows (uint8 per doc per hot term)
+            max_hot = max(0, DENSE_ROWS_HBM_BUDGET // max(n, 1))
+            if len(hot_ids) > max_hot:
+                order = np.argsort(-pf.term_df[hot_ids])
+                hot_ids = np.sort(hot_ids[order[:max_hot]])
+            if len(hot_ids):
+                sel = np.isin(term_of_tile, hot_ids)
+                hot_tiles = tile_of[sel]
+                rank_map = {int(t): r for r, t in enumerate(hot_ids)}
+                rank_of_tile = np.array(
+                    [rank_map[int(t)] for t in term_of_tile[sel]], np.int32
+                )
+                dense = scoring.build_dense_rows(
+                    dp.doc_ids,
+                    dp.tfs,
+                    jnp.asarray(hot_tiles.astype(np.int32)),
+                    jnp.asarray(rank_of_tile),
+                    n_hot=len(hot_ids),
+                    n_docs=n,
+                )
+                hot_rank = rank_map
+            else:
+                dense = None
+                hot_rank = {}
+            fs = scoring.FusedScorer(
+                dp.doc_ids,
+                dp.tfs,
+                self._inv_norm(si, field, n),
+                self.reader.live_docs[si],
+                dense,
+            )
+            fs.hot_rank = hot_rank
+        self._fused_scorers[key] = fs
+        return fs
+
+    def fused_plan(self, fs, si: int, field: str, terms, boost: float, msm: int):
+        """(rare_tiles, rare_w, hot_ranks, hot_w, msm) for FusedScorer,
+        or None when the query overflows the fixed slot budgets."""
+        pf = self.reader.segments[si].postings[field]
+        weights = self._segment_weights(si, field)
+        rt: list = []
+        rw: list = []
+        hr: list = []
+        hw: list = []
+        for t in terms:
+            tid = pf.term_id(t)
+            if tid < 0:
+                continue
+            w = float(weights[tid]) * boost
+            r = fs.hot_rank.get(tid)
+            if r is not None:
+                hr.append(r)
+                hw.append(w)
+            else:
+                s0 = int(pf.term_tile_start[tid])
+                c = int(pf.term_tile_count[tid])
+                rt.extend(range(s0, s0 + c))
+                rw.extend([w] * c)
+        if len(rt) > fs.t_rare or len(hr) > fs.n_hot_slots:
+            return None
+        return (
+            np.asarray(rt, np.int64),
+            np.asarray(rw, np.float32),
+            np.asarray(hr, np.int64),
+            np.asarray(hw, np.float32),
+            msm,
+        )
 
     def _exec_match(self, q: MatchQuery, si: int) -> Tuple[jax.Array, jax.Array]:
         seg = self.reader.segments[si]

@@ -205,3 +205,51 @@ class TestDirectBatcher:
             ]
             assert td.total == ref.total
         batcher.close()
+
+
+class TestFusedPath:
+    def test_fused_parity_with_unbatched(self, monkeypatch):
+        """Force the fused single-round-trip scorer (normally gated to
+        large segments) and check hit-for-hit parity + exact totals."""
+        from elasticsearch_tpu.search import executor_jax
+
+        monkeypatch.setattr(executor_jax, "FUSED_MIN_DOCS", 10)
+        svc = make_service(n_docs=400, seed=7)
+        try:
+            for text in ["alpha", "alpha beta", "gamma delta epsilon", "mu nu"]:
+                body = {"query": {"match": {"body": text}}, "size": 10}
+                fused = svc.search(body)
+                unbatched = svc.search({**body, "min_score": 0})
+                assert [
+                    (h["_id"], round(h["_score"], 4))
+                    for h in fused["hits"]["hits"]
+                ] == [
+                    (h["_id"], round(h["_score"], 4))
+                    for h in unbatched["hits"]["hits"]
+                ], text
+                assert (
+                    fused["hits"]["total"]["value"]
+                    == unbatched["hits"]["total"]["value"]
+                )
+            assert svc._batcher.stats["fused_jobs"] > 0
+            # operator=and goes through the with_cnt variant
+            body = {
+                "query": {
+                    "match": {"body": {"query": "alpha beta", "operator": "and"}}
+                },
+                "size": 10,
+            }
+            fused = svc.search(body)
+            unbatched = svc.search({**body, "min_score": 0})
+            assert [h["_id"] for h in fused["hits"]["hits"]] == [
+                h["_id"] for h in unbatched["hits"]["hits"]
+            ]
+            # deletes respected through the fused live mask
+            top = svc.search({"query": {"match": {"body": "alpha"}}, "size": 1})
+            victim = top["hits"]["hits"][0]["_id"]
+            svc.delete_doc(victim)
+            svc.refresh()
+            after = svc.search({"query": {"match": {"body": "alpha"}}, "size": 400})
+            assert victim not in [h["_id"] for h in after["hits"]["hits"]]
+        finally:
+            svc.close()
