@@ -29,6 +29,22 @@ DEFAULT_SETTINGS = {k: s.default for k, s in INDEX_SETTINGS.items()}
 
 
 class IndexService:
+    """The shard set of one index.
+
+    Two deployment shapes share this class (the round-3 unification of
+    the former ClusterService/TpuNode split):
+      * local mode (default): every shard lives in this process — the
+        single-node ES layout;
+      * distributed mode: ``routing`` maps shard→node id, only shards
+        routed to ``local_node`` get engines here, and every operation
+        on a remote shard rides ``remote_call(owner, action, payload)``
+        over the transport (TransportSearchAction / TransportShardBulk-
+        Action collapsed onto one seam). The search path runs the full
+        per-shard query phase on the owning node (aggs partials, sort
+        values, knn) and fetches only the global winners' sources
+        (query-then-fetch, SURVEY.md §3.3).
+    """
+
     def __init__(
         self,
         name: str,
@@ -36,6 +52,9 @@ class IndexService:
         mappings_json: Optional[dict] = None,
         analysis: Optional[AnalysisRegistry] = None,
         base_path: Optional[str] = None,
+        routing: Optional[Dict[int, str]] = None,
+        local_node: Optional[str] = None,
+        remote_call=None,
     ):
         self.name = name
         self.settings = dict(DEFAULT_SETTINGS)
