@@ -14,11 +14,23 @@ Scatter-add also accumulates a per-doc *matching-term count*, which makes
 conjunctions (operator=and) and minimum_should_match pure elementwise
 masks — Lucene's leapfrog intersection becomes arithmetic.
 
-All shapes are static: per-query tile lists are padded to a bucket size
-(`pad_tiles`) so XLA compiles once per (bucket, n_docs) pair, and query
-*batches* score as one [B, T, 128] launch (`make_batched_bm25_scorer`) —
-the "score query batches in parallel" idea from BASELINE.json's north
-star. Scores are float32 end-to-end for oracle parity.
+All shapes are static, realized by two serving engines (both batch up
+to BPAD concurrent queries per launch — the "score query batches in
+parallel" idea from BASELINE.json's north star):
+
+* `ChunkedScorer` — shared fixed shapes: every launch scores a
+  [BPAD, TCHUNK, block] slab of gathered tiles into a persistent
+  per-doc accumulator; a query's tile list is split into TCHUNK-sized
+  chunks, so a handful of programs total cover every (segment, query)
+  combination. Used for small segments and as the overflow path.
+* `FusedScorer` — one round trip per large segment: the whole query
+  phase (rare-tile gather + dense hot-term rows + msm mask + top-k)
+  runs as a single compiled program fed by one packed int32 plan
+  upload and returning one packed download, because on the measured
+  hardware each host↔device transfer costs ~100 ms while the kernels
+  are <15 ms (see the cost model below).
+
+Scores are float32 end-to-end for oracle parity.
 """
 
 from __future__ import annotations

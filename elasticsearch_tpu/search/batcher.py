@@ -211,25 +211,36 @@ class QueryBatcher:
                         break
                     if j is not None:
                         batch.append(j)
-                self.stats["jobs"] += len(batch)
-                self.stats["max_batch_seen"] = max(
-                    self.stats["max_batch_seen"], len(batch)
-                )
-                # group jobs that can share launches (same reader
-                # generation, field, and top-k compile bucket)
-                groups: Dict[Tuple, List[_Job]] = {}
-                for j in batch:
-                    kb = 16 if j.k <= 16 else scoring.next_bucket(j.k, 16)
-                    key = (id(j.executor), j.plan.field, kb)
-                    groups.setdefault(key, []).append(j)
-                for (eid, field, kb), jobs in groups.items():
-                    try:
-                        self._run_group(jobs, field, kb)
-                    except BaseException as e:  # surface to all waiters
-                        for j in jobs:
-                            if not j.event.is_set():
-                                j.error = e
-                                j.event.set()
+                try:
+                    with self._lock:
+                        self.stats["jobs"] += len(batch)
+                        self.stats["max_batch_seen"] = max(
+                            self.stats["max_batch_seen"], len(batch)
+                        )
+                    # group jobs that can share launches (same reader
+                    # generation, field, and top-k compile bucket)
+                    groups: Dict[Tuple, List[_Job]] = {}
+                    for j in batch:
+                        kb = 16 if j.k <= 16 else scoring.next_bucket(j.k, 16)
+                        key = (id(j.executor), j.plan.field, kb)
+                        groups.setdefault(key, []).append(j)
+                    for (eid, field, kb), jobs in groups.items():
+                        try:
+                            self._run_group(jobs, field, kb)
+                        except BaseException as e:  # surface to all waiters
+                            for j in jobs:
+                                if not j.event.is_set():
+                                    j.error = e
+                                    j.event.set()
+                except BaseException as e:
+                    # stats/grouping crash between dequeue and the
+                    # per-group guard: already-dequeued jobs are not in
+                    # the queue, so the finally-drain can't reach them —
+                    # fail them here so no submitter blocks forever
+                    for j in batch:
+                        if not j.event.is_set():
+                            j.error = e
+                            j.event.set()
         finally:
             # the dispatcher thread is exiting (close() or a crash
             # outside the per-group guard): nobody may block forever
@@ -269,8 +280,9 @@ class QueryBatcher:
                 ]
                 if all(p is not None for p in fplans):
                     s, d, tot = fs.search(fplans, kb, with_cnt)
-                    self.stats["launches"] += 1
-                    self.stats["fused_jobs"] += nj
+                    with self._lock:
+                        self.stats["launches"] += 1
+                        self.stats["fused_jobs"] += nj
                     self._collect(jobs, per_job_cands, totals, si, s, d, tot)
                     continue
             # ---- chunked path (small segments / slot overflow) ----
@@ -310,7 +322,8 @@ class QueryBatcher:
                 a_w.append(np.concatenate(wl) if wl else empty_w)
                 deferred.append(hots)
             acc, cnt = cs.score_into(acc, cnt, a_tiles, a_w)
-            self.stats["launches"] += 1
+            with self._lock:
+                self.stats["launches"] += 1
             if any(deferred):
                 # ---- the threshold broadcast + survival test ----
                 theta, accmax = cs.threshold(acc, kb)
@@ -335,7 +348,8 @@ class QueryBatcher:
                     b_tiles.append(np.concatenate(tl) if tl else empty_i)
                     b_w.append(np.concatenate(wl) if wl else empty_w)
                 acc, cnt = cs.score_into(acc, cnt, b_tiles, b_w)
-                self.stats["launches"] += 1
+                with self._lock:
+                    self.stats["launches"] += 1
             msm = np.ones(BPAD, np.int32)
             msm[:nj] = [j.plan.msm for j in jobs]
             s, d, tot = cs.finalize(acc, cnt, msm, kb)
@@ -357,12 +371,16 @@ class QueryBatcher:
             total = int(totals[ji])
             relation = "eq"
             if pruned_flags[ji]:
-                self.stats["pruned_jobs"] += 1
+                with self._lock:
+                    self.stats["pruned_jobs"] += 1
+                # pruned tiles mean the collected count is a lower bound —
+                # never report it as exact, even at tth_cap == 0 where the
+                # REST layer omits totals (internal consumers of TopDocs
+                # would otherwise see an exact-looking undercount)
+                relation = "gte"
                 if j.plan.tth_cap:
-                    # pruned tiles mean the collected count is a lower
-                    # bound; eligibility proved ≥ cap live matches
+                    # eligibility proof guaranteed ≥ cap live matches
                     total = max(total, j.plan.tth_cap)
-                    relation = "gte"
             j.result = TopDocs(
                 total=total,
                 hits=hits,

@@ -13,6 +13,7 @@ north star's "posting lists block-decoded once into HBM-resident arrays".
 
 from __future__ import annotations
 
+import threading
 from typing import Dict, List, Optional, Tuple
 
 import jax
@@ -66,14 +67,18 @@ class _LazyDeviceMap:
         self._names = set(names)
         self._build = build
         self._cache: Dict[str, object] = {}
+        self._lock = threading.Lock()
 
     def get(self, name, default=None):
         if name not in self._names:
             return default
         v = self._cache.get(name)
         if v is None:
-            v = self._build(name)
-            self._cache[name] = v
+            with self._lock:
+                v = self._cache.get(name)
+                if v is None:
+                    v = self._build(name)
+                    self._cache[name] = v
         return v
 
     def __getitem__(self, name):
@@ -147,6 +152,11 @@ class JaxExecutor:
         self._df_maps: Dict[str, Dict[str, int]] = {}
         self._shard_dfs: Dict[Tuple[str, str], int] = {}
         self._deleted_count: Optional[int] = None
+        # cache-miss builds are guarded so concurrent batcher workers
+        # can't duplicate a dense hot-row build (each one is up to
+        # DENSE_ROWS_HBM_BUDGET of HBM) or a tiling/compile; RLock
+        # because fused_scorer → _inv_norm/_segment_weights nest
+        self._build_lock = threading.RLock()
 
     # ---- per-(segment, field) dense inverse-norm array ----
 
@@ -154,18 +164,22 @@ class JaxExecutor:
         key = (si, field)
         arr = self._inv_norm_cache.get(key)
         if arr is None:
-            cache = self._oracle._field_cache(field)
-            pf = self.reader.segments[si].postings.get(field)
-            mf = self.reader.mappings.get(field)
-            if pf is None:
-                host = np.zeros(n, np.float32)
-            elif mf is not None and mf.type != TEXT:
-                # omitted norms → encodedNorm 1 for every doc
-                host = np.full(n, cache[1], np.float32)
-            else:
-                host = cache[pf.norms.astype(np.int64)]
-            arr = jax.device_put(host, self.device)
-            self._inv_norm_cache[key] = arr
+            with self._build_lock:
+                arr = self._inv_norm_cache.get(key)
+                if arr is not None:
+                    return arr
+                cache = self._oracle._field_cache(field)
+                pf = self.reader.segments[si].postings.get(field)
+                mf = self.reader.mappings.get(field)
+                if pf is None:
+                    host = np.zeros(n, np.float32)
+                elif mf is not None and mf.type != TEXT:
+                    # omitted norms → encodedNorm 1 for every doc
+                    host = np.full(n, cache[1], np.float32)
+                else:
+                    host = cache[pf.norms.astype(np.int64)]
+                arr = jax.device_put(host, self.device)
+                self._inv_norm_cache[key] = arr
         return arr
 
     # ---- entry point (mirrors NumpyExecutor.search) ----
@@ -365,28 +379,36 @@ class JaxExecutor:
         key = (si, field)
         w = self._seg_weights.get(key)
         if w is None:
-            pf = self.reader.segments[si].postings[field]
-            dc, _ = self.reader.field_stats(field)
-            if len(self.reader.segments) == 1:
-                df = pf.term_df.astype(np.float64)
-            else:
-                dfmap = self._df_map(field)
-                df = np.array([dfmap.get(t, 0) for t in pf.terms], np.float64)
-            # same float path as bm25.idf (float64 math, float32 result)
-            w = np.float32(np.log(1.0 + (dc - df + 0.5) / (df + 0.5)))
-            self._seg_weights[key] = w
+            with self._build_lock:
+                w = self._seg_weights.get(key)
+                if w is not None:
+                    return w
+                pf = self.reader.segments[si].postings[field]
+                dc, _ = self.reader.field_stats(field)
+                if len(self.reader.segments) == 1:
+                    df = pf.term_df.astype(np.float64)
+                else:
+                    dfmap = self._df_map(field)
+                    df = np.array([dfmap.get(t, 0) for t in pf.terms], np.float64)
+                # same float path as bm25.idf (float64 math, float32 result)
+                w = np.float32(np.log(1.0 + (dc - df + 0.5) / (df + 0.5)))
+                self._seg_weights[key] = w
         return w
 
     def _df_map(self, field: str) -> Dict[str, int]:
         m = self._df_maps.get(field)
         if m is None:
-            m = {}
-            for seg in self.reader.segments:
-                pf = seg.postings.get(field)
-                if pf is not None:
-                    for t, d in zip(pf.terms, pf.term_df.tolist()):
-                        m[t] = m.get(t, 0) + int(d)
-            self._df_maps[field] = m
+            with self._build_lock:
+                m = self._df_maps.get(field)
+                if m is not None:
+                    return m
+                m = {}
+                for seg in self.reader.segments:
+                    pf = seg.postings.get(field)
+                    if pf is not None:
+                        for t, d in zip(pf.terms, pf.term_df.tolist()):
+                            m[t] = m.get(t, 0) + int(d)
+                self._df_maps[field] = m
         return m
 
     def shard_df(self, field: str, term: str) -> int:
@@ -409,40 +431,50 @@ class JaxExecutor:
         """Cached BlockMaxIndex (shard-level stats over the segment's
         block-aligned tiling) — None when the field has no postings."""
         key = (si, field)
-        bmx = self._block_indexes.get(key)
-        if bmx is None:
+        if key in self._block_indexes:
+            return self._block_indexes[key]
+        with self._build_lock:
+            if key in self._block_indexes:
+                return self._block_indexes[key]
             from ..ops.wand import BlockMaxIndex, get_tiling
 
             seg = self.reader.segments[si]
             pf = seg.postings.get(field)
             if pf is None:
-                return None
-            tiling = get_tiling(pf, seg.num_docs)
-            bmx = BlockMaxIndex(
-                tiling, self._segment_weights(si, field), self._oracle._field_cache(field)
-            )
+                bmx = None  # cache the miss: no re-lock per batch
+            else:
+                tiling = get_tiling(pf, seg.num_docs)
+                bmx = BlockMaxIndex(
+                    tiling,
+                    self._segment_weights(si, field),
+                    self._oracle._field_cache(field),
+                )
             self._block_indexes[key] = bmx
-        return bmx
+            return bmx
 
     def chunked_scorer(self, si: int, field: str):
         """Cached fixed-shape ChunkedScorer over the block-aligned tiling
         of one segment (the batcher's launch engine)."""
         key = (si, field)
-        cs = self._chunked_scorers.get(key)
-        if cs is None:
+        if key in self._chunked_scorers:
+            return self._chunked_scorers[key]
+        with self._build_lock:
+            if key in self._chunked_scorers:
+                return self._chunked_scorers[key]
             bmx = self.block_index(si, field)
             if bmx is None:
-                return None
-            seg = self.reader.segments[si]
-            cs = scoring.ChunkedScorer(
-                bmx.tiling.doc_ids,
-                bmx.tiling.tfs,
-                self._inv_norm(si, field, seg.num_docs),
-                self.reader.live_docs[si],
-                block_size=bmx.tiling.block_size,
-            )
+                cs = None  # cache the miss: no re-lock per batch
+            else:
+                seg = self.reader.segments[si]
+                cs = scoring.ChunkedScorer(
+                    bmx.tiling.doc_ids,
+                    bmx.tiling.tfs,
+                    self._inv_norm(si, field, seg.num_docs),
+                    self.reader.live_docs[si],
+                    block_size=bmx.tiling.block_size,
+                )
             self._chunked_scorers[key] = cs
-        return cs
+            return cs
 
     def fused_scorer(self, si: int, field: str):
         """Cached single-round-trip FusedScorer for one large segment
@@ -451,6 +483,12 @@ class JaxExecutor:
         None for small segments (the chunked path compiles shared shapes
         there) or fields without postings."""
         key = (si, field)
+        if key in self._fused_scorers:
+            return self._fused_scorers[key]
+        with self._build_lock:
+            return self._fused_scorer_build(key, si, field)
+
+    def _fused_scorer_build(self, key, si: int, field: str):
         if key in self._fused_scorers:
             return self._fused_scorers[key]
         seg = self.reader.segments[si]

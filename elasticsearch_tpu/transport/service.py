@@ -131,6 +131,10 @@ class TransportService:
             max_workers=handler_threads, thread_name_prefix=f"transport-{node_id}"
         )
         self._loop = asyncio.new_event_loop()
+        # dispatch/pump tasks tracked so close() can cancel them — an
+        # un-cancelled pending task at loop close leaks ("Task was
+        # destroyed but it is pending!")
+        self._tasks: set = set()
         self._server: Optional[asyncio.AbstractServer] = None
         self._started = threading.Event()
         self._thread = threading.Thread(
@@ -171,17 +175,28 @@ class TransportService:
         def _shutdown():
             if self._server is not None:
                 self._server.close()
+            # cancel in-flight dispatch/pump tasks first; their
+            # cancellation wakeups are queued ahead of the stop below,
+            # so every task completes (cancelled) before the loop halts
+            for t in list(self._tasks):
+                t.cancel()
             for c in self._conns.values():
                 try:
                     c.writer.close()
                 except Exception:
                     pass
-            self._loop.stop()
+            self._loop.call_soon(self._loop.stop)
 
         if self._loop.is_running():
             self._loop.call_soon_threadsafe(_shutdown)
             self._thread.join(timeout=5)
         self._pool.shutdown(wait=False)
+
+    def _track(self, coro) -> "asyncio.Task":
+        task = asyncio.ensure_future(coro)
+        self._tasks.add(task)
+        task.add_done_callback(self._tasks.discard)
+        return task
 
     # ------------------------------------------------------------------
     # server side
@@ -193,6 +208,12 @@ class TransportService:
         self._handlers[action] = fn
 
     async def _serve_conn(self, reader, writer):
+        # inbound handler tasks are spawned by asyncio.start_server, not
+        # by _track — self-register so close() can cancel them too
+        task = asyncio.current_task()
+        if task is not None:
+            self._tasks.add(task)
+            task.add_done_callback(self._tasks.discard)
         try:
             hello = await _read_frame(reader)
             if hello.get("t") != "h" or hello.get("version") != TRANSPORT_VERSION:
@@ -225,7 +246,7 @@ class TransportService:
                 if msg.get("t") != "q":
                     continue
                 self.stats["rx_count"] += 1
-                asyncio.ensure_future(self._dispatch(msg, writer))
+                self._track(self._dispatch(msg, writer))
         except (asyncio.IncompleteReadError, ConnectionError, OSError):
             pass
         finally:
@@ -302,7 +323,7 @@ class TransportService:
             )
         conn = _Connection(reader, writer, hello.get("node"))
         self._conns[address] = conn
-        asyncio.ensure_future(conn.pump())
+        self._track(conn.pump())
         return conn
 
     async def _send_async(
