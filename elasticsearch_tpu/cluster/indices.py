@@ -4,22 +4,36 @@ Reference analogs: org.elasticsearch.index.IndexService (per-index shard
 registry, created by IndicesService from IndexMetadata),
 OperationRouting.shardId = floorMod(murmur3(routing), num_shards)
 (cluster/routing/IndexRouting), and the coordinator search fan-out
-(TransportSearchAction scatter + SearchPhaseController merge) collapsed
-to in-process calls — shards here are engine instances on one node; the
-mesh-distributed path lives in parallel/sharded.py.
+(TransportSearchAction scatter + SearchPhaseController merge). Two
+deployment shapes share this class:
+
+* **local mode** (default): every shard lives in this process — the
+  single-node ES layout; fan-out is in-process calls.
+* **distributed mode**: ``routing`` maps shard→node id, only shards
+  routed to ``local_node`` get engines here, and every operation on a
+  remote shard rides ``remote_call(owner, action, payload)`` over the
+  transport (TransportSearchAction / TransportShardBulkAction collapsed
+  onto one seam). The search path runs the FULL per-shard query phase
+  on the owning node — scoring, agg partials, sort values, knn,
+  source filtering, highlighting — and the coordinator merges the
+  per-shard pages exactly as the local path does (query-then-fetch
+  with the fetch folded into the shard response, SURVEY.md §3.3; the
+  fold trades (n_shards-1)×size over-fetched sources for one fewer
+  DCN round trip and no reader-pinning window between phases).
 """
 
 from __future__ import annotations
 
 import os
 import time
+from concurrent.futures import ThreadPoolExecutor
 from typing import Any, Dict, List, Optional
 
 from ..analysis import AnalysisRegistry
-from ..index.engine import OpResult, ShardEngine
+from ..index.engine import OpResult, ShardEngine, VersionConflictError
 from ..index.mapping import Mappings
 from ..search import dsl
-from ..search.coordinator import merge_sorted, merge_top_docs
+from ..search.coordinator import _col_key
 from ..search.executor import NumpyExecutor, ShardReader
 from ..utils.murmur3 import shard_id as route_shard_id
 
@@ -27,23 +41,24 @@ from ..common.settings import INDEX_SETTINGS, SettingsError, validate_index_sett
 
 DEFAULT_SETTINGS = {k: s.default for k, s in INDEX_SETTINGS.items()}
 
+# shared shard fan-out pool (coordinator scatter; leaf tasks only, so a
+# saturated pool queues requests rather than deadlocking)
+_FANOUT_POOL = ThreadPoolExecutor(max_workers=32, thread_name_prefix="search-fanout")
+
+ACTION_SHARD_SEARCH = "indices:data/read/search_shard"
+ACTION_SHARD_COUNT = "indices:data/read/count_shard"
+ACTION_SHARD_OPS = "indices:data/write/shard_ops"
+ACTION_SHARD_GET = "indices:data/read/get"
+ACTION_SHARD_REFRESH = "indices:admin/refresh_shards"
+ACTION_SHARD_FLUSH = "indices:admin/flush_shards"
+ACTION_SHARD_STATS = "indices:monitor/shard_stats"
+ACTION_CTX_OPEN = "indices:data/read/ctx_open"
+ACTION_CTX_CLOSE = "indices:data/read/ctx_close"
+
 
 class IndexService:
-    """The shard set of one index.
-
-    Two deployment shapes share this class (the round-3 unification of
-    the former ClusterService/TpuNode split):
-      * local mode (default): every shard lives in this process — the
-        single-node ES layout;
-      * distributed mode: ``routing`` maps shard→node id, only shards
-        routed to ``local_node`` get engines here, and every operation
-        on a remote shard rides ``remote_call(owner, action, payload)``
-        over the transport (TransportSearchAction / TransportShardBulk-
-        Action collapsed onto one seam). The search path runs the full
-        per-shard query phase on the owning node (aggs partials, sort
-        values, knn) and fetches only the global winners' sources
-        (query-then-fetch, SURVEY.md §3.3).
-    """
+    """The shard set of one index (see module docstring for the two
+    deployment shapes)."""
 
     def __init__(
         self,
@@ -52,7 +67,7 @@ class IndexService:
         mappings_json: Optional[dict] = None,
         analysis: Optional[AnalysisRegistry] = None,
         base_path: Optional[str] = None,
-        routing: Optional[Dict[int, str]] = None,
+        routing: Optional[Dict[Any, str]] = None,
         local_node: Optional[str] = None,
         remote_call=None,
     ):
@@ -79,13 +94,22 @@ class IndexService:
         n = int(self.settings["number_of_shards"])
         if n < 1:
             raise ValueError("number_of_shards must be >= 1")
-        self.shards: List[ShardEngine] = []
+        self.num_shards = n
+        # distributed-mode wiring (None/None/None = local mode)
+        self.routing: Optional[Dict[int, str]] = (
+            {int(k): v for k, v in routing.items()} if routing else None
+        )
+        self.local_node = local_node
+        self.remote_call = remote_call
+        self._local: Dict[int, ShardEngine] = {}
         for s in range(n):
+            if not self._owns(s):
+                continue
             shard_path = (
                 os.path.join(base_path, str(s)) if base_path is not None else None
             )
-            self.shards.append(
-                ShardEngine(self.mappings, self.analysis, path=shard_path, shard_id=s)
+            self._local[s] = ShardEngine(
+                self.mappings, self.analysis, path=shard_path, shard_id=s
             )
         # executor cache: shard id → (change_generation, executor)
         self._executors: Dict[int, tuple] = {}
@@ -103,11 +127,64 @@ class IndexService:
 
     # ---- routing ----
 
+    def _owns(self, sid: int) -> bool:
+        if self.routing is None:
+            return True
+        return self.routing.get(sid) == self.local_node
+
+    def _owner(self, sid: int) -> Optional[str]:
+        """Owning node id for a shard, or None in local mode."""
+        if self.routing is None:
+            return None
+        return self.routing.get(sid)
+
+    @property
+    def shards(self) -> List[ShardEngine]:
+        """Locally-held shard engines (all shards in local mode)."""
+        return [self._local[s] for s in sorted(self._local)]
+
+    def local_shard(self, sid: int) -> ShardEngine:
+        eng = self._local.get(sid)
+        if eng is None:
+            raise KeyError(
+                f"shard [{self.name}][{sid}] is not allocated to this node"
+            )
+        return eng
+
     def shard_for(self, doc_id: str, routing: Optional[str] = None) -> ShardEngine:
-        sid = route_shard_id(routing if routing is not None else doc_id, len(self.shards))
-        return self.shards[sid]
+        sid = route_shard_id(
+            routing if routing is not None else doc_id, self.num_shards
+        )
+        return self.local_shard(sid)
 
     # ---- document ops ----
+
+    def _shard_ops(self, sid: int, ops: List[dict]) -> List[dict]:
+        """Applies a batch of ops to one shard, local or remote.
+        Returns wire-shaped result dicts (TransportShardBulkAction)."""
+        owner = self._owner(sid)
+        if owner is None or owner == self.local_node:
+            return apply_shard_ops(self.local_shard(sid), ops)
+        out = self.remote_call(
+            owner,
+            ACTION_SHARD_OPS,
+            {"index": self.name, "shard": sid, "ops": ops},
+        )
+        return out["results"]
+
+    def _one_op(self, sid: int, op: dict) -> OpResult:
+        r = self._shard_ops(sid, [op])[0]
+        if not r.get("ok"):
+            if r.get("etype") == "version_conflict_engine_exception":
+                raise VersionConflictError(r.get("error", "version conflict"))
+            raise RuntimeError(r.get("error", "shard operation failed"))
+        return OpResult(
+            doc_id=r.get("_id", op.get("id")),
+            result=r["result"],
+            version=int(r.get("_version", 1)),
+            seq_no=int(r.get("_seq_no", 0)),
+            primary_term=int(r.get("_primary_term", 1)),
+        )
 
     def index_doc(
         self,
@@ -117,23 +194,58 @@ class IndexService:
         routing: Optional[str] = None,
         **kwargs,
     ) -> OpResult:
-        return self.shard_for(doc_id, routing).index(doc_id, source, op_type, **kwargs)
+        sid = route_shard_id(
+            routing if routing is not None else doc_id, self.num_shards
+        )
+        if self._owns(sid):
+            return self.local_shard(sid).index(doc_id, source, op_type, **kwargs)
+        op = {"op": "index", "id": doc_id, "source": source, "op_type": op_type}
+        op.update({k: v for k, v in kwargs.items() if v is not None})
+        return self._one_op(sid, op)
 
     def delete_doc(
         self, doc_id: str, routing: Optional[str] = None, **kwargs
     ) -> OpResult:
-        return self.shard_for(doc_id, routing).delete(doc_id, **kwargs)
+        sid = route_shard_id(
+            routing if routing is not None else doc_id, self.num_shards
+        )
+        if self._owns(sid):
+            return self.local_shard(sid).delete(doc_id, **kwargs)
+        op = {"op": "delete", "id": doc_id}
+        op.update({k: v for k, v in kwargs.items() if v is not None})
+        return self._one_op(sid, op)
 
     def get_doc(self, doc_id: str, routing: Optional[str] = None) -> Optional[dict]:
-        return self.shard_for(doc_id, routing).get(doc_id)
+        sid = route_shard_id(
+            routing if routing is not None else doc_id, self.num_shards
+        )
+        if self._owns(sid):
+            return self.local_shard(sid).get(doc_id)
+        out = self.remote_call(
+            self._owner(sid),
+            ACTION_SHARD_GET,
+            {"index": self.name, "shard": sid, "id": doc_id},
+        )
+        return out["doc"] if out["found"] else None
+
+    def _remote_owners(self) -> List[str]:
+        if self.routing is None:
+            return []
+        return sorted(
+            {o for o in self.routing.values() if o != self.local_node}
+        )
 
     def refresh(self) -> None:
         for s in self.shards:
             s.refresh()
+        for owner in self._remote_owners():
+            self.remote_call(owner, ACTION_SHARD_REFRESH, {"index": self.name})
 
     def flush(self) -> None:
         for s in self.shards:
             s.flush()
+        for owner in self._remote_owners():
+            self.remote_call(owner, ACTION_SHARD_FLUSH, {"index": self.name})
         self._persist_meta()
 
     def _persist_meta(self) -> None:
@@ -173,13 +285,17 @@ class IndexService:
 
     def close(self) -> None:
         # flushAndClose semantics (InternalEngine.close): make everything
-        # durable, trim the WAL, persist metadata
-        self.flush()
+        # durable, trim the WAL, persist metadata. Only local shards —
+        # remote engines belong to their owning node's lifecycle.
+        for s in self.shards:
+            s.flush()
+        self._persist_meta()
         for s in self.shards:
             s.close()
         self._batcher.close()
 
-    # ---- search (coordinator fan-out over local shards) ----
+    # ---- search: shard-level query phase (SearchService.executeQueryPhase
+    # analog; runs on the shard's owning node) ----
 
     def _executor(self, shard: ShardEngine):
         cached = self._executors.get(shard.shard_id)
@@ -196,28 +312,262 @@ class IndexService:
         self._executors[shard.shard_id] = (shard.change_generation, ex)
         return ex
 
-    def _search_batched(self, plan, k: int):
-        """Fan one request's shards into the micro-batching dispatcher
-        (they batch with each other AND with concurrent requests).
-        Returns (shard TopDocs list, executors) or None if any shard's
-        executor isn't a JaxExecutor."""
-        from ..search.batcher import QueryBatcher
-        from ..search.executor_jax import JaxExecutor
+    def shard_search_local(
+        self, sid: int, body: Optional[dict], pinned_executor=None
+    ) -> dict:
+        """Full per-shard query phase + folded fetch for ONE locally-held
+        shard. Returns a wire-shaped dict:
+          {total, relation, max_score,
+           hits: [{_id, _score, _source?, sort?, highlight?}],
+           aggs?: partial, profile?: entry}
+        `body` arrives with from/size already collapsed to 0/(from+size)
+        by the coordinator."""
+        ts = time.perf_counter_ns()
+        body = body or {}
+        k = int(body.get("size", 10))
+        min_score = body.get("min_score")
+        source_spec = body.get("_source", True)
+        search_after = body.get("search_after")
+        sort_specs = None
+        if "sort" in body:
+            from ..search.executor import parse_sort
 
-        executors = [self._executor(s) for s in self.shards]
-        if not all(isinstance(ex, JaxExecutor) for ex in executors):
-            return None
-        try:
-            jobs = [self._batcher.submit(ex, plan, k) for ex in executors]
-            return [QueryBatcher.wait(j) for j in jobs], executors
-        except RuntimeError:
-            return None  # batcher closed mid-request → unbatched path
+            sort_specs = parse_sort(body["sort"])
+            if search_after is None and [s["field"] for s in sort_specs] == [
+                "_score"
+            ]:
+                sort_specs = None  # default relevance order
+        if search_after is not None:
+            if sort_specs is None:
+                raise dsl.QueryParseError(
+                    "Sort must contain at least one field when using search_after"
+                )
+            if len(search_after) != len(sort_specs):
+                raise dsl.QueryParseError(
+                    f"search_after has {len(search_after)} value(s) but sort "
+                    f"has {len(sort_specs)}"
+                )
+        query = dsl.parse_query(body["query"]) if "query" in body else None
+        knn_body = body.get("knn")
+        knn = None
+        if knn_body is not None:
+            knn = [
+                dsl.parse_knn(kb)
+                for kb in (knn_body if isinstance(knn_body, list) else [knn_body])
+            ]
+        aggs_body = body.get("aggs") or body.get("aggregations")
+        agg_nodes = None
+        if aggs_body is not None:
+            from ..search.aggs import parse_aggs
+
+            agg_nodes = parse_aggs(aggs_body)
+        profile = bool(body.get("profile"))
+        # ES default: totals tracked accurately up to 10_000, pruning
+        # allowed past it (SearchSourceBuilder.TRACK_TOTAL_HITS_ACCURATE
+        # default of 10_000 in RestSearchAction)
+        tth = body.get("track_total_hits", 10_000)
+
+        shard = self.local_shard(sid)
+        ex = pinned_executor if pinned_executor is not None else self._executor(shard)
+        td = None
+        masks = None
+        svals: List[list] = []
+        # ---- batched fast path: flat match plans on the jax backend go
+        # through the cross-request micro-batching dispatcher (shared
+        # fixed-shape launches across concurrent requests) ----
+        if (
+            query is not None
+            and knn is None
+            and agg_nodes is None
+            and sort_specs is None
+            and search_after is None
+            and min_score is None
+            and not profile
+            and pinned_executor is None
+            and str(self.settings.get("search.backend")) == "jax"
+        ):
+            from ..search.batcher import extract_match_plan
+            from ..search.executor_jax import JaxExecutor
+
+            if isinstance(ex, JaxExecutor):
+                plan = extract_match_plan(query, self.mappings, self.analysis, tth)
+                if plan is not None:
+                    try:
+                        td = self._batcher.execute(ex, plan, k)
+                    except RuntimeError:
+                        td = None  # batcher closed mid-request → unbatched
+        if td is None:
+            if sort_specs is not None:
+                oracle = ex if isinstance(ex, NumpyExecutor) else ex._oracle
+                td, masks, svals = oracle.execute_sorted(
+                    query,
+                    sort_specs,
+                    size=k,
+                    from_=0,
+                    knn=knn,
+                    min_score=min_score,
+                    search_after=search_after,
+                )
+            else:
+                td, masks = ex.execute(
+                    query, size=k, from_=0, knn=knn, min_score=min_score
+                )
+        agg_partial = None
+        if agg_nodes is not None:
+            from ..search.aggs import AggCollector
+
+            oracle = ex if isinstance(ex, NumpyExecutor) else ex._oracle
+            agg_partial = AggCollector(oracle).collect(agg_nodes, masks)
+
+        # ---- folded fetch phase: sources + highlight for this shard's
+        # candidates (FetchPhase, SURVEY.md §3.3) ----
+        highlight_specs = None
+        highlight_terms = None
+        if "highlight" in body:
+            from ..search.highlight import extract_highlight_terms, parse_highlight
+
+            highlight_specs = parse_highlight(body["highlight"])
+            highlight_terms = extract_highlight_terms(
+                query, self.mappings, self.analysis
+            )
+        from ..search.executor import filter_source
+
+        reader = ex.reader
+        hits = []
+        for i, h in enumerate(td.hits):
+            src = reader.segments[h.segment].sources[h.local_doc]
+            entry: dict = {
+                "_id": h.doc_id,
+                "_score": None if sort_specs is not None else h.score,
+            }
+            filtered = filter_source(src, source_spec)
+            if filtered is not None and source_spec is not False:
+                entry["_source"] = filtered
+            if sort_specs is not None:
+                entry["sort"] = list(svals[i]) if i < len(svals) else []
+            if highlight_specs is not None and src is not None:
+                hl = self._highlight_hit(src, highlight_specs, highlight_terms)
+                if hl:
+                    entry["highlight"] = hl
+            hits.append(entry)
+        out = {
+            "total": int(td.total),
+            "relation": td.relation,
+            "max_score": None if td.max_score is None else float(td.max_score),
+            "hits": hits,
+        }
+        if agg_partial is not None:
+            out["aggs"] = agg_partial
+        if profile:
+            # per-shard query-phase breakdown ("profile": true —
+            # Profilers/QueryProfiler response shape, device+host time)
+            elapsed = time.perf_counter_ns() - ts
+            out["profile"] = {
+                "id": f"[{self.uuid}][{self.name}][{sid}]",
+                "searches": [
+                    {
+                        "query": [
+                            {
+                                "type": type(query).__name__
+                                if query is not None
+                                else "MatchAllQuery",
+                                "description": json_dumps_safe(
+                                    body.get("query", {"match_all": {}})
+                                ),
+                                "time_in_nanos": elapsed,
+                                "breakdown": {
+                                    "score": elapsed,
+                                    "backend": str(
+                                        self.settings.get("search.backend")
+                                    ),
+                                },
+                            }
+                        ],
+                        "rewrite_time": 0,
+                        "collector": [
+                            {
+                                "name": "SimpleTopDocsCollector",
+                                "reason": "search_top_hits",
+                                "time_in_nanos": elapsed,
+                            }
+                        ],
+                    }
+                ],
+                "aggregations": [],
+            }
+        return out
+
+    def shard_count_local(self, sid: int, body: Optional[dict]) -> dict:
+        body = body or {}
+        query = dsl.parse_query(body["query"]) if "query" in body else None
+        ex = self._executor(self.local_shard(sid))
+        td = ex.search(query, size=0)
+        return {"count": int(td.total)}
+
+    # ---- search: coordinator fan-out + reduce ----
+
+    def _fan_out(self, body: dict, pinned: Optional[List] = None) -> List[dict]:
+        """Scatter the per-shard request to every shard (local direct
+        call or transport hop), gather wire-shaped results in shard
+        order. `pinned[sid]` is a local executor or a {"node","ctx"}
+        token from pin_executors()."""
+
+        def run(sid: int) -> dict:
+            pin = pinned[sid] if pinned is not None else None
+            if isinstance(pin, dict):
+                # remote (or registry-held) pinned context
+                return self.remote_call(
+                    pin["node"],
+                    ACTION_SHARD_SEARCH,
+                    {
+                        "index": self.name,
+                        "shard": sid,
+                        "body": body,
+                        "ctx": pin["ctx"],
+                    },
+                )
+            owner = self._owner(sid)
+            if owner is None or owner == self.local_node:
+                return self.shard_search_local(sid, body, pinned_executor=pin)
+            return self.remote_call(
+                owner,
+                ACTION_SHARD_SEARCH,
+                {"index": self.name, "shard": sid, "body": body},
+            )
+
+        n = self.num_shards
+        if n == 1:
+            return [run(0)]
+        futs = [_FANOUT_POOL.submit(run, sid) for sid in range(n)]
+        return [f.result() for f in futs]
 
     def pin_executors(self) -> List:
         """Point-in-time executor snapshot (ReaderContext acquire): scroll
         and PIT searches reuse these so concurrent refreshes don't change
-        the view between pages."""
-        return [self._executor(s) for s in self.shards]
+        the view between pages. In distributed mode every shard gets a
+        reader context held in its owning node's registry and the pin is
+        a {"node","ctx"} token (the scroll-id → per-shard ReaderContext
+        indirection of SearchService.createAndPutReaderContext)."""
+        if self.routing is None:
+            return [self._executor(self._local[s]) for s in range(self.num_shards)]
+        pins: List[dict] = []
+        for sid in range(self.num_shards):
+            owner = self.routing[sid]
+            out = self.remote_call(
+                owner, ACTION_CTX_OPEN, {"index": self.name, "shard": sid}
+            )
+            pins.append({"node": owner, "ctx": out["ctx"]})
+        return pins
+
+    def release_pins(self, pins: List) -> None:
+        for pin in pins or []:
+            if isinstance(pin, dict):
+                try:
+                    self.remote_call(
+                        pin["node"], ACTION_CTX_CLOSE, {"ctx": pin["ctx"]}
+                    )
+                except Exception:
+                    pass  # best-effort (context TTL reaps it anyway)
 
     def search(
         self, body: Optional[dict] = None, pinned_executors: Optional[List] = None
@@ -253,190 +603,65 @@ class IndexService:
         t0 = time.perf_counter()
         size = int(body.get("size", 10))
         from_ = int(body.get("from", 0))
-        min_score = body.get("min_score")
-        source_spec = body.get("_source", True)
-        search_after = body.get("search_after")
+        # coordinator-side parses (merge keys + agg reduce plan only; the
+        # shards re-parse the body themselves so it can ride the wire)
         sort_specs = None
         if "sort" in body:
             from ..search.executor import parse_sort
 
             sort_specs = parse_sort(body["sort"])
-            if search_after is None and [s["field"] for s in sort_specs] == ["_score"]:
-                sort_specs = None  # default relevance order
-        if search_after is not None:
-            if sort_specs is None:
-                raise dsl.QueryParseError(
-                    "Sort must contain at least one field when using search_after"
-                )
-            if len(search_after) != len(sort_specs):
-                raise dsl.QueryParseError(
-                    f"search_after has {len(search_after)} value(s) but sort "
-                    f"has {len(sort_specs)}"
-                )
-        query = dsl.parse_query(body["query"]) if "query" in body else None
-        knn_body = body.get("knn")
-        knn = None
-        if knn_body is not None:
-            knn = [
-                dsl.parse_knn(k)
-                for k in (knn_body if isinstance(knn_body, list) else [knn_body])
-            ]
+            if body.get("search_after") is None and [
+                s["field"] for s in sort_specs
+            ] == ["_score"]:
+                sort_specs = None
         aggs_body = body.get("aggs") or body.get("aggregations")
         agg_nodes = None
         if aggs_body is not None:
             from ..search.aggs import parse_aggs
 
             agg_nodes = parse_aggs(aggs_body)
-        shard_results = []
-        executors = []  # pinned per-request so a concurrent refresh can't
-        # swap the reader between scoring and source fetch
-        agg_partials = []
-        shard_sort_values: List[List[List]] = []
         profile = bool(body.get("profile"))
-        shard_profiles = []
-        # ES default: totals tracked accurately up to 10_000, pruning
-        # allowed past it (SearchSourceBuilder.TRACK_TOTAL_HITS_ACCURATE
-        # default of 10_000 in RestSearchAction)
         tth = body.get("track_total_hits", 10_000)
-        # ---- batched fast path: flat match plans on the jax backend go
-        # through the cross-request micro-batching dispatcher (shared
-        # fixed-shape launches across concurrent requests) ----
-        if (
-            query is not None
-            and knn is None
-            and agg_nodes is None
-            and sort_specs is None
-            and search_after is None
-            and min_score is None
-            and not profile
-            and pinned_executors is None
-            and str(self.settings.get("search.backend")) == "jax"
-        ):
-            from ..search.batcher import extract_match_plan
 
-            plan = extract_match_plan(query, self.mappings, self.analysis, tth)
-            if plan is not None:
-                batched = self._search_batched(plan, from_ + size)
-                if batched is not None:
-                    shard_results, executors = batched
-                    shard_sort_values = [[] for _ in shard_results]
-        for shard_i, shard in enumerate(self.shards if not shard_results else ()):
-            ts = time.perf_counter_ns()
-            ex = (
-                pinned_executors[shard_i]
-                if pinned_executors is not None
-                else self._executor(shard)
-            )
-            executors.append(ex)
-            # each shard returns the full global page's worth of hits;
-            # the same execution's masks feed the agg phase (no re-run)
-            if sort_specs is not None:
-                oracle = ex if isinstance(ex, NumpyExecutor) else ex._oracle
-                td, masks, svals = oracle.execute_sorted(
-                    query,
-                    sort_specs,
-                    size=from_ + size,
-                    from_=0,
-                    knn=knn,
-                    min_score=min_score,
-                    search_after=search_after,
-                )
-                shard_sort_values.append(svals)
-            else:
-                td, masks = ex.execute(
-                    query, size=from_ + size, from_=0, knn=knn, min_score=min_score
-                )
-                shard_sort_values.append([])
-            shard_results.append(td)
-            if agg_nodes is not None:
-                from ..search.aggs import AggCollector
+        # every shard returns the full global page's worth of hits
+        sub = {**body, "from": 0, "size": from_ + size}
+        shard_results = self._fan_out(sub, pinned_executors)
 
-                oracle = ex if isinstance(ex, NumpyExecutor) else ex._oracle
-                agg_partials.append(
-                    AggCollector(oracle).collect(agg_nodes, masks)
-                )
-            if profile:
-                # per-shard query-phase breakdown ("profile": true —
-                # Profilers/QueryProfiler response shape, device+host time)
-                elapsed = time.perf_counter_ns() - ts
-                shard_profiles.append(
-                    {
-                        "id": f"[{self.uuid}][{self.name}][{shard.shard_id}]",
-                        "searches": [
-                            {
-                                "query": [
-                                    {
-                                        "type": type(query).__name__
-                                        if query is not None
-                                        else "MatchAllQuery",
-                                        "description": json_dumps_safe(
-                                            body.get("query", {"match_all": {}})
-                                        ),
-                                        "time_in_nanos": elapsed,
-                                        "breakdown": {
-                                            "score": elapsed,
-                                            "backend": str(
-                                                self.settings.get("search.backend")
-                                            ),
-                                        },
-                                    }
-                                ],
-                                "rewrite_time": 0,
-                                "collector": [
-                                    {
-                                        "name": "SimpleTopDocsCollector",
-                                        "reason": "search_top_hits",
-                                        "time_in_nanos": elapsed,
-                                    }
-                                ],
-                            }
-                        ],
-                        "aggregations": [],
-                    }
-                )
-        if sort_specs is not None:
-            total, max_score, hits, hit_sorts = merge_sorted(
-                shard_results, shard_sort_values, sort_specs, from_, size
-            )
-        else:
-            total, max_score, hits = merge_top_docs(shard_results, from_, size)
-            hit_sorts = None
-        from ..search.executor import filter_source
-
-        highlight_specs = None
-        highlight_terms = None
-        if "highlight" in body:
-            from ..search.highlight import extract_highlight_terms, parse_highlight
-
-            highlight_specs = parse_highlight(body["highlight"])
-            highlight_terms = extract_highlight_terms(
-                query, self.mappings, self.analysis
-            )
-        out_hits = []
-        for i, h in enumerate(hits):
-            reader = executors[h.shard].reader
-            src = reader.segments[h.segment].sources[h.local_doc]
-            entry = {
-                "_index": self.name,
-                "_id": h.doc_id,
-                "_score": None if sort_specs is not None else h.score,
-            }
-            filtered = filter_source(src, source_spec)
-            if filtered is not None and source_spec is not False:
-                entry["_source"] = filtered
-            if hit_sorts is not None:
-                entry["sort"] = hit_sorts[i]
-            if highlight_specs is not None and src is not None:
-                hl = self._highlight_hit(src, highlight_specs, highlight_terms)
-                if hl:
-                    entry["highlight"] = hl
-            out_hits.append(entry)
+        # ---- coordinator reduce (SearchPhaseController.reducedQueryPhase:
+        # merge-sort per-shard pages by score/sort key, shard asc, rank
+        # asc — within a shard rank order already encodes (segment, doc)
+        # ascending tie-breaks) ----
+        total = sum(r["total"] for r in shard_results)
+        max_score = None
+        for r in shard_results:
+            ms = r.get("max_score")
+            if ms is not None:
+                max_score = ms if max_score is None else max(max_score, ms)
+        entries = []
+        for si, r in enumerate(shard_results):
+            for rank, h in enumerate(r["hits"]):
+                if sort_specs is not None:
+                    key = tuple(
+                        _col_key(v, spec)
+                        for v, spec in zip(h.get("sort", []), sort_specs)
+                    )
+                else:
+                    sc = h.get("_score")
+                    key = (-(sc if sc is not None else 0.0),)
+                entries.append((key, si, rank, h))
+        entries.sort(key=lambda e: e[:3])
+        out_hits = [
+            {"_index": self.name, **h} for _, _, _, h in entries[from_ : from_ + size]
+        ]
         took = int((time.perf_counter() - t0) * 1000)
         self.search_stats["query_total"] += 1
         self.search_stats["query_time_in_millis"] += took
         self.search_stats["fetch_total"] += 1
-        hits_obj: dict = {"max_score": max_score, "hits": out_hits}
-        gte_shard = any(td.relation == "gte" for td in shard_results)
+        hits_obj: dict = {
+            "max_score": None if sort_specs is not None else max_score,
+            "hits": out_hits,
+        }
+        gte_shard = any(r.get("relation") == "gte" for r in shard_results)
         if tth is True:
             hits_obj["total"] = {"value": total, "relation": "eq"}
         elif tth is not False:
@@ -445,19 +670,27 @@ class IndexService:
                 "value": min(total, limit),
                 "relation": "gte" if (total > limit or gte_shard) else "eq",
             }
+        n = self.num_shards
         resp = {
             "took": took,
             "timed_out": False,
             "_shards": {
-                "total": len(self.shards),
-                "successful": len(self.shards),
+                "total": n,
+                "successful": n,
                 "skipped": 0,
                 "failed": 0,
             },
             "hits": hits_obj,
         }
         if profile:
-            resp["profile"] = {"shards": shard_profiles}
+            resp["profile"] = {
+                "shards": [
+                    r["profile"] for r in shard_results if r.get("profile")
+                ]
+            }
+        agg_partials = [
+            r["aggs"] for r in shard_results if r.get("aggs") is not None
+        ]
         return resp, agg_nodes, agg_partials
 
     def _highlight_hit(self, src: dict, specs: dict, terms_by_field: dict) -> dict:
@@ -587,7 +820,7 @@ class IndexService:
                     entry["_source"] = filtered
             out_hits.append(entry)
         took = int((time.perf_counter() - t0) * 1000)
-        n = len(self.shards)
+        n = self.num_shards
         return {
             "took": took,
             "timed_out": False,
@@ -609,17 +842,28 @@ class IndexService:
                 **body,
                 "query": {"bool": {"must": [inner], "filter": [extra_filter]}},
             }
-        query = dsl.parse_query(body["query"]) if "query" in body else None
-        total = 0
-        for shard in self.shards:
-            ex = self._executor(shard)
-            td = ex.search(query, size=0)
-            total += td.total
+
+        def run(sid: int) -> dict:
+            owner = self._owner(sid)
+            if owner is None or owner == self.local_node:
+                return self.shard_count_local(sid, body)
+            return self.remote_call(
+                owner,
+                ACTION_SHARD_COUNT,
+                {"index": self.name, "shard": sid, "body": body},
+            )
+
+        n = self.num_shards
+        if n == 1:
+            results = [run(0)]
+        else:
+            futs = [_FANOUT_POOL.submit(run, sid) for sid in range(n)]
+            results = [f.result() for f in futs]
         return {
-            "count": total,
+            "count": sum(r["count"] for r in results),
             "_shards": {
-                "total": len(self.shards),
-                "successful": len(self.shards),
+                "total": n,
+                "successful": n,
                 "skipped": 0,
                 "failed": 0,
             },
@@ -629,9 +873,19 @@ class IndexService:
 
     @property
     def num_docs(self) -> int:
-        return sum(s.num_docs for s in self.shards)
+        n = sum(s.num_docs for s in self.shards)
+        for owner in self._remote_owners():
+            try:
+                out = self.remote_call(
+                    owner, ACTION_SHARD_STATS, {"index": self.name}
+                )
+                n += int(out.get("docs", 0))
+            except Exception:
+                pass
+        return n
 
-    def stats(self) -> dict:
+    def local_stats(self) -> dict:
+        """Stats over the shards held on THIS node (wire-shaped)."""
         store_bytes = 0
         if self.base_path and os.path.isdir(self.base_path):
             for root, _, files in os.walk(self.base_path):
@@ -640,28 +894,62 @@ class IndexService:
                         store_bytes += os.path.getsize(os.path.join(root, f))
                     except OSError:
                         pass
-        ops = {
-            k: sum(s.op_stats[k] for s in self.shards)
-            for k in self.shards[0].op_stats
-        }
+        shards = self.shards
+        if shards:
+            ops = {
+                k: sum(s.op_stats[k] for s in shards) for k in shards[0].op_stats
+            }
+        else:
+            ops = {
+                "index_total": 0,
+                "index_time_in_nanos": 0,
+                "delete_total": 0,
+                "refresh_total": 0,
+                "flush_total": 0,
+                "merge_total": 0,
+            }
         deleted = sum(
             int((~l).sum()) if l is not None else 0
-            for s in self.shards
+            for s in shards
             for l in s.live_docs
         )
+        return {
+            "docs": sum(s.num_docs for s in shards),
+            "deleted": deleted,
+            "store_bytes": store_bytes,
+            "index_total": ops["index_total"],
+            "index_time_in_nanos": ops["index_time_in_nanos"],
+            "delete_total": ops["delete_total"],
+            "refresh_total": ops["refresh_total"],
+            "flush_total": ops["flush_total"],
+            "merge_total": ops["merge_total"],
+            "segments": sum(len(s.segments) for s in shards),
+        }
+
+    def stats(self) -> dict:
+        agg = self.local_stats()
+        for owner in self._remote_owners():
+            try:
+                out = self.remote_call(
+                    owner, ACTION_SHARD_STATS, {"index": self.name}
+                )
+            except Exception:
+                continue
+            for k in agg:
+                agg[k] += out.get(k, 0)
         body = {
-            "docs": {"count": self.num_docs, "deleted": deleted},
-            "store": {"size_in_bytes": store_bytes},
+            "docs": {"count": agg["docs"], "deleted": agg["deleted"]},
+            "store": {"size_in_bytes": agg["store_bytes"]},
             "indexing": {
-                "index_total": ops["index_total"],
-                "index_time_in_millis": ops["index_time_in_nanos"] // 1_000_000,
-                "delete_total": ops["delete_total"],
+                "index_total": agg["index_total"],
+                "index_time_in_millis": agg["index_time_in_nanos"] // 1_000_000,
+                "delete_total": agg["delete_total"],
             },
             "search": dict(self.search_stats),
-            "refresh": {"total": ops["refresh_total"]},
-            "flush": {"total": ops["flush_total"]},
-            "merges": {"total": ops["merge_total"]},
-            "segments": {"count": sum(len(s.segments) for s in self.shards)},
+            "refresh": {"total": agg["refresh_total"]},
+            "flush": {"total": agg["flush_total"]},
+            "merges": {"total": agg["merge_total"]},
+            "segments": {"count": agg["segments"]},
         }
         return {"uuid": self.uuid, "primaries": body, "total": body}
 
@@ -678,6 +966,60 @@ class IndexService:
             "settings": {"index": index_settings},
             "mappings": self.mappings.to_json(),
         }
+
+
+def apply_shard_ops(eng: ShardEngine, ops: List[dict]) -> List[dict]:
+    """Applies wire-shaped ops to one engine (the shard side of
+    TransportShardBulkAction.performOnPrimary). Shared by the local path
+    and the transport handler."""
+    results = []
+    for op in ops:
+        try:
+            if op["op"] == "index":
+                r = eng.index(
+                    op["id"],
+                    op["source"],
+                    op_type=op.get("op_type", "index"),
+                    if_seq_no=op.get("if_seq_no"),
+                    if_primary_term=op.get("if_primary_term"),
+                )
+                results.append(
+                    {
+                        "ok": True,
+                        "_id": r.doc_id,
+                        "result": r.result,
+                        "_version": r.version,
+                        "_seq_no": r.seq_no,
+                        "_primary_term": r.primary_term,
+                    }
+                )
+            elif op["op"] == "delete":
+                r = eng.delete(
+                    op["id"],
+                    if_seq_no=op.get("if_seq_no"),
+                    if_primary_term=op.get("if_primary_term"),
+                )
+                results.append(
+                    {
+                        "ok": True,
+                        "_id": r.doc_id,
+                        "result": r.result,
+                        "_version": r.version,
+                        "_seq_no": r.seq_no,
+                        "_primary_term": r.primary_term,
+                    }
+                )
+            else:
+                results.append({"ok": False, "error": f"bad op {op['op']}"})
+        except VersionConflictError as e:
+            results.append(
+                {
+                    "ok": False,
+                    "error": str(e),
+                    "etype": "version_conflict_engine_exception",
+                }
+            )
+    return results
 
 
 def json_dumps_safe(obj) -> str:

@@ -682,9 +682,9 @@ class ClusterService:
         return {"succeeded": found, "num_freed": 1 if found else 0}
 
     def health(self) -> dict:
-        n_primaries = sum(len(i.shards) for i in self.indices.values())
+        n_primaries = sum(i.num_shards for i in self.indices.values())
         n_replicas = sum(
-            len(i.shards) * int(i.settings.get("number_of_replicas", 1))
+            i.num_shards * int(i.settings.get("number_of_replicas", 1))
             for i in self.indices.values()
         )
         status = "yellow" if n_replicas > 0 else "green"

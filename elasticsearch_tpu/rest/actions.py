@@ -246,7 +246,7 @@ class RestActions:
                     "status": "open",
                     "index": name,
                     "uuid": idx.uuid,
-                    "pri": str(len(idx.shards)),
+                    "pri": str(idx.num_shards),
                     "rep": str(idx.settings.get("number_of_replicas", 1)),
                     "docs.count": str(idx.num_docs),
                     "docs.deleted": "0",
@@ -302,8 +302,8 @@ class RestActions:
         idx = self.cluster.get_index(params["index"])
         return 200, {
             "_shards": {
-                "total": len(idx.shards),
-                "successful": len(idx.shards),
+                "total": idx.num_shards,
+                "successful": idx.num_shards,
                 "failed": 0,
             },
             "_all": idx.stats(),
@@ -313,20 +313,20 @@ class RestActions:
     def refresh_index(self, body, params, qs):
         idx = self.cluster.get_index(params["index"])
         idx.refresh()
-        n = len(idx.shards)
+        n = idx.num_shards
         return 200, {"_shards": {"total": n, "successful": n, "failed": 0}}
 
     def refresh_all(self, body, params, qs):
         n = 0
         for idx in self.cluster.indices.values():
             idx.refresh()
-            n += len(idx.shards)
+            n += idx.num_shards
         return 200, {"_shards": {"total": n, "successful": n, "failed": 0}}
 
     def flush_index(self, body, params, qs):
         idx = self.cluster.get_index(params["index"])
         idx.flush()
-        n = len(idx.shards)
+        n = idx.num_shards
         return 200, {"_shards": {"total": n, "successful": n, "failed": 0}}
 
     def flush_all(self, body, params, qs):
@@ -338,7 +338,7 @@ class RestActions:
         max_seg = int(qs.get("max_num_segments", ["1"])[0])
         for s in idx.shards:
             s.maybe_merge(max_segments=max_seg)
-        n = len(idx.shards)
+        n = idx.num_shards
         return 200, {"_shards": {"total": n, "successful": n, "failed": 0}}
 
     # ------------------------------------------------------------------
@@ -376,7 +376,7 @@ class RestActions:
         )
         self._maybe_refresh(idx, qs)
         return (201 if r.result == "created" else 200), self._doc_response(
-            params["index"], r, len(idx.shards)
+            params["index"], r, idx.num_shards
         )
 
     def index_doc_auto(self, body, params, qs):
@@ -437,7 +437,7 @@ class RestActions:
         r = idx.delete_doc(params["id"], routing=routing, **kwargs)
         self._maybe_refresh(idx, qs)
         status = 200 if r.result == "deleted" else 404
-        return status, self._doc_response(params["index"], r, len(idx.shards))
+        return status, self._doc_response(params["index"], r, idx.num_shards)
 
     def update_doc(self, body, params, qs):
         """_update: partial doc merge / doc_as_upsert / scripted noop
@@ -462,7 +462,7 @@ class RestActions:
                 merged = deep_merge(base, doc_part)
                 r = idx.index_doc(params["id"], merged, routing=routing)
                 self._maybe_refresh(idx, qs)
-                return 201, self._doc_response(params["index"], r, len(idx.shards))
+                return 201, self._doc_response(params["index"], r, idx.num_shards)
             return 404, error_body(
                 404,
                 "document_missing_exception",
@@ -481,7 +481,7 @@ class RestActions:
             }
         r = idx.index_doc(params["id"], merged, routing=routing)
         self._maybe_refresh(idx, qs)
-        return 200, self._doc_response(params["index"], r, len(idx.shards))
+        return 200, self._doc_response(params["index"], r, idx.num_shards)
 
     def mget(self, body, params, qs):
         body = body or {}
@@ -703,7 +703,7 @@ class RestActions:
                     items.append(
                         {
                             "delete": {
-                                **self._doc_response(index, r, len(idx.shards)),
+                                **self._doc_response(index, r, idx.num_shards),
                                 "status": 200 if r.result == "deleted" else 404,
                             }
                         }
@@ -735,7 +735,7 @@ class RestActions:
                     items.append(
                         {
                             action: {
-                                **self._doc_response(index, r, len(idx.shards)),
+                                **self._doc_response(index, r, idx.num_shards),
                                 "status": 201 if r.result == "created" else 200,
                             }
                         }
