@@ -143,6 +143,33 @@ class IndexService:
         """Locally-held shard engines (all shards in local mode)."""
         return [self._local[s] for s in sorted(self._local)]
 
+    @property
+    def local_shards(self) -> Dict[int, ShardEngine]:
+        """shard id → locally-held engine (IndicesService view)."""
+        return dict(self._local)
+
+    def apply_routing(self, routing: Optional[Dict[int, str]]) -> None:
+        """Reconciles local engines with a new routing table (the
+        IndicesClusterStateService.applyClusterState shard create/remove
+        path): engines are created for newly-owned shards and closed for
+        shards routed away."""
+        if routing is not None:
+            self.routing = dict(routing)
+        for sid in range(self.num_shards):
+            if self._owns(sid) and sid not in self._local:
+                shard_path = (
+                    os.path.join(self.base_path, str(sid))
+                    if self.base_path is not None
+                    else None
+                )
+                self._local[sid] = ShardEngine(
+                    self.mappings, self.analysis, path=shard_path, shard_id=sid
+                )
+            elif not self._owns(sid) and sid in self._local:
+                eng = self._local.pop(sid)
+                self._executors.pop(sid, None)
+                eng.close()
+
     def local_shard(self, sid: int) -> ShardEngine:
         eng = self._local.get(sid)
         if eng is None:
@@ -163,8 +190,11 @@ class IndexService:
         """Applies a batch of ops to one shard, local or remote.
         Returns wire-shaped result dicts (TransportShardBulkAction)."""
         owner = self._owner(sid)
-        if owner is None or owner == self.local_node:
+        if owner is None:
             return apply_shard_ops(self.local_shard(sid), ops)
+        # distributed mode always rides the handler seam — even for the
+        # local owner (remote_call short-circuits) — because the handler
+        # is where dynamic-mapping updates round-trip to the master
         out = self.remote_call(
             owner,
             ACTION_SHARD_OPS,
@@ -197,7 +227,7 @@ class IndexService:
         sid = route_shard_id(
             routing if routing is not None else doc_id, self.num_shards
         )
-        if self._owns(sid):
+        if self.routing is None:
             return self.local_shard(sid).index(doc_id, source, op_type, **kwargs)
         op = {"op": "index", "id": doc_id, "source": source, "op_type": op_type}
         op.update({k: v for k, v in kwargs.items() if v is not None})
@@ -209,7 +239,7 @@ class IndexService:
         sid = route_shard_id(
             routing if routing is not None else doc_id, self.num_shards
         )
-        if self._owns(sid):
+        if self.routing is None:
             return self.local_shard(sid).delete(doc_id, **kwargs)
         op = {"op": "delete", "id": doc_id}
         op.update({k: v for k, v in kwargs.items() if v is not None})

@@ -8,10 +8,19 @@ write routing. Per SURVEY §2.7's prescription for a fixed-topology TPU
 pod, consensus is simplified to a deterministic single-writer design:
 the master is the lowest node id among discovered peers, cluster state
 is a versioned JSON snapshot published over the transport, and nodes
-apply states monotonically by version. (Quorum voting/pre-vote — the
-Raft safety machinery — is intentionally out of scope for this tier;
-the reference's InternalTestCluster-style tests exercise the same
-join/publish/apply surface.)
+apply states monotonically by version.
+
+The round-5 unification: each node fronts a full
+:class:`DistributedClusterService` — the same `ClusterService` the
+single-node REST tier uses, whose `IndexService` objects run in
+*distributed mode* (``routing``/``local_node``/``remote_call``). Every
+shard-level operation (full query phase with aggs/sort/knn/highlight,
+scroll/PIT reader contexts, counts, stats, write batches) executes on
+the shard's owning node over the transport, and the coordinator merges
+exactly as the local path does (SearchService.executeQueryPhase +
+SearchPhaseController, SURVEY §3.3). Metadata mutations (index CRUD,
+mappings, settings, aliases, templates) route to the master, which
+publishes the new cluster state to every node.
 
 Data plane vs control plane: scoring stays on-device per node
 (executor_jax), only metadata/doc blobs ride this DCN path.
@@ -19,16 +28,32 @@ Data plane vs control plane: scoring stays on-device per node
 
 from __future__ import annotations
 
+import json
 import os
 import threading
-from typing import Dict, List, Optional, Tuple
+import time
+import uuid as _uuidlib
+from typing import Any, Dict, List, Optional, Tuple
 
-from ..analysis import AnalysisRegistry
-from ..index.engine import ShardEngine, VersionConflictError
-from ..index.mapping import Mappings
-from ..search import dsl
+from ..common import deep_merge
+from ..common.settings import SettingsError, validate_index_settings
+from ..index.mapping import MappingParseError, Mappings
+from .indices import (
+    ACTION_CTX_CLOSE,
+    ACTION_CTX_OPEN,
+    ACTION_SHARD_COUNT,
+    ACTION_SHARD_FLUSH,
+    ACTION_SHARD_GET,
+    ACTION_SHARD_OPS,
+    ACTION_SHARD_REFRESH,
+    ACTION_SHARD_SEARCH,
+    ACTION_SHARD_STATS,
+    IndexService,
+    apply_shard_ops,
+    _flatten_settings,
+)
+from .service import ClusterError, ClusterService, IndexNotFoundError, _validate_index_name
 from ..transport.service import TransportError, TransportService
-from ..utils.murmur3 import shard_id as route_shard_id
 
 
 class NodeError(Exception):
@@ -39,70 +64,150 @@ class NotMasterError(NodeError):
     pass
 
 
-class _LocalIndex:
-    """Per-node view of one index: metadata + the locally-owned shards."""
+# Reader-context TTL when the opener does not specify one (SearchService
+# DEFAULT_KEEPALIVE is 5 minutes).
+DEFAULT_CTX_KEEPALIVE = 300.0
 
-    def __init__(self, name: str, meta: dict, data_path: Optional[str]):
-        self.name = name
-        self.meta = meta
-        self.mappings = Mappings(meta.get("mappings") or {})
-        analysis_cfg = (meta.get("settings") or {}).get("analysis")
-        self.analysis = AnalysisRegistry(
-            {"analysis": analysis_cfg} if analysis_cfg else None
+
+class DistributedClusterService(ClusterService):
+    """`ClusterService` whose metadata mutations ride through the master
+    and whose `IndexService` objects run in distributed mode.
+
+    Reads (search/count/scroll/PIT/resolve/aliases) are inherited
+    unchanged — they operate on ``self.indices``, and the distributed
+    `IndexService` routes per-shard work to owning nodes itself."""
+
+    def __init__(self, node: "TpuNode"):
+        super().__init__(
+            data_path=None,
+            cluster_name=node.cluster_name,
+            node_name=node.name,
         )
-        self.data_path = data_path
-        self.shards: Dict[int, ShardEngine] = {}
-        # executor cache: shard -> (generation, executor)
-        self._executors: Dict[int, tuple] = {}
+        self.node = node
+        # base path for local shard storage; cluster-state persistence is
+        # handled by the node (_persist_state), not ClusterService._persist
+        self.data_path = node.data_path
 
-    @property
-    def num_shards(self) -> int:
-        return int(self.meta.get("num_shards", 1))
+    # metadata persistence rides the node's published-state snapshot
+    def _persist(self) -> None:
+        pass
 
-    def backend(self) -> str:
-        return str((self.meta.get("settings") or {}).get("search.backend", "jax"))
+    def _recover(self) -> None:
+        pass
 
-    def ensure_shard(self, sid: int) -> ShardEngine:
-        eng = self.shards.get(sid)
-        if eng is None:
-            path = (
-                os.path.join(self.data_path, self.name, str(sid))
-                if self.data_path
-                else None
-            )
-            eng = ShardEngine(self.mappings, self.analysis, path=path, shard_id=sid)
-            self.shards[sid] = eng
-        return eng
+    # ---- master round-trip mutations (TransportMasterNodeAction) ----
 
-    def executor(self, sid: int):
-        eng = self.shards[sid]
-        cached = self._executors.get(sid)
-        if cached is not None and cached[0] == eng.change_generation:
-            return cached[1]
-        reader = eng.reader()
-        if self.backend() == "jax":
-            from ..search.executor_jax import JaxExecutor
+    def create_index(self, name: str, body: Optional[dict] = None) -> dict:
+        return self.node.master_request(
+            "indices:admin/create", {"name": name, "body": body or {}}
+        )
 
-            ex = JaxExecutor(reader)
-        else:
-            from ..search.executor import NumpyExecutor
+    def delete_index(self, name: str) -> dict:
+        return self.node.master_request("indices:admin/delete", {"name": name})
 
-            ex = NumpyExecutor(reader)
-        self._executors[sid] = (eng.change_generation, ex)
-        return ex
+    def put_mapping(self, name: str, body: dict) -> dict:
+        return self.node.master_request(
+            "cluster:mapping/update", {"index": name, "mappings": body or {}}
+        )
 
-    def close(self):
-        for eng in self.shards.values():
-            eng.close()
+    def update_settings(self, name: str, body: dict) -> dict:
+        return self.node.master_request(
+            "indices:admin/settings", {"index": name, "settings": body or {}}
+        )
+
+    def update_aliases(self, body: dict) -> dict:
+        return self.node.master_request("indices:admin/aliases", body or {})
+
+    def put_template(self, name: str, body: dict) -> dict:
+        return self.node.master_request(
+            "indices:admin/template/put", {"name": name, "body": body or {}}
+        )
+
+    def delete_template(self, name: str) -> dict:
+        return self.node.master_request(
+            "indices:admin/template/delete", {"name": name}
+        )
+
+    def get_or_autocreate(self, name: str) -> IndexService:
+        """Unlike the single-node base, this must NOT hold the service
+        lock across the master round-trip (the publish-apply thread
+        takes it)."""
+        idx = self.indices.get(name)
+        if idx is None:
+            if not self.cluster_settings.get("action.auto_create_index"):
+                raise IndexNotFoundError(name)
+            try:
+                self.create_index(name)
+            except ClusterError as e:
+                if e.err_type != "resource_already_exists_exception":
+                    raise
+            idx = self.indices.get(name)
+            if idx is None:
+                raise IndexNotFoundError(name)
+        return idx
+
+    # ---- state application (ClusterApplierService.applyClusterState) ----
+
+    def apply_state(self, state: dict) -> None:
+        """Reconciles local services with a freshly-applied cluster
+        state: creates/updates/removes IndexService instances, replaces
+        alias and template metadata."""
+        self.aliases = state.get("aliases", {})
+        self.templates = state.get("templates", {})
+        for name, meta in state.get("indices", {}).items():
+            idx = self.indices.get(name)
+            routing = {int(k): v for k, v in meta.get("routing", {}).items()}
+            if idx is None:
+                idx = IndexService(
+                    name,
+                    settings=meta.get("settings"),
+                    mappings_json=meta.get("mappings"),
+                    base_path=self._index_path(name),
+                    routing=routing,
+                    local_node=self.node.name,
+                    remote_call=self.node.remote_call,
+                )
+                idx.uuid = meta.get("uuid", idx.uuid)
+                idx.creation_date = meta.get("creation_date", idx.creation_date)
+                self.indices[name] = idx
+            else:
+                new_mappings = meta.get("mappings") or {}
+                if new_mappings != idx.mappings.to_json():
+                    idx.mappings.merge(new_mappings)
+                settings = meta.get("settings") or {}
+                flat = {
+                    k: v
+                    for k, v in _flatten_settings(settings).items()
+                    if not k.startswith("analysis.")
+                }
+                idx.settings.update(flat)
+                idx.apply_routing(routing)
+        for name in list(self.indices):
+            if name not in state.get("indices", {}):
+                idx = self.indices.pop(name)
+                idx.close()
+                path = self._index_path(name)
+                if path and os.path.isdir(path):
+                    import shutil
+
+                    shutil.rmtree(path, ignore_errors=True)
+        self.version = state.get("version", self.version)
+
+    def health(self) -> dict:
+        base = super().health()
+        n_nodes = len(self.node.state.get("nodes", {}))
+        base["number_of_nodes"] = n_nodes
+        base["number_of_data_nodes"] = n_nodes
+        return base
 
 
 class TpuNode:
-    """One cluster node: transport endpoint + local shards + coordinator.
+    """One cluster node: transport endpoint + distributed cluster
+    service + coordinator.
 
     Every public document/search method can be called on ANY node (the
     coordinating-node model): the call routes to owning nodes over the
-    transport, exactly `TransportBulkAction`/`TransportSearchAction`.
-    """
+    transport, exactly `TransportBulkAction`/`TransportSearchAction`."""
 
     def __init__(
         self,
@@ -115,12 +220,29 @@ class TpuNode:
         self.name = name
         self.seeds = [tuple(s) for s in (seeds or [])]
         self.data_path = data_path
+        self.cluster_name = cluster_name
         self.transport = TransportService(name, cluster_name, port=port)
-        self.state: dict = {"version": 0, "master": None, "nodes": {}, "indices": {}}
+        self.state: dict = {
+            "version": 0,
+            "master": None,
+            "nodes": {},
+            "indices": {},
+            "aliases": {},
+            "templates": {},
+        }
         self._state_lock = threading.RLock()
-        self.indices: Dict[str, _LocalIndex] = {}
+        self.cluster = DistributedClusterService(self)
+        # pinned reader contexts held for remote scroll/PIT coordinators
+        # (SearchService.createAndPutReaderContext registry)
+        self._ctxs: Dict[str, dict] = {}
+        self._ctx_lock = threading.Lock()
         self._closed = False
         self._register_handlers()
+
+    # expose the index registry (tests + REST introspection)
+    @property
+    def indices(self) -> Dict[str, IndexService]:
+        return self.cluster.indices
 
     # ------------------------------------------------------------------
     # lifecycle, discovery, election (PeerFinder + simplified Zen2)
@@ -138,19 +260,17 @@ class TpuNode:
         master = min(peers)  # deterministic: lowest node id wins
         if master == self.name:
             # GatewayMetaState analog: a restarting master recovers its
-            # persisted index metadata (routing entries to dead nodes are
-            # reconciled by the replication tier). The recovered state is
-            # built as a NEW dict and applied while self.state still
-            # holds the version-0 placeholder, so the monotonic check in
-            # _apply_state sees a genuine version increase (applying
-            # self.state against itself would early-return and lose the
-            # recovered indices).
+            # persisted index metadata. The recovered state is built as a
+            # NEW dict with a version bump so the monotonic check in
+            # _apply_state sees a genuine increase.
             persisted = self._load_persisted_state()
             recovered = {
                 "version": (persisted or {}).get("version", 0) + 1,
                 "master": self.name,
                 "nodes": {self.name: {"address": list(self.transport.address)}},
                 "indices": (persisted or {}).get("indices", {}),
+                "aliases": (persisted or {}).get("aliases", {}),
+                "templates": (persisted or {}).get("templates", {}),
             }
             self._apply_state(recovered)
         else:
@@ -163,9 +283,10 @@ class TpuNode:
         return self
 
     def close(self):
+        if self._closed:
+            return
         self._closed = True
-        for li in self.indices.values():
-            li.close()
+        self.cluster.close()
         self.transport.close()
 
     @property
@@ -174,6 +295,44 @@ class TpuNode:
 
     def is_master(self) -> bool:
         return self.state.get("master") == self.name
+
+    # ------------------------------------------------------------------
+    # routing helpers
+    # ------------------------------------------------------------------
+
+    def remote_call(self, node_id: str, action: str, payload, timeout: float = 30.0):
+        """Dispatch to a node by id: local shortcut or transport hop
+        (the `NodeClient` pattern). This is the `remote_call` seam the
+        distributed IndexService rides."""
+        if node_id == self.name:
+            return self.transport._handlers[action](payload)
+        info = self.state["nodes"].get(node_id)
+        if info is None:
+            raise NodeError(f"unknown node [{node_id}]")
+        return self._send(tuple(info["address"]), action, payload, timeout)
+
+    def master_request(self, action: str, payload, timeout: float = 30.0):
+        """Route a metadata mutation to the master
+        (TransportMasterNodeAction)."""
+        if self.is_master():
+            return self.transport._handlers[action](payload)
+        m = self.state.get("master")
+        info = self.state["nodes"].get(m)
+        if info is None:
+            raise NodeError("no known master")
+        return self._send(tuple(info["address"]), action, payload, timeout)
+
+    def _send(self, address: Tuple[str, int], action: str, payload, timeout: float):
+        """transport.send + re-hydration of ClusterError-shaped remote
+        failures so REST status codes survive the hop."""
+        from ..transport.service import RemoteTransportError
+
+        try:
+            return self.transport.send(address, action, payload, timeout)
+        except RemoteTransportError as e:
+            if e.status is not None and e.err_type is not None:
+                raise ClusterError(e.status, str(e), e.err_type)
+            raise
 
     # ------------------------------------------------------------------
     # handlers
@@ -188,15 +347,27 @@ class TpuNode:
         t.register_handler("cluster:mapping/update", self._handle_mapping_update)
         t.register_handler("indices:admin/create", self._handle_create_index)
         t.register_handler("indices:admin/delete", self._handle_delete_index)
-        t.register_handler("indices:admin/refresh", self._handle_refresh)
-        t.register_handler("indices:data/write/shard_ops", self._handle_shard_ops)
-        t.register_handler("indices:data/read/get", self._handle_get)
-        t.register_handler("indices:data/read/search_shard", self._handle_search_shard)
+        t.register_handler("indices:admin/settings", self._handle_update_settings)
+        t.register_handler("indices:admin/aliases", self._handle_update_aliases)
+        t.register_handler("indices:admin/template/put", self._handle_put_template)
+        t.register_handler(
+            "indices:admin/template/delete", self._handle_delete_template
+        )
+        t.register_handler(ACTION_SHARD_REFRESH, self._handle_refresh_shards)
+        t.register_handler(ACTION_SHARD_FLUSH, self._handle_flush_shards)
+        t.register_handler(ACTION_SHARD_STATS, self._handle_shard_stats)
+        t.register_handler(ACTION_SHARD_OPS, self._handle_shard_ops)
+        t.register_handler(ACTION_SHARD_GET, self._handle_get)
+        t.register_handler(ACTION_SHARD_SEARCH, self._handle_search_shard)
+        t.register_handler(ACTION_SHARD_COUNT, self._handle_count_shard)
+        t.register_handler(ACTION_CTX_OPEN, self._handle_ctx_open)
+        t.register_handler(ACTION_CTX_CLOSE, self._handle_ctx_close)
+
+    # ---- membership + publication ----
 
     def _handle_join(self, p: dict) -> dict:
         with self._state_lock:
-            if not self.is_master():
-                raise NotMasterError(f"[{self.name}] is not the master")
+            self._require_master()
             new = _copy_state(self.state)
             new["nodes"][p["node"]] = {"address": p["address"]}
             new["version"] += 1
@@ -205,11 +376,11 @@ class TpuNode:
 
     def _handle_publish(self, p: dict) -> dict:
         self._apply_state(p)
-        return {"ack": True, "node": self.name}
+        return {"ack": True, "node": self.name, "version": p.get("version")}
 
     def _publish(self, new_state: dict):
         """Master applies locally then pushes to every other node
-        (PublicationTransportHandler; single-phase — see module note)."""
+        (PublicationTransportHandler; single-phase)."""
         self._apply_state(new_state)
         for nid, info in new_state["nodes"].items():
             if nid == self.name:
@@ -219,34 +390,23 @@ class TpuNode:
                     tuple(info["address"]), "cluster:state/publish", new_state
                 )
             except TransportError:
-                pass  # node-left handling arrives with replication tier
+                pass  # publish-failure repair arrives with failure detection
 
     def _apply_state(self, state: dict):
         """ClusterApplierService.onNewClusterState: monotonic by version;
-        creates/removes local shards to match the routing table."""
+        reconciles the local service registry to the routing table."""
         with self._state_lock:
             if state["version"] <= self.state.get("version", 0):
                 return
             self.state = state
-            for iname, meta in state["indices"].items():
-                li = self.indices.get(iname)
-                if li is None:
-                    li = _LocalIndex(iname, meta, self.data_path)
-                    self.indices[iname] = li
-                else:
-                    # merge published mapping updates into the live
-                    # Mappings object the engines share
-                    new_mappings = meta.get("mappings") or {}
-                    if new_mappings != li.mappings.to_json():
-                        li.mappings.merge(new_mappings)
-                    li.meta = meta
-                for sid_s, owner in meta.get("routing", {}).items():
-                    if owner == self.name:
-                        li.ensure_shard(int(sid_s))
-            for iname in list(self.indices):
-                if iname not in state["indices"]:
-                    self.indices.pop(iname).close()
+            self.cluster.apply_state(state)
             self._persist_state()
+
+    def _require_master(self):
+        if not self.is_master():
+            raise NotMasterError(f"[{self.name}] is not the master")
+
+    # ---- persisted cluster state (PersistedClusterStateService) ----
 
     def _state_path(self) -> Optional[str]:
         if self.data_path is None:
@@ -254,13 +414,9 @@ class TpuNode:
         return os.path.join(self.data_path, "_cluster_state.json")
 
     def _persist_state(self):
-        """PersistedClusterStateService analog: every applied state is
-        durable so a restarted node can recover metadata."""
         path = self._state_path()
         if path is None:
             return
-        import json
-
         os.makedirs(self.data_path, exist_ok=True)
         tmp = path + ".tmp"
         with open(tmp, "w", encoding="utf-8") as f:
@@ -273,8 +429,6 @@ class TpuNode:
         path = self._state_path()
         if path is None:
             return None
-        import json
-
         try:
             with open(path, encoding="utf-8") as f:
                 return json.load(f)
@@ -282,347 +436,436 @@ class TpuNode:
             return None
 
     # ------------------------------------------------------------------
-    # index admin
+    # master-side metadata mutations
     # ------------------------------------------------------------------
 
     def _handle_create_index(self, p: dict) -> dict:
         with self._state_lock:
-            if not self.is_master():
-                raise NotMasterError(f"[{self.name}] is not the master")
+            self._require_master()
             name = p["name"]
             body = p.get("body") or {}
+            _validate_index_name(name)
             if name in self.state["indices"]:
-                raise NodeError(f"index [{name}] already exists")
-            settings = dict(body.get("settings") or {})
-            settings = {
-                (k[len("index.") :] if k.startswith("index.") else k): v
-                for k, v in _flatten(settings).items()
+                raise ClusterError(
+                    400,
+                    f"index [{name}] already exists",
+                    "resource_already_exists_exception",
+                )
+            if name in self.state.get("aliases", {}):
+                raise ClusterError(
+                    400,
+                    f"an alias with the same name as the index [{name}] "
+                    "already exists",
+                    "invalid_index_name_exception",
+                )
+            settings = body.get("settings") or {}
+            mappings = body.get("mappings") or {}
+            template = _template_for(self.state.get("templates", {}), name)
+            if template is not None:
+                t = template.get("template", {})
+                settings = deep_merge(t.get("settings") or {}, settings)
+                mappings = deep_merge(t.get("mappings") or {}, mappings)
+            flat = _flatten_settings(settings)
+            analysis_cfg = {
+                k: v for k, v in flat.items() if k.startswith("analysis.")
             }
-            num_shards = int(settings.get("number_of_shards", 1))
+            flat = {k: v for k, v in flat.items() if not k.startswith("analysis.")}
+            try:
+                validated = validate_index_settings(flat, creating=True)
+                Mappings(mappings)  # parse check
+            except SettingsError as e:
+                raise ClusterError(400, str(e), "illegal_argument_exception")
+            except (MappingParseError, ValueError) as e:
+                raise ClusterError(400, str(e), "mapper_parsing_exception")
+            num_shards = int(validated.get("number_of_shards", 1))
             nodes = sorted(self.state["nodes"])
             # round-robin allocation over the sorted node set
             # (BalancedShardsAllocator, radically simplified)
-            routing = {
-                str(s): nodes[s % len(nodes)] for s in range(num_shards)
-            }
+            routing = {str(s): nodes[s % len(nodes)] for s in range(num_shards)}
+            meta_settings: Dict[str, Any] = dict(validated)
+            meta_settings["number_of_shards"] = num_shards
+            if analysis_cfg:
+                # re-nest the analysis group for AnalysisRegistry
+                nested: dict = {}
+                for k, v in analysis_cfg.items():
+                    parts = k.split(".")
+                    node = nested
+                    for part in parts[:-1]:
+                        node = node.setdefault(part, {})
+                    node[parts[-1]] = v
+                meta_settings["analysis"] = nested["analysis"]
+            creation_date = int(time.time() * 1000)
             new = _copy_state(self.state)
             new["indices"][name] = {
-                "settings": settings,
-                "mappings": body.get("mappings") or {},
+                "settings": meta_settings,
+                "mappings": mappings,
                 "num_shards": num_shards,
                 "routing": routing,
+                "uuid": _uuidlib.uuid4().hex[:22],
+                "creation_date": creation_date,
             }
             new["version"] += 1
             self._publish(new)
-            return {"acknowledged": True, "index": name, "routing": routing}
+            return {
+                "acknowledged": True,
+                "shards_acknowledged": True,
+                "index": name,
+                "routing": routing,
+            }
+
+    def _handle_delete_index(self, p: dict) -> dict:
+        with self._state_lock:
+            self._require_master()
+            name = p["name"]
+            if name not in self.state["indices"]:
+                raise IndexNotFoundError(name)
+            new = _copy_state(self.state)
+            del new["indices"][name]
+            for alias in list(new.get("aliases", {})):
+                new["aliases"][alias].pop(name, None)
+                if not new["aliases"][alias]:
+                    del new["aliases"][alias]
+            new["version"] += 1
+            self._publish(new)
+            return {"acknowledged": True}
 
     def _handle_mapping_update(self, p: dict) -> dict:
-        """Dynamic-mapping updates round-trip through the master and ride
-        the next published state (SURVEY.md §3.2: 'may round-trip to
-        MASTER for dynamic mapping')."""
+        """Explicit PUT _mapping and dynamic-mapping round-trips both
+        land here (SURVEY.md §3.2 'may round-trip to MASTER')."""
         with self._state_lock:
-            if not self.is_master():
-                raise NotMasterError(f"[{self.name}] is not the master")
+            self._require_master()
             name = p["index"]
             if name not in self.state["indices"]:
-                raise NodeError(f"no such index [{name}]")
+                raise IndexNotFoundError(name)
             new = _copy_state(self.state)
-            merged = Mappings(new["indices"][name].get("mappings") or {})
-            merged.merge(p["mappings"])
+            try:
+                merged = Mappings(new["indices"][name].get("mappings") or {})
+                merged.merge(p["mappings"])
+            except MappingParseError as e:
+                raise ClusterError(400, str(e), "illegal_argument_exception")
             new["indices"][name]["mappings"] = merged.to_json()
             new["version"] += 1
             self._publish(new)
             return {"acknowledged": True}
 
-    def _handle_delete_index(self, p: dict) -> dict:
+    def _handle_update_settings(self, p: dict) -> dict:
         with self._state_lock:
-            if not self.is_master():
-                raise NotMasterError(f"[{self.name}] is not the master")
-            name = p["name"]
+            self._require_master()
+            name = p["index"]
             if name not in self.state["indices"]:
-                raise NodeError(f"no such index [{name}]")
+                raise IndexNotFoundError(name)
+            flat = _flatten_settings(p.get("settings") or {})
+            try:
+                validated = validate_index_settings(flat, creating=False)
+            except SettingsError as e:
+                raise ClusterError(400, str(e), "illegal_argument_exception")
             new = _copy_state(self.state)
-            del new["indices"][name]
+            new["indices"][name]["settings"].update(validated)
             new["version"] += 1
             self._publish(new)
             return {"acknowledged": True}
 
-    def _handle_refresh(self, p: dict) -> dict:
-        li = self.indices.get(p["index"])
+    def _handle_update_aliases(self, p: dict) -> dict:
+        with self._state_lock:
+            self._require_master()
+            new = _copy_state(self.state)
+            aliases = new.setdefault("aliases", {})
+            for entry in (p or {}).get("actions", []):
+                if not isinstance(entry, dict) or len(entry) != 1:
+                    raise ClusterError(
+                        400, "malformed alias action", "illegal_argument_exception"
+                    )
+                op, spec = next(iter(entry.items()))
+                indices = spec.get("indices") or (
+                    [spec["index"]] if "index" in spec else []
+                )
+                names = spec.get("aliases") or (
+                    [spec["alias"]] if "alias" in spec else []
+                )
+                if not indices:
+                    raise ClusterError(
+                        400,
+                        "Validation Failed: 1: index is missing;",
+                        "action_request_validation_exception",
+                    )
+                if not names and op != "remove_index":
+                    raise ClusterError(
+                        400,
+                        "Validation Failed: 1: alias is missing;",
+                        "action_request_validation_exception",
+                    )
+                if op == "add":
+                    for index in indices:
+                        if index not in new["indices"]:
+                            raise IndexNotFoundError(index)
+                        for alias in names:
+                            if alias in new["indices"]:
+                                raise ClusterError(
+                                    400,
+                                    "an index exists with the same name as "
+                                    f"the alias [{alias}]",
+                                    "invalid_alias_name_exception",
+                                )
+                            aliases.setdefault(alias, {})[index] = {
+                                "filter": spec.get("filter"),
+                                "is_write_index": bool(
+                                    spec.get("is_write_index", False)
+                                ),
+                            }
+                elif op == "remove":
+                    for index in indices:
+                        for alias in names:
+                            entry2 = aliases.get(alias)
+                            if entry2 is None or index not in entry2:
+                                raise ClusterError(
+                                    404,
+                                    f"aliases [{alias}] missing",
+                                    "aliases_not_found_exception",
+                                )
+                            entry2.pop(index, None)
+                            if not entry2:
+                                aliases.pop(alias, None)
+                elif op == "remove_index":
+                    for index in indices:
+                        if index in new["indices"]:
+                            del new["indices"][index]
+                else:
+                    raise ClusterError(
+                        400,
+                        f"unknown alias action [{op}]",
+                        "illegal_argument_exception",
+                    )
+            new["version"] += 1
+            self._publish(new)
+            return {"acknowledged": True}
+
+    def _handle_put_template(self, p: dict) -> dict:
+        with self._state_lock:
+            self._require_master()
+            body = p.get("body") or {}
+            patterns = body.get("index_patterns")
+            if not patterns:
+                raise ClusterError(
+                    400,
+                    "index template must have at least one index pattern",
+                    "illegal_argument_exception",
+                )
+            new = _copy_state(self.state)
+            new.setdefault("templates", {})[p["name"]] = {
+                "index_patterns": patterns
+                if isinstance(patterns, list)
+                else [patterns],
+                "template": body.get("template", {}),
+                "priority": int(body.get("priority", 0)),
+            }
+            new["version"] += 1
+            self._publish(new)
+            return {"acknowledged": True}
+
+    def _handle_delete_template(self, p: dict) -> dict:
+        with self._state_lock:
+            self._require_master()
+            if p["name"] not in self.state.get("templates", {}):
+                raise ClusterError(
+                    404,
+                    f"index template matching [{p['name']}] not found",
+                    "resource_not_found_exception",
+                )
+            new = _copy_state(self.state)
+            del new["templates"][p["name"]]
+            new["version"] += 1
+            self._publish(new)
+            return {"acknowledged": True}
+
+    # ------------------------------------------------------------------
+    # shard-level handlers (the owning-node side of the IndexService
+    # remote actions)
+    # ------------------------------------------------------------------
+
+    def _index_service(self, name: str) -> IndexService:
+        idx = self.cluster.indices.get(name)
+        if idx is None:
+            raise IndexNotFoundError(name)
+        return idx
+
+    def _handle_refresh_shards(self, p: dict) -> dict:
+        idx = self._index_service(p["index"])
         n = 0
-        if li is not None:
-            for eng in li.shards.values():
-                eng.refresh()
-                n += 1
+        for s in idx.shards:  # local shards only — no re-fan-out
+            s.refresh()
+            n += 1
         return {"refreshed_shards": n}
 
-    # ------------------------------------------------------------------
-    # document ops (shard-routed, TransportShardBulkAction analog)
-    # ------------------------------------------------------------------
+    def _handle_flush_shards(self, p: dict) -> dict:
+        idx = self._index_service(p["index"])
+        n = 0
+        for s in idx.shards:
+            s.flush()
+            n += 1
+        idx._persist_meta()
+        return {"flushed_shards": n}
+
+    def _handle_shard_stats(self, p: dict) -> dict:
+        return self._index_service(p["index"]).local_stats()
 
     def _handle_shard_ops(self, p: dict) -> dict:
-        li = self.indices.get(p["index"])
-        if li is None:
-            raise NodeError(f"no such index [{p['index']}] on [{self.name}]")
+        idx = self._index_service(p["index"])
         sid = int(p["shard"])
-        eng = li.shards.get(sid)
+        eng = idx._local.get(sid)
         if eng is None:
             raise NodeError(
                 f"shard [{p['index']}][{sid}] not allocated to [{self.name}]"
             )
-        results = []
-        for op in p["ops"]:
-            try:
-                if op["op"] == "index":
-                    r = eng.index(
-                        op["id"], op["source"], op_type=op.get("op_type", "index")
-                    )
-                    results.append(
-                        {
-                            "ok": True,
-                            "result": r.result,
-                            "_version": r.version,
-                            "_seq_no": r.seq_no,
-                        }
-                    )
-                elif op["op"] == "delete":
-                    r = eng.delete(op["id"])
-                    results.append({"ok": True, "result": r.result})
-                else:
-                    results.append({"ok": False, "error": f"bad op {op['op']}"})
-            except VersionConflictError as e:
-                results.append(
-                    {
-                        "ok": False,
-                        "error": str(e),
-                        "etype": "version_conflict_engine_exception",
-                    }
-                )
+        results = apply_shard_ops(eng, p["ops"])
         # dynamic mapping changes must reach the master (and thus every
         # coordinator + the persisted state) before they are lost to a
-        # restart — compare against the applied metadata and round-trip
-        mj = li.mappings.to_json()
-        if mj != (li.meta.get("mappings") or {}):
+        # restart — compare against the published metadata and round-trip
+        mj = idx.mappings.to_json()
+        published = (self.state["indices"].get(p["index"]) or {}).get(
+            "mappings"
+        ) or {}
+        if mj != published:
             try:
-                payload = {"index": p["index"], "mappings": mj}
-                if self.is_master():
-                    self._handle_mapping_update(payload)
-                else:
-                    self.transport.send(
-                        self._master_addr(), "cluster:mapping/update", payload
-                    )
-                # only record success AFTER the master acked — a failed
-                # send leaves meta stale so the next write retries
-                li.meta["mappings"] = mj
+                self.master_request(
+                    "cluster:mapping/update",
+                    {"index": p["index"], "mappings": mj},
+                )
             except TransportError:
-                pass  # genuinely retried on the next write now
+                pass  # retried on the next write (published stays stale)
         return {"results": results}
 
     def _handle_get(self, p: dict) -> dict:
-        li = self.indices.get(p["index"])
-        if li is None:
-            raise NodeError(f"no such index [{p['index']}]")
-        eng = li.shards.get(int(p["shard"]))
+        idx = self._index_service(p["index"])
+        eng = idx._local.get(int(p["shard"]))
         if eng is None:
             raise NodeError("shard not here")
         doc = eng.get(p["id"])
         return {"found": doc is not None, "doc": doc}
 
-    # ------------------------------------------------------------------
-    # shard-level search (SearchService.executeQueryPhase analog; the
-    # fetch phase is folded into the query response — hits carry _source)
-    # ------------------------------------------------------------------
-
     def _handle_search_shard(self, p: dict) -> dict:
-        li = self.indices.get(p["index"])
-        if li is None:
-            raise NodeError(f"no such index [{p['index']}]")
+        idx = self._index_service(p["index"])
         sid = int(p["shard"])
-        if sid not in li.shards:
-            raise NodeError("shard not here")
-        body = p.get("body") or {}
-        ex = li.executor(sid)
-        query = dsl.parse_query(body["query"]) if "query" in body else None
-        size = int(body.get("size", 10)) + int(body.get("from", 0))
-        td = ex.search(query, size=size)
-        reader = ex.reader
-        hits = []
-        for h in td.hits:
-            src = reader.segments[h.segment].sources[h.local_doc]
-            hits.append({"_id": h.doc_id, "_score": h.score, "_source": src})
-        return {
-            "total": td.total,
-            "max_score": td.max_score,
-            "hits": hits,
-        }
+        pinned = None
+        if p.get("ctx"):
+            pinned = self._ctx_executor(p["ctx"])
+        return idx.shard_search_local(sid, p.get("body"), pinned_executor=pinned)
+
+    def _handle_count_shard(self, p: dict) -> dict:
+        idx = self._index_service(p["index"])
+        return idx.shard_count_local(int(p["shard"]), p.get("body"))
+
+    # ---- pinned reader contexts (scroll/PIT across nodes) ----
+
+    def _handle_ctx_open(self, p: dict) -> dict:
+        idx = self._index_service(p["index"])
+        sid = int(p["shard"])
+        ex = idx._executor(idx.local_shard(sid))
+        ctx_id = _uuidlib.uuid4().hex
+        keep_alive = float(p.get("keep_alive", DEFAULT_CTX_KEEPALIVE))
+        with self._ctx_lock:
+            self._reap_ctxs()
+            self._ctxs[ctx_id] = {
+                "executor": ex,
+                "expires": time.time() + keep_alive,
+                "keep_alive": keep_alive,
+            }
+        return {"ctx": ctx_id}
+
+    def _handle_ctx_close(self, p: dict) -> dict:
+        with self._ctx_lock:
+            found = self._ctxs.pop(p.get("ctx"), None) is not None
+        return {"closed": found}
+
+    def _ctx_executor(self, ctx_id: str):
+        with self._ctx_lock:
+            entry = self._ctxs.get(ctx_id)
+            if entry is None or entry["expires"] < time.time():
+                self._ctxs.pop(ctx_id, None)
+                raise ClusterError(
+                    404,
+                    f"No search context found for id [{ctx_id}]",
+                    "search_context_missing_exception",
+                )
+            entry["expires"] = time.time() + entry["keep_alive"]
+            return entry["executor"]
+
+    def _reap_ctxs(self):
+        now = time.time()
+        for cid in [c for c, e in self._ctxs.items() if e["expires"] < now]:
+            self._ctxs.pop(cid, None)
 
     # ------------------------------------------------------------------
-    # coordinator API (callable on any node)
+    # coordinator facade (callable on any node; NodeClient pattern)
     # ------------------------------------------------------------------
-
-    def _master_addr(self) -> Tuple[str, int]:
-        m = self.state.get("master")
-        if m == self.name:
-            return self.transport.address
-        info = self.state["nodes"].get(m)
-        if info is None:
-            raise NodeError("no known master")
-        return tuple(info["address"])
-
-    def _call(self, node_id: str, action: str, payload, timeout: float = 30.0):
-        """Local shortcut or transport hop (the `NodeClient` pattern)."""
-        if node_id == self.name:
-            return self.transport._handlers[action](payload)
-        info = self.state["nodes"].get(node_id)
-        if info is None:
-            raise NodeError(f"unknown node [{node_id}]")
-        return self.transport.send(tuple(info["address"]), action, payload, timeout)
 
     def create_index(self, name: str, body: Optional[dict] = None) -> dict:
-        payload = {"name": name, "body": body or {}}
-        if self.is_master():
-            return self._handle_create_index(payload)
-        return self.transport.send(
-            self._master_addr(), "indices:admin/create", payload
-        )
+        return self.cluster.create_index(name, body)
 
     def delete_index(self, name: str) -> dict:
-        payload = {"name": name}
-        if self.is_master():
-            return self._handle_delete_index(payload)
-        return self.transport.send(
-            self._master_addr(), "indices:admin/delete", payload
-        )
-
-    def _index_meta(self, index: str) -> dict:
-        meta = self.state["indices"].get(index)
-        if meta is None:
-            raise NodeError(f"no such index [{index}]")
-        return meta
-
-    def _owner(self, index: str, doc_id: str, routing: Optional[str] = None):
-        meta = self._index_meta(index)
-        sid = route_shard_id(
-            routing if routing is not None else doc_id, meta["num_shards"]
-        )
-        return sid, meta["routing"][str(sid)]
+        return self.cluster.delete_index(name)
 
     def index_doc(
         self, index: str, doc_id: str, source: dict, op_type: str = "index"
     ) -> dict:
-        sid, owner = self._owner(index, doc_id)
-        out = self._call(
-            owner,
-            "indices:data/write/shard_ops",
-            {
-                "index": index,
-                "shard": sid,
-                "ops": [
-                    {"op": "index", "id": doc_id, "source": source, "op_type": op_type}
-                ],
-            },
+        idx = self._index_service(index)
+        from ..utils.murmur3 import shard_id as route_shard_id
+
+        sid = route_shard_id(doc_id, idx.num_shards)
+        out = idx._shard_ops(
+            sid, [{"op": "index", "id": doc_id, "source": source, "op_type": op_type}]
         )
-        return out["results"][0]
+        return out[0]
 
     def delete_doc(self, index: str, doc_id: str) -> dict:
-        sid, owner = self._owner(index, doc_id)
-        out = self._call(
-            owner,
-            "indices:data/write/shard_ops",
-            {"index": index, "shard": sid, "ops": [{"op": "delete", "id": doc_id}]},
-        )
-        return out["results"][0]
+        idx = self._index_service(index)
+        from ..utils.murmur3 import shard_id as route_shard_id
+
+        sid = route_shard_id(doc_id, idx.num_shards)
+        return idx._shard_ops(sid, [{"op": "delete", "id": doc_id}])[0]
 
     def bulk(self, index: str, ops: List[dict]) -> List[dict]:
         """ops: [{"op": "index"|"delete", "id": ..., "source": ...}];
         grouped by owning shard, one transport hop per shard."""
-        meta = self._index_meta(index)
+        idx = self._index_service(index)
+        from ..utils.murmur3 import shard_id as route_shard_id
+
         by_shard: Dict[int, List[Tuple[int, dict]]] = {}
         for i, op in enumerate(ops):
-            sid = route_shard_id(op["id"], meta["num_shards"])
+            sid = route_shard_id(op["id"], idx.num_shards)
             by_shard.setdefault(sid, []).append((i, op))
         results: List[Optional[dict]] = [None] * len(ops)
         for sid, items in by_shard.items():
-            owner = meta["routing"][str(sid)]
-            out = self._call(
-                owner,
-                "indices:data/write/shard_ops",
-                {"index": index, "shard": sid, "ops": [op for _, op in items]},
-            )
-            for (i, _), r in zip(items, out["results"]):
+            out = idx._shard_ops(sid, [op for _, op in items])
+            for (i, _), r in zip(items, out):
                 results[i] = r
         return results  # type: ignore[return-value]
 
     def get_doc(self, index: str, doc_id: str) -> Optional[dict]:
-        sid, owner = self._owner(index, doc_id)
-        out = self._call(
-            owner, "indices:data/read/get", {"index": index, "shard": sid, "id": doc_id}
-        )
-        return out["doc"] if out["found"] else None
+        return self._index_service(index).get_doc(doc_id)
 
     def refresh(self, index: str) -> None:
-        meta = self._index_meta(index)
-        for nid in {o for o in meta["routing"].values()}:
-            self._call(nid, "indices:admin/refresh", {"index": index})
+        self._index_service(index).refresh()
 
     def search(self, index: str, body: Optional[dict] = None) -> dict:
-        """Scatter to one copy of every shard, gather, merge by
-        (score desc, shard asc, rank asc) — SearchPhaseController."""
-        import time as _time
+        try:
+            return self.cluster.search(index, body)
+        except IndexNotFoundError as e:
+            raise NodeError(str(e))
 
-        t0 = _time.perf_counter()
-        body = body or {}
-        meta = self._index_meta(index)
-        size = int(body.get("size", 10))
-        from_ = int(body.get("from", 0))
-        shard_pages = []
-        for sid_s, owner in sorted(meta["routing"].items(), key=lambda kv: int(kv[0])):
-            page = self._call(
-                owner,
-                "indices:data/read/search_shard",
-                {"index": index, "shard": int(sid_s), "body": body},
-            )
-            shard_pages.append(page)
-        cands = []
-        for si, page in enumerate(shard_pages):
-            for rank, h in enumerate(page["hits"]):
-                cands.append((-(h["_score"] or 0.0), si, rank, h))
-        cands.sort(key=lambda c: c[:3])
-        total = sum(p["total"] for p in shard_pages)
-        window = cands[from_ : from_ + size]
-        hits = [
-            {"_index": index, "_id": h["_id"], "_score": h["_score"], "_source": h["_source"]}
-            for _, _, _, h in window
-        ]
-        max_score = max(
-            (p["max_score"] for p in shard_pages if p["max_score"] is not None),
-            default=None,
-        )
-        n = len(shard_pages)
-        return {
-            "took": int((_time.perf_counter() - t0) * 1000),
-            "timed_out": False,
-            "_shards": {"total": n, "successful": n, "skipped": 0, "failed": 0},
-            "hits": {
-                "total": {"value": total, "relation": "eq"},
-                "max_score": max_score,
-                "hits": hits,
-            },
-        }
+    def count(self, index: str, body: Optional[dict] = None) -> dict:
+        return self.cluster.count(index, body)
 
 
 def _copy_state(state: dict) -> dict:
-    import json
-
     return json.loads(json.dumps(state))
 
 
-def _flatten(d: dict, prefix: str = "") -> dict:
-    out = {}
-    for k, v in d.items():
-        key = f"{prefix}.{k}" if prefix else k
-        if isinstance(v, dict):
-            out.update(_flatten(v, key))
-        else:
-            out[key] = v
-    return out
+def _template_for(templates: Dict[str, dict], index_name: str) -> Optional[dict]:
+    import fnmatch
+
+    best = None
+    for t in templates.values():
+        if any(fnmatch.fnmatch(index_name, p) for p in t["index_patterns"]):
+            if best is None or t["priority"] > best["priority"]:
+                best = t
+    return best

@@ -186,22 +186,64 @@ class ElasticsearchTpuServer:
 
 def main(argv=None):
     ap = argparse.ArgumentParser(description="elasticsearch-tpu node")
-    ap.add_argument("--port", type=int, default=9200)
+    ap.add_argument("--port", type=int, default=9200, help="HTTP port")
     ap.add_argument("--host", default="127.0.0.1")
     ap.add_argument("--data-path", default=None)
+    ap.add_argument(
+        "--node-name", default=None, help="start a cluster node (transport on)"
+    )
+    ap.add_argument(
+        "--transport-port", type=int, default=9300, help="inter-node RPC port"
+    )
+    ap.add_argument(
+        "--seeds",
+        default=None,
+        help="comma-separated host:port seed list (discovery.seed_hosts)",
+    )
     args = ap.parse_args(argv)
-    server = ElasticsearchTpuServer(
-        port=args.port, host=args.host, data_path=args.data_path
-    )
-    print(
-        f"elasticsearch-tpu listening on http://{args.host}:{server.port} "
-        f"(data: {args.data_path or 'in-memory'})",
-        flush=True,
-    )
+    node = None
+    if args.node_name is not None or args.seeds is not None:
+        # multi-node mode: the HTTP tier fronts a TpuNode's distributed
+        # cluster service (Netty4HttpServerTransport + TransportService
+        # both bound on one Node, SURVEY.md §3.1)
+        from ..cluster.node import TpuNode
+
+        seeds = []
+        for part in (args.seeds or "").split(","):
+            part = part.strip()
+            if part:
+                h, _, p = part.rpartition(":")
+                seeds.append((h or "127.0.0.1", int(p)))
+        node = TpuNode(
+            args.node_name or "node-0",
+            seeds=seeds,
+            data_path=args.data_path,
+            port=args.transport_port,
+        ).start()
+        server = ElasticsearchTpuServer(
+            port=args.port, host=args.host, cluster=node.cluster
+        )
+        print(
+            f"elasticsearch-tpu node [{node.name}] transport "
+            f"{node.address[0]}:{node.address[1]} http://{args.host}:{server.port}"
+            f" (data: {args.data_path or 'in-memory'})",
+            flush=True,
+        )
+    else:
+        server = ElasticsearchTpuServer(
+            port=args.port, host=args.host, data_path=args.data_path
+        )
+        print(
+            f"elasticsearch-tpu listening on http://{args.host}:{server.port} "
+            f"(data: {args.data_path or 'in-memory'})",
+            flush=True,
+        )
     try:
         server.serve_forever()
     except KeyboardInterrupt:
         server.close()
+        if node is not None:
+            node.close()
 
 
 if __name__ == "__main__":

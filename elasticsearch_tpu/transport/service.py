@@ -54,10 +54,22 @@ class ReceiveTimeoutTransportError(TransportError):
 
 
 class RemoteTransportError(TransportError):
-    """An exception raised by the remote handler, re-raised locally."""
+    """An exception raised by the remote handler, re-raised locally.
+    Carries the remote exception's REST status/err_type when the remote
+    raised a ClusterError-shaped exception, so coordinators can re-raise
+    with the right HTTP status (ES serializes ElasticsearchException
+    status over the wire the same way)."""
 
-    def __init__(self, reason: str, etype: str):
+    def __init__(
+        self,
+        reason: str,
+        etype: str,
+        status: Optional[int] = None,
+        err_type: Optional[str] = None,
+    ):
         super().__init__(reason, etype)
+        self.status = status
+        self.err_type = err_type
 
 
 async def _read_frame(reader: asyncio.StreamReader) -> dict:
@@ -279,6 +291,12 @@ class TransportService:
                     "error": str(e),
                     "etype": type(e).__name__,
                 }
+                status = getattr(e, "status", None)
+                err_type = getattr(e, "err_type", None)
+                if isinstance(status, int):
+                    out["status"] = status
+                if isinstance(err_type, str):
+                    out["err_type"] = err_type
         try:
             writer.write(_frame(out))
             await writer.drain()
@@ -345,7 +363,10 @@ class TransportService:
             )
         if msg.get("t") == "e":
             raise RemoteTransportError(
-                msg.get("error", "remote error"), msg.get("etype", "exception")
+                msg.get("error", "remote error"),
+                msg.get("etype", "exception"),
+                status=msg.get("status"),
+                err_type=msg.get("err_type"),
             )
         return msg.get("p")
 

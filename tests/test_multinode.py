@@ -77,8 +77,8 @@ class TestDistributedIndex:
         owners = set(r["routing"].values())
         assert owners == {"node-0", "node-1"}
         # both nodes hold their shards locally
-        assert set(a.indices["dist"].shards) | set(
-            b.indices["dist"].shards
+        assert set(a.indices["dist"].local_shards) | set(
+            b.indices["dist"].local_shards
         ) == {0, 1, 2, 3}
 
         docs = {
@@ -159,9 +159,9 @@ class TestPersistence:
                 a.index_doc("pers", str(i), {"body": f"doc number {i}"})
             a.refresh("pers")
             for li in b.indices.values():
-                for eng in li.shards.values():
+                for eng in li.shards:
                     eng.flush()
-            b_docs = sum(e.num_docs for e in b.indices["pers"].shards.values())
+            b_docs = sum(e.num_docs for e in b.indices["pers"].shards)
         finally:
             b.close()
         # restart node-1 with the same data path; rejoin and recover
@@ -170,7 +170,7 @@ class TestPersistence:
         ).start()
         try:
             b2_docs = sum(
-                e.num_docs for e in b2.indices["pers"].shards.values()
+                e.num_docs for e in b2.indices["pers"].shards
             )
             assert b2_docs == b_docs
             resp = a.search("pers", {"query": {"match": {"body": "doc"}}})
@@ -192,7 +192,7 @@ class TestPersistence:
                 a.index_doc("solo", str(i), {"body": f"persisted doc {i}"})
             a.refresh("solo")
             for li in a.indices.values():
-                for eng in li.shards.values():
+                for eng in li.shards:
                     eng.flush()
         finally:
             a.close()
@@ -203,7 +203,7 @@ class TestPersistence:
                 assert "solo" in a2.state["indices"], "metadata lost on restart"
                 assert "solo" in a2.indices, "local index not re-created"
                 assert sum(
-                    e.num_docs for e in a2.indices["solo"].shards.values()
+                    e.num_docs for e in a2.indices["solo"].shards
                 ) == 5
                 assert a2.get_doc("solo", "3")["_source"]["body"] == "persisted doc 3"
                 resp = a2.search("solo", {"query": {"match": {"body": "persisted"}}})
