@@ -54,6 +54,27 @@ ACTION_SHARD_FLUSH = "indices:admin/flush_shards"
 ACTION_SHARD_STATS = "indices:monitor/shard_stats"
 ACTION_CTX_OPEN = "indices:data/read/ctx_open"
 ACTION_CTX_CLOSE = "indices:data/read/ctx_close"
+ACTION_SHARD_REPLICA_OPS = "indices:data/write/replica_ops"
+
+
+def norm_shard_routing(entry) -> dict:
+    """Normalizes a routing-table entry to the replicated shape
+    {"primary", "replicas", "in_sync", "primary_term"} (ShardRouting +
+    the in-sync allocation set that IndexMetadata carries, SURVEY §2.6).
+    Pre-replication states stored a bare primary node id string."""
+    if isinstance(entry, str):
+        return {"primary": entry, "replicas": [], "in_sync": [entry],
+                "primary_term": 1}
+    primary = entry.get("primary")
+    in_sync = entry.get("in_sync")
+    if in_sync is None:
+        in_sync = [primary] if primary is not None else []
+    return {
+        "primary": primary,
+        "replicas": list(entry.get("replicas", [])),
+        "in_sync": list(in_sync),
+        "primary_term": int(entry.get("primary_term", 1)),
+    }
 
 
 class IndexService:
@@ -96,11 +117,20 @@ class IndexService:
             raise ValueError("number_of_shards must be >= 1")
         self.num_shards = n
         # distributed-mode wiring (None/None/None = local mode)
-        self.routing: Optional[Dict[int, str]] = (
-            {int(k): v for k, v in routing.items()} if routing else None
+        self.routing: Optional[Dict[int, dict]] = (
+            {int(k): norm_shard_routing(v) for k, v in routing.items()}
+            if routing
+            else None
         )
         self.local_node = local_node
         self.remote_call = remote_call
+        # primary-side replication tracking: shard → extra targets added
+        # during peer recovery, before they enter the in-sync set
+        # (ReplicationTracker.initiateTracking)
+        self._tracked: Dict[int, set] = {}
+        # round-robin cursor for in-sync copy selection on search
+        # (adaptive replica selection, radically simplified)
+        self._ars_cursor = 0
         self._local: Dict[int, ShardEngine] = {}
         for s in range(n):
             if not self._owns(s):
@@ -109,7 +139,8 @@ class IndexService:
                 os.path.join(base_path, str(s)) if base_path is not None else None
             )
             self._local[s] = ShardEngine(
-                self.mappings, self.analysis, path=shard_path, shard_id=s
+                self.mappings, self.analysis, path=shard_path, shard_id=s,
+                primary_term=self._primary_term(s),
             )
         # executor cache: shard id → (change_generation, executor)
         self._executors: Dict[int, tuple] = {}
@@ -127,16 +158,64 @@ class IndexService:
 
     # ---- routing ----
 
-    def _owns(self, sid: int) -> bool:
-        if self.routing is None:
-            return True
-        return self.routing.get(sid) == self.local_node
-
-    def _owner(self, sid: int) -> Optional[str]:
-        """Owning node id for a shard, or None in local mode."""
+    def _entry(self, sid: int) -> Optional[dict]:
         if self.routing is None:
             return None
         return self.routing.get(sid)
+
+    def _copies(self, sid: int) -> List[str]:
+        e = self._entry(sid)
+        if e is None:
+            return []
+        out = [e["primary"]] if e["primary"] is not None else []
+        out.extend(e["replicas"])
+        return out
+
+    def _owns(self, sid: int) -> bool:
+        """True if this node holds a copy (primary OR replica)."""
+        if self.routing is None:
+            return True
+        return self.local_node in self._copies(sid)
+
+    def _primary_term(self, sid: int) -> int:
+        e = self._entry(sid)
+        return 1 if e is None else e["primary_term"]
+
+    def _owner(self, sid: int) -> Optional[str]:
+        """PRIMARY node id for a shard (write routing), or None in
+        local mode."""
+        e = self._entry(sid)
+        return None if e is None else e["primary"]
+
+    def _search_node(self, sid: int) -> Optional[str]:
+        """Copy selection for reads: any in-sync copy, preferring the
+        local one (OperationRouting.searchShards + ARS, simplified to
+        local-first round-robin). None = execute locally."""
+        e = self._entry(sid)
+        if e is None:
+            return None
+        in_sync = [n for n in e["in_sync"] if n in self._copies(sid)]
+        if not in_sync:
+            return e["primary"]
+        if self.local_node in in_sync:
+            return self.local_node
+        self._ars_cursor += 1
+        return in_sync[self._ars_cursor % len(in_sync)]
+
+    def replica_targets(self, sid: int) -> List[str]:
+        """Write fan-out set on the primary: assigned in-sync copies plus
+        recovery-tracked targets, minus self (ReplicationOperation's
+        replication group)."""
+        e = self._entry(sid)
+        if e is None:
+            return []
+        targets = set(n for n in e["in_sync"] if n in self._copies(sid))
+        targets |= self._tracked.get(sid, set())
+        targets.discard(self.local_node)
+        return sorted(targets)
+
+    def add_tracked(self, sid: int, node: str) -> None:
+        self._tracked.setdefault(sid, set()).add(node)
 
     @property
     def shards(self) -> List[ShardEngine]:
@@ -148,27 +227,76 @@ class IndexService:
         """shard id → locally-held engine (IndicesService view)."""
         return dict(self._local)
 
-    def apply_routing(self, routing: Optional[Dict[int, str]]) -> None:
+    def apply_routing(
+        self, routing: Optional[Dict[int, Any]]
+    ) -> List[int]:
         """Reconciles local engines with a new routing table (the
         IndicesClusterStateService.applyClusterState shard create/remove
         path): engines are created for newly-owned shards and closed for
-        shards routed away."""
+        shards routed away. Returns shard ids newly assigned to this
+        node as replicas that are NOT yet in the in-sync set — these
+        need peer recovery from their primary."""
         if routing is not None:
-            self.routing = dict(routing)
+            self.routing = {
+                int(k): norm_shard_routing(v) for k, v in routing.items()
+            }
+        # copy-on-write: readers (search/refresh/stats threads) iterate
+        # self._local without the state lock, so it is never mutated in
+        # place — a fresh dict is swapped in atomically
+        local = dict(self._local)
+        needs_recovery: List[int] = []
         for sid in range(self.num_shards):
-            if self._owns(sid) and sid not in self._local:
+            if self._owns(sid) and sid not in local:
                 shard_path = (
                     os.path.join(self.base_path, str(sid))
                     if self.base_path is not None
                     else None
                 )
-                self._local[sid] = ShardEngine(
-                    self.mappings, self.analysis, path=shard_path, shard_id=sid
+                local[sid] = ShardEngine(
+                    self.mappings, self.analysis, path=shard_path, shard_id=sid,
+                    primary_term=self._primary_term(sid),
                 )
-            elif not self._owns(sid) and sid in self._local:
-                eng = self._local.pop(sid)
+                e = self._entry(sid)
+                if (
+                    e is not None
+                    and e["primary"] != self.local_node
+                    and self.local_node not in e["in_sync"]
+                ):
+                    needs_recovery.append(sid)
+            elif not self._owns(sid) and sid in local:
+                eng = local.pop(sid)
                 self._executors.pop(sid, None)
                 eng.close()
+            if self.routing is not None:
+                e = self._entry(sid)
+                if e is not None:
+                    # a promoted local primary adopts the bumped term
+                    eng = local.get(sid)
+                    if eng is not None and e["primary"] == self.local_node:
+                        eng.primary_term = max(eng.primary_term, e["primary_term"])
+                    # recovery-tracked targets that reached the in-sync
+                    # set (or were routed away) no longer need tracking
+                    tracked = self._tracked.get(sid)
+                    if tracked:
+                        tracked &= set(e["replicas"]) - set(e["in_sync"])
+        self._local = local
+        return needs_recovery
+
+    def recovery_needed(self) -> List[int]:
+        """Locally-assigned replica shards that are not yet in-sync —
+        the set the owning node must peer-recover from their primaries."""
+        out: List[int] = []
+        for sid in self._local:
+            e = self._entry(sid)
+            if (
+                e is not None
+                and e["primary"] not in (None, self.local_node)
+                and self.local_node in e["replicas"]
+                and self.local_node not in e["in_sync"]
+            ):
+                out.append(sid)
+        return out
+
 
     def local_shard(self, sid: int) -> ShardEngine:
         eng = self._local.get(sid)
@@ -189,9 +317,19 @@ class IndexService:
     def _shard_ops(self, sid: int, ops: List[dict]) -> List[dict]:
         """Applies a batch of ops to one shard, local or remote.
         Returns wire-shaped result dicts (TransportShardBulkAction)."""
+        if self.routing is None:
+            return apply_shard_ops(self.local_shard(sid), ops)
         owner = self._owner(sid)
         if owner is None:
-            return apply_shard_ops(self.local_shard(sid), ops)
+            # red shard: every copy died — refuse the write instead of
+            # acking it into a stale local replica (ES: 503 unavailable)
+            from .service import ClusterError
+
+            raise ClusterError(
+                503,
+                f"primary shard [{self.name}][{sid}] is not active",
+                "unavailable_shards_exception",
+            )
         # distributed mode always rides the handler seam — even for the
         # local owner (remote_call short-circuits) — because the handler
         # is where dynamic-mapping updates round-trip to the master
@@ -246,24 +384,30 @@ class IndexService:
         return self._one_op(sid, op)
 
     def get_doc(self, doc_id: str, routing: Optional[str] = None) -> Optional[dict]:
+        # realtime get routes to the PRIMARY (TransportGetAction with
+        # realtime=true reads through the primary's version map)
         sid = route_shard_id(
             routing if routing is not None else doc_id, self.num_shards
         )
-        if self._owns(sid):
+        owner = self._owner(sid)
+        if owner is None or owner == self.local_node:
             return self.local_shard(sid).get(doc_id)
         out = self.remote_call(
-            self._owner(sid),
+            owner,
             ACTION_SHARD_GET,
             {"index": self.name, "shard": sid, "id": doc_id},
         )
         return out["doc"] if out["found"] else None
 
     def _remote_owners(self) -> List[str]:
+        """Every node holding any copy of any shard, except this one."""
         if self.routing is None:
             return []
-        return sorted(
-            {o for o in self.routing.values() if o != self.local_node}
-        )
+        nodes: set = set()
+        for sid in self.routing:
+            nodes.update(self._copies(sid))
+        nodes.discard(self.local_node)
+        return sorted(nodes)
 
     def refresh(self) -> None:
         for s in self.shards:
@@ -556,7 +700,7 @@ class IndexService:
                         "ctx": pin["ctx"],
                     },
                 )
-            owner = self._owner(sid)
+            owner = self._search_node(sid)
             if owner is None or owner == self.local_node:
                 return self.shard_search_local(sid, body, pinned_executor=pin)
             return self.remote_call(
@@ -571,7 +715,7 @@ class IndexService:
         futs = [_FANOUT_POOL.submit(run, sid) for sid in range(n)]
         return [f.result() for f in futs]
 
-    def pin_executors(self) -> List:
+    def pin_executors(self, keep_alive: Optional[float] = None) -> List:
         """Point-in-time executor snapshot (ReaderContext acquire): scroll
         and PIT searches reuse these so concurrent refreshes don't change
         the view between pages. In distributed mode every shard gets a
@@ -581,10 +725,13 @@ class IndexService:
         if self.routing is None:
             return [self._executor(self._local[s]) for s in range(self.num_shards)]
         pins: List[dict] = []
+        payload: dict = {"index": self.name}
+        if keep_alive is not None:
+            payload["keep_alive"] = float(keep_alive)
         for sid in range(self.num_shards):
-            owner = self.routing[sid]
+            owner = self._search_node(sid) or self.local_node
             out = self.remote_call(
-                owner, ACTION_CTX_OPEN, {"index": self.name, "shard": sid}
+                owner, ACTION_CTX_OPEN, {**payload, "shard": sid}
             )
             pins.append({"node": owner, "ctx": out["ctx"]})
         return pins
@@ -874,7 +1021,7 @@ class IndexService:
             }
 
         def run(sid: int) -> dict:
-            owner = self._owner(sid)
+            owner = self._search_node(sid)
             if owner is None or owner == self.local_node:
                 return self.shard_count_local(sid, body)
             return self.remote_call(
@@ -902,8 +1049,18 @@ class IndexService:
     # ---- metadata ----
 
     @property
+    def primary_shards(self) -> List[ShardEngine]:
+        """Locally-held engines for shards whose PRIMARY is this node —
+        the copies that count once in doc/stat aggregates."""
+        return [
+            self._local[s]
+            for s in sorted(self._local)
+            if self._owner(s) in (None, self.local_node)
+        ]
+
+    @property
     def num_docs(self) -> int:
-        n = sum(s.num_docs for s in self.shards)
+        n = sum(s.num_docs for s in self.primary_shards)
         for owner in self._remote_owners():
             try:
                 out = self.remote_call(
@@ -914,8 +1071,49 @@ class IndexService:
                 pass
         return n
 
+    # ---- peer recovery, target side (RecoveryTarget) ----
+
+    def begin_peer_recovery(self, sid: int) -> Optional[str]:
+        """Discards the placeholder engine + any stale on-disk state so
+        phase-1 files can land in a clean shard directory. Copy-on-write
+        on _local (see apply_routing)."""
+        local = dict(self._local)
+        eng = local.pop(sid, None)
+        self._local = local
+        self._executors.pop(sid, None)
+        if eng is not None:
+            eng.close()
+        if self.base_path is None:
+            return None
+        shard_path = os.path.join(self.base_path, str(sid))
+        if os.path.isdir(shard_path):
+            import shutil
+
+            shutil.rmtree(shard_path, ignore_errors=True)
+        return shard_path
+
+    def finish_peer_recovery(self, sid: int) -> ShardEngine:
+        """Opens the recovered shard (replaying any copied translog
+        tail) and installs it."""
+        shard_path = (
+            os.path.join(self.base_path, str(sid))
+            if self.base_path is not None
+            else None
+        )
+        eng = ShardEngine(
+            self.mappings, self.analysis, path=shard_path, shard_id=sid,
+            primary_term=self._primary_term(sid),
+        )
+        local = dict(self._local)
+        local[sid] = eng
+        self._local = local
+        self._executors.pop(sid, None)
+        return eng
+
     def local_stats(self) -> dict:
-        """Stats over the shards held on THIS node (wire-shaped)."""
+        """Stats over the PRIMARY shards held on THIS node (wire-shaped;
+        replicas are excluded so cross-node aggregation counts each
+        document once)."""
         store_bytes = 0
         if self.base_path and os.path.isdir(self.base_path):
             for root, _, files in os.walk(self.base_path):
@@ -924,7 +1122,7 @@ class IndexService:
                         store_bytes += os.path.getsize(os.path.join(root, f))
                     except OSError:
                         pass
-        shards = self.shards
+        shards = self.primary_shards
         if shards:
             ops = {
                 k: sum(s.op_stats[k] for s in shards) for k in shards[0].op_stats

@@ -46,14 +46,17 @@ from .indices import (
     ACTION_SHARD_GET,
     ACTION_SHARD_OPS,
     ACTION_SHARD_REFRESH,
+    ACTION_SHARD_REPLICA_OPS,
     ACTION_SHARD_SEARCH,
     ACTION_SHARD_STATS,
     IndexService,
     apply_shard_ops,
+    norm_shard_routing,
     _flatten_settings,
 )
 from .service import ClusterError, ClusterService, IndexNotFoundError, _validate_index_name
 from ..transport.service import TransportError, TransportService
+from ..utils.murmur3 import shard_id as route_shard_id
 
 
 class NodeError(Exception):
@@ -151,9 +154,11 @@ class DistributedClusterService(ClusterService):
     def apply_state(self, state: dict) -> None:
         """Reconciles local services with a freshly-applied cluster
         state: creates/updates/removes IndexService instances, replaces
-        alias and template metadata."""
+        alias and template metadata, and kicks off peer recoveries for
+        newly-assigned out-of-sync replica copies."""
         self.aliases = state.get("aliases", {})
         self.templates = state.get("templates", {})
+        recoveries: Dict[str, List[int]] = {}
         for name, meta in state.get("indices", {}).items():
             idx = self.indices.get(name)
             routing = {int(k): v for k, v in meta.get("routing", {}).items()}
@@ -182,6 +187,9 @@ class DistributedClusterService(ClusterService):
                 }
                 idx.settings.update(flat)
                 idx.apply_routing(routing)
+            needs = idx.recovery_needed()
+            if needs:
+                recoveries[name] = needs
         for name in list(self.indices):
             if name not in state.get("indices", {}):
                 idx = self.indices.pop(name)
@@ -192,13 +200,63 @@ class DistributedClusterService(ClusterService):
 
                     shutil.rmtree(path, ignore_errors=True)
         self.version = state.get("version", self.version)
+        for name, sids in recoveries.items():
+            self.node.schedule_recoveries(name, sids)
 
     def health(self) -> dict:
-        base = super().health()
-        n_nodes = len(self.node.state.get("nodes", {}))
-        base["number_of_nodes"] = n_nodes
-        base["number_of_data_nodes"] = n_nodes
-        return base
+        """Shard-level red/yellow/green from the routing table
+        (TransportClusterHealthAction): red = a shard with no live
+        primary, yellow = desired replicas missing or out of sync."""
+        state = self.node.state
+        n_nodes = len(state.get("nodes", {}))
+        active_primaries = 0
+        active_shards = 0
+        unassigned = 0
+        initializing = 0
+        status = "green"
+        for meta in state.get("indices", {}).values():
+            desired = int(
+                (meta.get("settings") or {}).get("number_of_replicas", 1)
+            )
+            for raw in meta.get("routing", {}).values():
+                entry = norm_shard_routing(raw)
+                if entry["primary"] is None:
+                    unassigned += 1 + desired
+                    status = "red"
+                    continue
+                active_primaries += 1
+                active_shards += 1
+                in_sync_replicas = [
+                    n for n in entry["replicas"] if n in entry["in_sync"]
+                ]
+                active_shards += len(in_sync_replicas)
+                recovering = len(entry["replicas"]) - len(in_sync_replicas)
+                initializing += recovering
+                missing = desired - len(in_sync_replicas)
+                if missing > 0:
+                    unassigned += max(0, missing - recovering)
+                    if status != "red":
+                        status = "yellow"
+        total = active_shards + unassigned + initializing
+        return {
+            "cluster_name": self.cluster_name,
+            "status": status,
+            "timed_out": False,
+            "number_of_nodes": n_nodes,
+            "number_of_data_nodes": n_nodes,
+            "active_primary_shards": active_primaries,
+            "active_shards": active_shards,
+            "relocating_shards": 0,
+            "initializing_shards": initializing,
+            "unassigned_shards": unassigned,
+            "delayed_unassigned_shards": 0,
+            "number_of_pending_tasks": 0,
+            "number_of_in_flight_fetch": 0,
+            "task_max_waiting_in_queue_millis": 0,
+            "active_shards_percent_as_number": (
+                100.0 if total == 0 else round(100.0 * active_shards / total, 1)
+            ),
+        }
 
 
 class TpuNode:
@@ -216,11 +274,19 @@ class TpuNode:
         data_path: Optional[str] = None,
         cluster_name: str = "elasticsearch-tpu",
         port: int = 0,
+        fd_interval: float = 1.0,
+        fd_retries: int = 3,
     ):
         self.name = name
         self.seeds = [tuple(s) for s in (seeds or [])]
         self.data_path = data_path
         self.cluster_name = cluster_name
+        # failure detection (FollowersChecker/LeaderChecker cadence)
+        self.fd_interval = fd_interval
+        self.fd_retries = fd_retries
+        self._fd_stop = threading.Event()
+        self._fd_thread: Optional[threading.Thread] = None
+        self._fd_failures: Dict[str, int] = {}
         self.transport = TransportService(name, cluster_name, port=port)
         self.state: dict = {
             "version": 0,
@@ -236,6 +302,10 @@ class TpuNode:
         # (SearchService.createAndPutReaderContext registry)
         self._ctxs: Dict[str, dict] = {}
         self._ctx_lock = threading.Lock()
+        # in-flight peer recoveries, keyed (index, shard) — repeated
+        # state applications must not start duplicate recoveries
+        self._recovering: set = set()
+        self._recovery_lock = threading.Lock()
         self._closed = False
         self._register_handlers()
 
@@ -280,12 +350,19 @@ class TpuNode:
                 {"node": self.name, "address": list(self.transport.address)},
             )
             self._apply_state(state)
+        self._fd_thread = threading.Thread(
+            target=self._fd_loop, name=f"fd-{self.name}", daemon=True
+        )
+        self._fd_thread.start()
         return self
 
     def close(self):
         if self._closed:
             return
         self._closed = True
+        self._fd_stop.set()
+        if self._fd_thread is not None:
+            self._fd_thread.join(timeout=5.0)
         self.cluster.close()
         self.transport.close()
 
@@ -362,6 +439,14 @@ class TpuNode:
         t.register_handler(ACTION_SHARD_COUNT, self._handle_count_shard)
         t.register_handler(ACTION_CTX_OPEN, self._handle_ctx_open)
         t.register_handler(ACTION_CTX_CLOSE, self._handle_ctx_close)
+        t.register_handler(ACTION_SHARD_REPLICA_OPS, self._handle_replica_ops)
+        t.register_handler("internal:fd/ping", self._handle_fd_ping)
+        t.register_handler("internal:recovery/start", self._handle_recovery_start)
+        t.register_handler(
+            "internal:recovery/finalize", self._handle_recovery_finalize
+        )
+        t.register_handler("cluster:shard/failed", self._handle_shard_failed)
+        t.register_handler("cluster:shard/started", self._handle_shard_started)
 
     # ---- membership + publication ----
 
@@ -370,6 +455,9 @@ class TpuNode:
             self._require_master()
             new = _copy_state(self.state)
             new["nodes"][p["node"]] = {"address": p["address"]}
+            # a (re)joining node is a fresh allocation target for any
+            # under-replicated shard (AllocationService.reroute on join)
+            _fill_replicas(new)
             new["version"] += 1
             self._publish(new)
             return self.state
@@ -380,17 +468,25 @@ class TpuNode:
 
     def _publish(self, new_state: dict):
         """Master applies locally then pushes to every other node
-        (PublicationTransportHandler; single-phase)."""
+        (PublicationTransportHandler; single-phase). A node that misses
+        a publish is NOT forgotten: the per-node retry here plus the
+        failure-detector's version re-sync (`_check_followers` resends
+        the current state whenever a ping reports a stale version) keep
+        every reachable node converged (LagDetector analog)."""
         self._apply_state(new_state)
         for nid, info in new_state["nodes"].items():
             if nid == self.name:
                 continue
-            try:
-                self.transport.send(
-                    tuple(info["address"]), "cluster:state/publish", new_state
-                )
-            except TransportError:
-                pass  # publish-failure repair arrives with failure detection
+            for attempt in (0, 1):
+                try:
+                    self.transport.send(
+                        tuple(info["address"]), "cluster:state/publish", new_state
+                    )
+                    break
+                except TransportError:
+                    if attempt == 1:
+                        # lag repair happens in the fd loop
+                        pass
 
     def _apply_state(self, state: dict):
         """ClusterApplierService.onNewClusterState: monotonic by version;
@@ -436,6 +532,332 @@ class TpuNode:
             return None
 
     # ------------------------------------------------------------------
+    # failure detection + elastic recovery (FollowersChecker /
+    # LeaderChecker / NodeLeftExecutor, SURVEY §5)
+    # ------------------------------------------------------------------
+
+    def _handle_fd_ping(self, p: dict) -> dict:
+        return {"node": self.name, "version": self.state.get("version", 0)}
+
+    def _fd_loop(self):
+        while not self._fd_stop.wait(self.fd_interval):
+            if self._closed:
+                return
+            try:
+                if self.is_master():
+                    self._check_followers()
+                else:
+                    self._check_master()
+            except Exception:
+                pass  # the checker must survive anything a tick throws
+
+    def _check_followers(self):
+        """Master pings every follower; a stale version gets the current
+        state re-sent (lag repair); `fd_retries` consecutive failures
+        remove the node from the cluster."""
+        with self._state_lock:
+            nodes = {
+                nid: tuple(info["address"])
+                for nid, info in self.state["nodes"].items()
+                if nid != self.name
+            }
+            version = self.state.get("version", 0)
+        for nid, addr in nodes.items():
+            try:
+                resp = self.transport.send(
+                    addr, "internal:fd/ping", {}, timeout=self.fd_interval * 5
+                )
+                self._fd_failures[nid] = 0
+                if resp.get("version", 0) < version:
+                    with self._state_lock:
+                        state = self.state
+                    self.transport.send(addr, "cluster:state/publish", state)
+            except TransportError:
+                n = self._fd_failures.get(nid, 0) + 1
+                self._fd_failures[nid] = n
+                if n >= self.fd_retries:
+                    self._fd_failures.pop(nid, None)
+                    self._node_left(nid)
+
+    def _check_master(self):
+        """Follower pings the master; on sustained failure the lowest
+        surviving node id takes over (deterministic re-election)."""
+        with self._state_lock:
+            master = self.state.get("master")
+            info = self.state["nodes"].get(master)
+        if master is None or master == self.name or info is None:
+            return
+        try:
+            self.transport.send(
+                tuple(info["address"]),
+                "internal:fd/ping",
+                {},
+                timeout=self.fd_interval * 5,
+            )
+            self._fd_failures[master] = 0
+        except TransportError:
+            n = self._fd_failures.get(master, 0) + 1
+            self._fd_failures[master] = n
+            if n >= self.fd_retries:
+                self._fd_failures.pop(master, None)
+                self._elect_after_master_loss(master)
+
+    def _elect_after_master_loss(self, dead_master: str):
+        with self._state_lock:
+            if self.state.get("master") != dead_master:
+                return  # someone already took over
+            survivors = [n for n in self.state["nodes"] if n != dead_master]
+            if not survivors or min(survivors) != self.name:
+                return  # not our job; wait for the new master's publish
+            new = _copy_state(self.state)
+            new["master"] = self.name
+            _remove_node_from_state(new, dead_master)
+            _fill_replicas(new)
+            new["version"] += 1
+            self._publish(new)
+
+    def _node_left(self, nid: str):
+        """Master removes a dead node: promote in-sync replicas for its
+        primaries, drop its copies, re-allocate missing replicas (which
+        peer-recover from the new primaries)."""
+        with self._state_lock:
+            if not self.is_master() or nid not in self.state["nodes"]:
+                return
+            new = _copy_state(self.state)
+            _remove_node_from_state(new, nid)
+            _fill_replicas(new)
+            new["version"] += 1
+            self._publish(new)
+
+    # ---- replication lifecycle (master side) ----
+
+    def _handle_shard_failed(self, p: dict) -> dict:
+        """A primary reports a replica that failed to ack a write (or a
+        node reports a broken copy): drop it from the in-sync set so
+        reads never see stale data (ReplicationOperation →
+        ShardStateAction.shardFailed)."""
+        with self._state_lock:
+            self._require_master()
+            name, sid, node = p["index"], str(p["shard"]), p["node"]
+            meta = self.state["indices"].get(name)
+            if meta is None:
+                return {"acknowledged": True}
+            new = _copy_state(self.state)
+            entry = norm_shard_routing(new["indices"][name]["routing"][sid])
+            changed = False
+            if node in entry["in_sync"]:
+                entry["in_sync"].remove(node)
+                changed = True
+            if node in entry["replicas"]:
+                entry["replicas"].remove(node)
+                changed = True
+            if entry["primary"] == node:
+                entry["primary"] = None
+                promote = [n for n in entry["in_sync"] if n in entry["replicas"]]
+                if promote:
+                    entry["primary"] = promote[0]
+                    entry["replicas"].remove(promote[0])
+                    entry["primary_term"] += 1
+                changed = True
+            if not changed:
+                return {"acknowledged": True}
+            new["indices"][name]["routing"][sid] = entry
+            _fill_replicas(new)
+            new["version"] += 1
+            self._publish(new)
+            return {"acknowledged": True}
+
+    def _handle_shard_started(self, p: dict) -> dict:
+        """A peer-recovered replica reports readiness: join the in-sync
+        set (ShardStateAction.shardStarted)."""
+        with self._state_lock:
+            self._require_master()
+            name, sid, node = p["index"], str(p["shard"]), p["node"]
+            meta = self.state["indices"].get(name)
+            if meta is None:
+                raise IndexNotFoundError(name)
+            new = _copy_state(self.state)
+            entry = norm_shard_routing(new["indices"][name]["routing"][sid])
+            if node not in entry["replicas"] and entry["primary"] != node:
+                entry["replicas"].append(node)
+            if node not in entry["in_sync"]:
+                entry["in_sync"].append(node)
+            new["indices"][name]["routing"][sid] = entry
+            new["version"] += 1
+            self._publish(new)
+            return {"acknowledged": True}
+
+    def _report_shard_failed(self, index: str, sid: int, node: str):
+        try:
+            self.master_request(
+                "cluster:shard/failed",
+                {"index": index, "shard": sid, "node": node},
+            )
+        except (TransportError, NodeError, ClusterError):
+            pass  # fd loop will catch a dead master; retried on next write
+
+    # ---- peer recovery (RecoverySourceHandler on the primary,
+    # RecoveryTarget driven by schedule_recoveries on the target) ----
+
+    def _handle_recovery_start(self, p: dict) -> dict:
+        """Phase 1: the primary flushes and streams its shard files
+        (RecoverySourceHandler.phase1). Diskless primaries skip phase 1
+        entirely — phase 2's seqno-gated replay carries everything."""
+        idx = self._index_service(p["index"])
+        sid = int(p["shard"])
+        eng = idx._local.get(sid)
+        if eng is None or idx._owner(sid) != self.name:
+            raise NodeError(
+                f"[{self.name}] is not the primary for [{p['index']}][{sid}]"
+            )
+        if eng.path is None:
+            return {"mode": "ops"}
+        import base64
+
+        with eng._lock:
+            eng.flush()
+            files: Dict[str, str] = {}
+            for root, _, fnames in os.walk(eng.path):
+                for fn in fnames:
+                    full = os.path.join(root, fn)
+                    rel = os.path.relpath(full, eng.path)
+                    try:
+                        with open(full, "rb") as f:
+                            files[rel] = base64.b64encode(f.read()).decode("ascii")
+                    except OSError:
+                        pass
+            return {"mode": "files", "files": files, "max_seq_no": eng.max_seq_no}
+
+    def _handle_recovery_finalize(self, p: dict) -> dict:
+        """Phase 2: under the primary's engine lock, start tracking the
+        target for write fan-out and hand back every op newer than the
+        target's local checkpoint (version-map diff — the ops-replay of
+        RecoverySourceHandler.phase2). At-least-once delivery composes
+        with the replica's seqno dedup."""
+        idx = self._index_service(p["index"])
+        sid = int(p["shard"])
+        eng = idx._local.get(sid)
+        if eng is None or idx._owner(sid) != self.name:
+            raise NodeError(
+                f"[{self.name}] is not the primary for [{p['index']}][{sid}]"
+            )
+        local_seq = int(p["local_seq"])
+        with eng._lock:
+            idx.add_tracked(sid, p["target"])
+            ops: List[dict] = []
+            for doc_id, ve in eng._versions.items():
+                if ve.seq_no <= local_seq:
+                    continue
+                if ve.deleted:
+                    ops.append(
+                        {"op": "delete", "id": doc_id, "version": ve.version,
+                         "seq_no": ve.seq_no}
+                    )
+                else:
+                    doc = eng.get(doc_id)
+                    if doc is None:
+                        continue
+                    ops.append(
+                        {"op": "index", "id": doc_id, "source": doc["_source"],
+                         "version": ve.version, "seq_no": ve.seq_no}
+                    )
+            ops.sort(key=lambda o: o["seq_no"])
+        return {"ops": ops}
+
+    def schedule_recoveries(self, index_name: str, sids: List[int]):
+        """Runs peer recoveries in the background — apply_state must not
+        block (the master is waiting on the publish ack, and shard
+        started/failed reports need the master's state lock)."""
+        if not sids or self._closed:
+            return
+        with self._recovery_lock:
+            todo = [
+                sid for sid in sids if (index_name, sid) not in self._recovering
+            ]
+            self._recovering.update((index_name, sid) for sid in todo)
+        if not todo:
+            return
+        threading.Thread(
+            target=self._run_recoveries,
+            args=(index_name, todo),
+            name=f"recovery-{self.name}-{index_name}",
+            daemon=True,
+        ).start()
+
+    def _run_recoveries(self, index_name: str, sids: List[int]):
+        for sid in sids:
+            try:
+                self._recover_shard(index_name, sid)
+            except Exception:
+                # a failed recovery leaves the copy out of the in-sync
+                # set; the next routing change re-triggers it
+                pass
+            finally:
+                with self._recovery_lock:
+                    self._recovering.discard((index_name, sid))
+
+    def _recover_shard(self, index_name: str, sid: int):
+        idx = self.cluster.indices.get(index_name)
+        if idx is None:
+            return
+        entry = idx._entry(sid)
+        if (
+            entry is None
+            or entry["primary"] in (None, self.name)
+            or self.name in entry["in_sync"]
+        ):
+            return
+        primary = entry["primary"]
+        out = self.remote_call(
+            primary,
+            "internal:recovery/start",
+            {"index": index_name, "shard": sid, "target": self.name},
+        )
+        shard_path = idx.begin_peer_recovery(sid)
+        if out.get("mode") == "files" and shard_path is not None:
+            import base64
+
+            for rel, b64 in out["files"].items():
+                full = os.path.join(shard_path, rel)
+                os.makedirs(os.path.dirname(full), exist_ok=True)
+                with open(full, "wb") as f:
+                    f.write(base64.b64decode(b64))
+        eng = idx.finish_peer_recovery(sid)
+        fin = self.remote_call(
+            primary,
+            "internal:recovery/finalize",
+            {
+                "index": index_name,
+                "shard": sid,
+                "target": self.name,
+                "local_seq": eng.max_seq_no,
+            },
+        )
+        for op in fin["ops"]:
+            if op["op"] == "index":
+                eng.index_replica(
+                    op["id"], op["source"], op["version"], op["seq_no"]
+                )
+            else:
+                eng.delete_replica(op["id"], op["version"], op["seq_no"])
+        eng.refresh()
+        # the started report must land — a swallowed failure would strand
+        # a fully-recovered copy out of the in-sync set forever (the fd
+        # loop's lag repair resends the same version, which the monotonic
+        # apply skips). Retry across master elections.
+        for attempt in range(10):
+            try:
+                self.master_request(
+                    "cluster:shard/started",
+                    {"index": index_name, "shard": sid, "node": self.name},
+                )
+                return
+            except (TransportError, NodeError, NotMasterError, ClusterError):
+                if self._closed:
+                    return
+                time.sleep(0.5)
+
+    # ------------------------------------------------------------------
     # master-side metadata mutations
     # ------------------------------------------------------------------
 
@@ -478,10 +900,28 @@ class TpuNode:
             except (MappingParseError, ValueError) as e:
                 raise ClusterError(400, str(e), "mapper_parsing_exception")
             num_shards = int(validated.get("number_of_shards", 1))
+            num_replicas = int(validated.get("number_of_replicas", 1))
             nodes = sorted(self.state["nodes"])
-            # round-robin allocation over the sorted node set
-            # (BalancedShardsAllocator, radically simplified)
-            routing = {str(s): nodes[s % len(nodes)] for s in range(num_shards)}
+            # primaries round-robin over the sorted node set; replicas on
+            # the following distinct nodes (BalancedShardsAllocator,
+            # radically simplified). At creation every copy is empty, so
+            # replicas are born in-sync.
+            routing: Dict[str, dict] = {}
+            for s in range(num_shards):
+                primary = nodes[s % len(nodes)]
+                reps: List[str] = []
+                for r in range(1, len(nodes)):
+                    if len(reps) >= num_replicas:
+                        break
+                    cand = nodes[(s + r) % len(nodes)]
+                    if cand != primary and cand not in reps:
+                        reps.append(cand)
+                routing[str(s)] = {
+                    "primary": primary,
+                    "replicas": reps,
+                    "in_sync": [primary] + reps,
+                    "primary_term": 1,
+                }
             meta_settings: Dict[str, Any] = dict(validated)
             meta_settings["number_of_shards"] = num_shards
             if analysis_cfg:
@@ -510,7 +950,9 @@ class TpuNode:
                 "acknowledged": True,
                 "shards_acknowledged": True,
                 "index": name,
-                "routing": routing,
+                # sid → primary (the pre-replication response shape)
+                "routing": {s: e["primary"] for s, e in routing.items()},
+                "replicas": {s: e["replicas"] for s, e in routing.items()},
             }
 
     def _handle_delete_index(self, p: dict) -> dict:
@@ -717,6 +1159,36 @@ class TpuNode:
                 f"shard [{p['index']}][{sid}] not allocated to [{self.name}]"
             )
         results = apply_shard_ops(eng, p["ops"])
+        # ---- replication fan-out (ReplicationOperation.execute): the
+        # primary forwards seqno-stamped ops to every in-sync/tracked
+        # copy and only acks once they respond; a copy that fails is
+        # reported to the master and leaves the in-sync set ----
+        rops: List[dict] = []
+        for op, r in zip(p["ops"], results):
+            if not r.get("ok"):
+                continue
+            if op["op"] == "index":
+                rops.append(
+                    {"op": "index", "id": r["_id"], "source": op["source"],
+                     "version": r["_version"], "seq_no": r["_seq_no"]}
+                )
+            elif r.get("result") == "deleted":
+                rops.append(
+                    {"op": "delete", "id": r["_id"],
+                     "version": r["_version"], "seq_no": r["_seq_no"]}
+                )
+        if rops:
+            for target in idx.replica_targets(sid):
+                try:
+                    self.remote_call(
+                        target,
+                        ACTION_SHARD_REPLICA_OPS,
+                        {"index": p["index"], "shard": sid, "ops": rops},
+                    )
+                except (TransportError, NodeError, ClusterError):
+                    # ClusterError covers re-hydrated remote failures
+                    # (e.g. the replica missed the index-creation publish)
+                    self._report_shard_failed(p["index"], sid, target)
         # dynamic mapping changes must reach the master (and thus every
         # coordinator + the persisted state) before they are lost to a
         # restart — compare against the published metadata and round-trip
@@ -733,6 +1205,25 @@ class TpuNode:
             except TransportError:
                 pass  # retried on the next write (published stays stale)
         return {"results": results}
+
+    def _handle_replica_ops(self, p: dict) -> dict:
+        """Replica side of the write fan-out: apply with the primary's
+        version+seqno, no CAS (IndexShard.applyIndexOperationOnReplica)."""
+        idx = self._index_service(p["index"])
+        sid = int(p["shard"])
+        eng = idx._local.get(sid)
+        if eng is None:
+            raise NodeError(
+                f"replica shard [{p['index']}][{sid}] not on [{self.name}]"
+            )
+        for op in p["ops"]:
+            if op["op"] == "index":
+                eng.index_replica(
+                    op["id"], op["source"], op["version"], op["seq_no"]
+                )
+            else:
+                eng.delete_replica(op["id"], op["version"], op["seq_no"])
+        return {"acks": len(p["ops"]), "local_checkpoint": eng.max_seq_no}
 
     def _handle_get(self, p: dict) -> dict:
         idx = self._index_service(p["index"])
@@ -808,8 +1299,6 @@ class TpuNode:
         self, index: str, doc_id: str, source: dict, op_type: str = "index"
     ) -> dict:
         idx = self._index_service(index)
-        from ..utils.murmur3 import shard_id as route_shard_id
-
         sid = route_shard_id(doc_id, idx.num_shards)
         out = idx._shard_ops(
             sid, [{"op": "index", "id": doc_id, "source": source, "op_type": op_type}]
@@ -818,8 +1307,6 @@ class TpuNode:
 
     def delete_doc(self, index: str, doc_id: str) -> dict:
         idx = self._index_service(index)
-        from ..utils.murmur3 import shard_id as route_shard_id
-
         sid = route_shard_id(doc_id, idx.num_shards)
         return idx._shard_ops(sid, [{"op": "delete", "id": doc_id}])[0]
 
@@ -827,8 +1314,6 @@ class TpuNode:
         """ops: [{"op": "index"|"delete", "id": ..., "source": ...}];
         grouped by owning shard, one transport hop per shard."""
         idx = self._index_service(index)
-        from ..utils.murmur3 import shard_id as route_shard_id
-
         by_shard: Dict[int, List[Tuple[int, dict]]] = {}
         for i, op in enumerate(ops):
             sid = route_shard_id(op["id"], idx.num_shards)
@@ -858,6 +1343,69 @@ class TpuNode:
 
 def _copy_state(state: dict) -> dict:
     return json.loads(json.dumps(state))
+
+
+def _remove_node_from_state(state: dict, nid: str) -> None:
+    """Drops a node and promotes in-sync replicas for every primary it
+    held (NodeLeftExecutor + AllocationService failover). A shard whose
+    only copies lived on the dead node keeps primary=None — red, exactly
+    the reference's data-loss surface."""
+    state["nodes"].pop(nid, None)
+    for meta in state.get("indices", {}).values():
+        routing = meta.get("routing", {})
+        for sid, raw in routing.items():
+            entry = norm_shard_routing(raw)
+            if nid in entry["replicas"]:
+                entry["replicas"].remove(nid)
+            if nid in entry["in_sync"]:
+                entry["in_sync"].remove(nid)
+            if entry["primary"] == nid:
+                promote = [n for n in entry["in_sync"] if n in entry["replicas"]]
+                if promote:
+                    entry["primary"] = promote[0]
+                    entry["replicas"].remove(promote[0])
+                    entry["primary_term"] += 1
+                else:
+                    entry["primary"] = None
+            routing[sid] = entry
+
+
+def _fill_replicas(state: dict) -> None:
+    """Allocates missing replica copies onto nodes that hold no copy of
+    the shard (BalancedShardsAllocator, radically simplified: spread by
+    current copy count). Newly-assigned replicas are NOT in-sync — the
+    target node peer-recovers and then reports shard-started."""
+    nodes = sorted(state.get("nodes", {}))
+    if not nodes:
+        return
+    # total copies per node, for least-loaded placement
+    load = {n: 0 for n in nodes}
+    for meta in state.get("indices", {}).values():
+        for raw in meta.get("routing", {}).values():
+            entry = norm_shard_routing(raw)
+            for n in ([entry["primary"]] if entry["primary"] else []) + entry["replicas"]:
+                if n in load:
+                    load[n] += 1
+    for meta in state.get("indices", {}).values():
+        desired = int(
+            (meta.get("settings") or {}).get("number_of_replicas", 1)
+        )
+        routing = meta.get("routing", {})
+        for sid, raw in routing.items():
+            entry = norm_shard_routing(raw)
+            holders = set(
+                ([entry["primary"]] if entry["primary"] else [])
+                + entry["replicas"]
+            )
+            while len(entry["replicas"]) < desired:
+                candidates = [n for n in nodes if n not in holders]
+                if not candidates:
+                    break
+                pick = min(candidates, key=lambda n: (load[n], n))
+                entry["replicas"].append(pick)
+                holders.add(pick)
+                load[pick] += 1
+            routing[sid] = entry
 
 
 def _template_for(templates: Dict[str, dict], index_name: str) -> Optional[dict]:

@@ -594,7 +594,7 @@ class ClusterService:
         body = dict(body or {})
         size = int(body.get("size", 10))
         body.pop("from", None)
-        pinned = idx.pin_executors()
+        pinned = idx.pin_executors(keep_alive=_parse_keep_alive(keep_alive))
         resp = idx.search({**body, "from": 0, "size": size}, pinned_executors=pinned)
         scroll_id = _uuid.uuid4().hex
         with self._lock:
@@ -651,7 +651,7 @@ class ClusterService:
         with self._lock:
             self._pits[pit_id] = {
                 "index": index,
-                "pinned": idx.pin_executors(),
+                "pinned": idx.pin_executors(keep_alive=_parse_keep_alive(keep_alive)),
                 "expires": time.time() + _parse_keep_alive(keep_alive),
             }
         return {"id": pit_id}

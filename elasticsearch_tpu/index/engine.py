@@ -223,6 +223,57 @@ class ShardEngine:
             return OpResult(doc_id, "deleted", version, seq_no, self.primary_term)
 
     # ------------------------------------------------------------------
+    # replica apply (InternalEngine.index on a replica: no CAS — the
+    # primary already assigned version+seqno; replicas dedup by seqno,
+    # the LiveVersionMap "op came out of order" check)
+    # ------------------------------------------------------------------
+
+    def index_replica(
+        self, doc_id: str, source: dict, version: int, seq_no: int
+    ) -> OpResult:
+        with self._lock:
+            cur = self._versions.get(doc_id)
+            self._next_seq = max(self._next_seq, seq_no + 1)
+            if cur is not None and cur.seq_no >= seq_no:
+                return OpResult(doc_id, "noop", cur.version, cur.seq_no,
+                                self.primary_term)
+            parsed = self.parser.parse(doc_id, source)
+            self._versions[doc_id] = _VersionEntry(version, seq_no, False)
+            self._buffer[doc_id] = _BufferedDoc(source, version, seq_no, parsed)
+            self._buffered_deletes.pop(doc_id, None)
+            if self.translog is not None:
+                self.translog.add(
+                    {
+                        "op": "index",
+                        "id": doc_id,
+                        "source": source,
+                        "seq_no": seq_no,
+                        "version": version,
+                    }
+                )
+            self.op_stats["index_total"] += 1
+            return OpResult(doc_id, "created", version, seq_no, self.primary_term)
+
+    def delete_replica(self, doc_id: str, version: int, seq_no: int) -> OpResult:
+        with self._lock:
+            cur = self._versions.get(doc_id)
+            self._next_seq = max(self._next_seq, seq_no + 1)
+            if cur is not None and cur.seq_no >= seq_no:
+                return OpResult(doc_id, "noop", cur.version, cur.seq_no,
+                                self.primary_term)
+            entry = _VersionEntry(version, seq_no, True)
+            self._versions[doc_id] = entry
+            self._buffer.pop(doc_id, None)
+            self._buffered_deletes[doc_id] = entry
+            if self.translog is not None:
+                self.translog.add(
+                    {"op": "delete", "id": doc_id, "seq_no": seq_no,
+                     "version": version}
+                )
+            self.op_stats["delete_total"] += 1
+            return OpResult(doc_id, "deleted", version, seq_no, self.primary_term)
+
+    # ------------------------------------------------------------------
     # read path (Engine.get — realtime)
     # ------------------------------------------------------------------
 

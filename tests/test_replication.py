@@ -1,0 +1,234 @@
+"""Replication + failure detection (InternalTestCluster-style: real
+nodes over localhost TCP, killed and restarted mid-test).
+
+Reference analogs (SURVEY.md §2.6, §5): ReplicationOperation write
+fan-out with the in-sync allocation set, ShardStateAction
+shardFailed/shardStarted, FollowersChecker/LeaderChecker failure
+detection with node-left promotion, and peer recovery
+(RecoverySourceHandler.phase1 file copy + phase2 seqno-gated replay).
+"""
+
+import time
+
+import pytest
+
+from elasticsearch_tpu.cluster.node import TpuNode
+
+FD = {"fd_interval": 0.1, "fd_retries": 2}
+
+
+def wait_until(cond, timeout=15.0, interval=0.05, msg="condition"):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if cond():
+            return
+        time.sleep(interval)
+    raise AssertionError(f"timed out waiting for {msg}")
+
+
+def make_cluster(n, tmp_path=None, **kw):
+    kw = {**FD, **kw}
+    nodes = [
+        TpuNode(
+            "node-0",
+            data_path=str(tmp_path / "node-0") if tmp_path else None,
+            **kw,
+        ).start()
+    ]
+    for i in range(1, n):
+        nodes.append(
+            TpuNode(
+                f"node-{i}",
+                seeds=[nodes[0].address],
+                data_path=str(tmp_path / f"node-{i}") if tmp_path else None,
+                **kw,
+            ).start()
+        )
+    return nodes
+
+
+@pytest.fixture
+def cluster2():
+    nodes = make_cluster(2)
+    yield nodes
+    for n in nodes:
+        n.close()
+
+
+class TestReplicaWrites:
+    def test_replicas_allocated_on_distinct_nodes(self, cluster2):
+        a, b = cluster2
+        r = a.create_index("rep", {"settings": {"number_of_shards": 2,
+                                                "number_of_replicas": 1}})
+        for sid, raw in a.state["indices"]["rep"]["routing"].items():
+            assert raw["primary"] != raw["replicas"][0]
+            assert set(raw["in_sync"]) == {raw["primary"], raw["replicas"][0]}
+        # each node holds every shard (one copy each)
+        assert set(a.indices["rep"].local_shards) == {0, 1}
+        assert set(b.indices["rep"].local_shards) == {0, 1}
+
+    def test_writes_fan_out_to_replicas(self, cluster2):
+        a, b = cluster2
+        a.create_index("fan", {"settings": {"number_of_shards": 2,
+                                            "number_of_replicas": 1}})
+        for i in range(20):
+            a.index_doc("fan", f"d{i}", {"n": i})
+        a.refresh("fan")
+        # every copy on every node has the docs of its shard
+        for node in (a, b):
+            idx = node.indices["fan"]
+            got = sum(e.num_docs for e in idx.local_shards.values())
+            assert got == 20, f"{node.name} holds {got} docs across copies"
+        # replica copies carry the primary-assigned seqnos
+        for sid in (0, 1):
+            pa = a.indices["fan"].local_shards[sid]
+            pb = b.indices["fan"].local_shards[sid]
+            assert pa.max_seq_no == pb.max_seq_no
+
+    def test_delete_and_update_replicate(self, cluster2):
+        a, b = cluster2
+        a.create_index("mut", {"settings": {"number_of_shards": 1,
+                                            "number_of_replicas": 1}})
+        a.index_doc("mut", "x", {"v": 1})
+        a.index_doc("mut", "x", {"v": 2})
+        a.index_doc("mut", "y", {"v": 1})
+        a.delete_doc("mut", "y")
+        a.refresh("mut")
+        for node in (a, b):
+            eng = node.indices["mut"].local_shards[0]
+            assert eng.num_docs == 1
+            assert eng.get("x")["_source"]["v"] == 2
+            assert eng.get("y") is None
+
+    def test_health_green_with_replicas(self, cluster2):
+        a, _ = cluster2
+        a.create_index("h", {"settings": {"number_of_shards": 2,
+                                          "number_of_replicas": 1}})
+        h = a.cluster.health()
+        assert h["status"] == "green"
+        assert h["active_shards"] == 4
+
+    def test_health_yellow_when_replica_unallocatable(self):
+        a = TpuNode("node-0", **FD).start()
+        try:
+            a.create_index("solo", {"settings": {"number_of_shards": 1,
+                                                 "number_of_replicas": 1}})
+            assert a.cluster.health()["status"] == "yellow"
+        finally:
+            a.close()
+
+
+class TestFailover:
+    def test_node_death_promotes_replicas_no_data_loss(self, cluster2):
+        a, b = cluster2
+        a.create_index("fo", {"settings": {"number_of_shards": 4,
+                                           "number_of_replicas": 1}})
+        docs = {f"d{i}": f"payload number {i}" for i in range(30)}
+        a.bulk("fo", [{"op": "index", "id": k, "source": {"body": v}}
+                      for k, v in docs.items()])
+        a.refresh("fo")
+        b.close()  # kill the non-master
+        wait_until(lambda: set(a.state["nodes"]) == {"node-0"},
+                   msg="master to notice node-1 died")
+        # every shard promoted to a live primary, nothing red
+        for raw in a.state["indices"]["fo"]["routing"].values():
+            assert raw["primary"] == "node-0"
+        h = a.cluster.health()
+        assert h["status"] == "yellow"  # replicas unassigned, no data loss
+        resp = a.search("fo", {"query": {"match": {"body": "payload"}},
+                               "size": 50})
+        assert resp["hits"]["total"]["value"] == 30
+        # writes keep working after failover
+        assert a.index_doc("fo", "post-mortem", {"body": "payload after"})
+        a.refresh("fo")
+        assert a.count("fo")["count"] == 31
+
+    def test_master_death_triggers_reelection(self, cluster2):
+        a, b = cluster2
+        b.create_index("m", {"settings": {"number_of_shards": 2,
+                                          "number_of_replicas": 1}})
+        for i in range(10):
+            b.index_doc("m", f"d{i}", {"body": f"doc {i}"})
+        b.refresh("m")
+        a.close()  # kill the MASTER
+        wait_until(lambda: b.is_master(), msg="node-1 to take over as master")
+        assert set(b.state["nodes"]) == {"node-1"}
+        for raw in b.state["indices"]["m"]["routing"].values():
+            assert raw["primary"] == "node-1"
+        resp = b.search("m", {"query": {"match": {"body": "doc"}}, "size": 20})
+        assert resp["hits"]["total"]["value"] == 10
+        b.index_doc("m", "new", {"body": "doc eleven"})
+        b.refresh("m")
+        assert b.count("m")["count"] == 11
+
+
+class TestPeerRecovery:
+    def test_late_joiner_recovers_replicas_to_green(self, tmp_path):
+        a = TpuNode("node-0", data_path=str(tmp_path / "node-0"), **FD).start()
+        b = None
+        try:
+            a.create_index("pr", {"settings": {"number_of_shards": 2,
+                                               "number_of_replicas": 1}})
+            for i in range(25):
+                a.index_doc("pr", f"d{i}", {"body": f"doc number {i}"})
+            a.refresh("pr")
+            assert a.cluster.health()["status"] == "yellow"
+            b = TpuNode("node-1", seeds=[a.address],
+                        data_path=str(tmp_path / "node-1"), **FD).start()
+            wait_until(lambda: a.cluster.health()["status"] == "green",
+                       msg="peer recovery to bring the cluster green")
+            idx_b = b.indices["pr"]
+            assert sum(e.num_docs for e in idx_b.local_shards.values()) == 25
+            # replica copies answer searches with the same results
+            resp = b.search("pr", {"query": {"match": {"body": "doc"}},
+                                   "size": 50})
+            assert resp["hits"]["total"]["value"] == 25
+        finally:
+            if b is not None:
+                b.close()
+            a.close()
+
+    def test_bounce_node_recovers_missed_writes(self, tmp_path):
+        nodes = make_cluster(2, tmp_path)
+        a, b = nodes
+        try:
+            a.create_index("bounce", {"settings": {"number_of_shards": 2,
+                                                   "number_of_replicas": 1}})
+            for i in range(10):
+                a.index_doc("bounce", f"pre{i}", {"body": f"pre doc {i}"})
+            a.refresh("bounce")
+            b.close()
+            wait_until(lambda: set(a.state["nodes"]) == {"node-0"},
+                       msg="node-1 removal")
+            # writes while node-1 is down — it must NOT serve these stale
+            for i in range(10):
+                a.index_doc("bounce", f"mid{i}", {"body": f"mid doc {i}"})
+            a.refresh("bounce")
+            b2 = TpuNode("node-1", seeds=[a.address],
+                         data_path=str(tmp_path / "node-1"), **FD).start()
+            wait_until(lambda: a.cluster.health()["status"] == "green",
+                       msg="re-replication after bounce")
+            idx_b = b2.indices["bounce"]
+            assert sum(e.num_docs for e in idx_b.local_shards.values()) == 20
+            resp = b2.search("bounce", {"query": {"match": {"body": "mid"}},
+                                        "size": 50})
+            assert resp["hits"]["total"]["value"] == 10
+            b2.close()
+        finally:
+            a.close()
+
+    def test_in_sync_set_excludes_failed_copy_until_recovered(self, tmp_path):
+        nodes = make_cluster(2, tmp_path)
+        a, b = nodes
+        try:
+            a.create_index("sync", {"settings": {"number_of_shards": 1,
+                                                 "number_of_replicas": 1}})
+            a.index_doc("sync", "one", {"body": "first"})
+            b.close()
+            wait_until(lambda: set(a.state["nodes"]) == {"node-0"},
+                       msg="node-1 removal")
+            entry = a.state["indices"]["sync"]["routing"]["0"]
+            assert entry["in_sync"] == ["node-0"]
+            assert entry["primary"] == "node-0"
+        finally:
+            a.close()
