@@ -55,6 +55,7 @@ ACTION_SHARD_STATS = "indices:monitor/shard_stats"
 ACTION_CTX_OPEN = "indices:data/read/ctx_open"
 ACTION_CTX_CLOSE = "indices:data/read/ctx_close"
 ACTION_SHARD_REPLICA_OPS = "indices:data/write/replica_ops"
+ACTION_SNAPSHOT_SHARD = "internal:snapshot/shard"
 
 
 def norm_shard_routing(entry) -> dict:
@@ -227,15 +228,12 @@ class IndexService:
         """shard id → locally-held engine (IndicesService view)."""
         return dict(self._local)
 
-    def apply_routing(
-        self, routing: Optional[Dict[int, Any]]
-    ) -> List[int]:
+    def apply_routing(self, routing: Optional[Dict[int, Any]]) -> None:
         """Reconciles local engines with a new routing table (the
         IndicesClusterStateService.applyClusterState shard create/remove
         path): engines are created for newly-owned shards and closed for
-        shards routed away. Returns shard ids newly assigned to this
-        node as replicas that are NOT yet in the in-sync set — these
-        need peer recovery from their primary."""
+        shards routed away. Callers check ``recovery_needed()`` after
+        applying to find replica copies that must peer-recover."""
         if routing is not None:
             self.routing = {
                 int(k): norm_shard_routing(v) for k, v in routing.items()
@@ -244,7 +242,6 @@ class IndexService:
         # self._local without the state lock, so it is never mutated in
         # place — a fresh dict is swapped in atomically
         local = dict(self._local)
-        needs_recovery: List[int] = []
         for sid in range(self.num_shards):
             if self._owns(sid) and sid not in local:
                 shard_path = (
@@ -256,13 +253,6 @@ class IndexService:
                     self.mappings, self.analysis, path=shard_path, shard_id=sid,
                     primary_term=self._primary_term(sid),
                 )
-                e = self._entry(sid)
-                if (
-                    e is not None
-                    and e["primary"] != self.local_node
-                    and self.local_node not in e["in_sync"]
-                ):
-                    needs_recovery.append(sid)
             elif not self._owns(sid) and sid in local:
                 eng = local.pop(sid)
                 self._executors.pop(sid, None)
@@ -280,7 +270,6 @@ class IndexService:
                     if tracked:
                         tracked &= set(e["replicas"]) - set(e["in_sync"])
         self._local = local
-        return needs_recovery
 
     def recovery_needed(self) -> List[int]:
         """Locally-assigned replica shards that are not yet in-sync —
@@ -389,8 +378,18 @@ class IndexService:
         sid = route_shard_id(
             routing if routing is not None else doc_id, self.num_shards
         )
+        if self.routing is None:
+            return self.local_shard(sid).get(doc_id)
         owner = self._owner(sid)
-        if owner is None or owner == self.local_node:
+        if owner is None:
+            from .service import ClusterError
+
+            raise ClusterError(
+                503,
+                f"primary shard [{self.name}][{sid}] is not active",
+                "unavailable_shards_exception",
+            )
+        if owner == self.local_node:
             return self.local_shard(sid).get(doc_id)
         out = self.remote_call(
             owner,
@@ -1110,6 +1109,59 @@ class IndexService:
         self._executors.pop(sid, None)
         return eng
 
+    # ---- snapshots (SnapshotShardsService.snapshotShard) ----
+
+    def snapshot_shard_local(self, sid: int) -> dict:
+        """One shard's snapshot payload: the committed file set for
+        disk-backed engines (immutable segments + manifest — exactly the
+        incremental unit BlobStoreRepository ships), or a doc dump for
+        in-memory engines."""
+        eng = self.local_shard(sid)
+        if eng.path is None:
+            return {"docs": dump_engine_docs(eng)}
+        with eng._lock:
+            eng.flush()
+            files: Dict[str, bytes] = {}
+            for root, _, fnames in os.walk(eng.path):
+                for fn in fnames:
+                    full = os.path.join(root, fn)
+                    rel = os.path.relpath(full, eng.path)
+                    # flush committed everything; the WAL tail is empty
+                    if rel.startswith("translog"):
+                        continue
+                    try:
+                        with open(full, "rb") as f:
+                            files[rel] = f.read()
+                    except OSError:
+                        pass
+            return {"files": files}
+
+    def snapshot_shards(self) -> Dict[int, dict]:
+        """Collects every shard's payload, pulling remote shards from
+        their primary over the transport."""
+        import base64
+
+        out: Dict[int, dict] = {}
+        for sid in range(self.num_shards):
+            owner = self._owner(sid)
+            if owner is None or owner == self.local_node:
+                out[sid] = self.snapshot_shard_local(sid)
+            else:
+                r = self.remote_call(
+                    owner, ACTION_SNAPSHOT_SHARD,
+                    {"index": self.name, "shard": sid},
+                )
+                if "files_b64" in r:
+                    out[sid] = {
+                        "files": {
+                            k: base64.b64decode(v)
+                            for k, v in r["files_b64"].items()
+                        }
+                    }
+                else:
+                    out[sid] = {"docs": r["docs"]}
+        return out
+
     def local_stats(self) -> dict:
         """Stats over the PRIMARY shards held on THIS node (wire-shaped;
         replicas are excluded so cross-node aggregation counts each
@@ -1194,6 +1246,29 @@ class IndexService:
             "settings": {"index": index_settings},
             "mappings": self.mappings.to_json(),
         }
+
+
+def dump_engine_docs(eng: ShardEngine) -> List[dict]:
+    """Live docs of one engine as seqno/version-stamped wire dicts
+    (snapshot doc-mode payloads and doc-replay restores)."""
+    docs: List[dict] = []
+    with eng._lock:
+        for doc_id, ve in eng._versions.items():
+            if ve.deleted:
+                continue
+            doc = eng.get(doc_id)
+            if doc is None:
+                continue
+            docs.append(
+                {
+                    "id": doc_id,
+                    "source": doc["_source"],
+                    "version": ve.version,
+                    "seq_no": ve.seq_no,
+                }
+            )
+    docs.sort(key=lambda d: d["seq_no"])
+    return docs
 
 
 def apply_shard_ops(eng: ShardEngine, ops: List[dict]) -> List[dict]:

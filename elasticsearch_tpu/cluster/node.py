@@ -49,6 +49,7 @@ from .indices import (
     ACTION_SHARD_REPLICA_OPS,
     ACTION_SHARD_SEARCH,
     ACTION_SHARD_STATS,
+    ACTION_SNAPSHOT_SHARD,
     IndexService,
     apply_shard_ops,
     norm_shard_routing,
@@ -131,6 +132,16 @@ class DistributedClusterService(ClusterService):
             "indices:admin/template/delete", {"name": name}
         )
 
+    def put_repository(self, name: str, body: dict) -> dict:
+        return self.node.master_request(
+            "cluster:repository/put", {"name": name, "body": body or {}}
+        )
+
+    def delete_repository(self, name: str) -> dict:
+        return self.node.master_request(
+            "cluster:repository/delete", {"name": name}
+        )
+
     def get_or_autocreate(self, name: str) -> IndexService:
         """Unlike the single-node base, this must NOT hold the service
         lock across the master round-trip (the publish-apply thread
@@ -158,6 +169,7 @@ class DistributedClusterService(ClusterService):
         newly-assigned out-of-sync replica copies."""
         self.aliases = state.get("aliases", {})
         self.templates = state.get("templates", {})
+        self.repositories = state.get("repositories", {})
         recoveries: Dict[str, List[int]] = {}
         for name, meta in state.get("indices", {}).items():
             idx = self.indices.get(name)
@@ -202,6 +214,41 @@ class DistributedClusterService(ClusterService):
         self.version = state.get("version", self.version)
         for name, sids in recoveries.items():
             self.node.schedule_recoveries(name, sids)
+
+    def _restore_index(
+        self, repository, snap: str, entry: dict, source_name: str, target: str
+    ) -> None:
+        """Distributed restore: index creation rides the master (so the
+        routing table allocates copies cluster-wide), then shards replay
+        through the routed write path. History (versions/seqnos) is
+        fresh — the restored CONTENT is exact."""
+        from .service import _docs_from_snapshot_files
+
+        imeta = entry["indices"][source_name]
+        num_shards = int(imeta["num_shards"])
+        settings = dict(imeta.get("settings") or {})
+        self.create_index(
+            target, {"settings": settings, "mappings": imeta.get("mappings")}
+        )
+        idx = self.indices[target]
+        for sid in range(num_shards):
+            docs = repository.shard_docs(snap, source_name, sid)
+            if docs is None:
+                files = repository.shard_files(snap, source_name, sid)
+                if files is None:
+                    continue
+                docs = _docs_from_snapshot_files(
+                    files, imeta.get("mappings"), imeta.get("settings")
+                )
+            if docs:
+                idx._shard_ops(
+                    sid,
+                    [
+                        {"op": "index", "id": d["id"], "source": d["source"]}
+                        for d in docs
+                    ],
+                )
+        idx.refresh()
 
     def health(self) -> dict:
         """Shard-level red/yellow/green from the routing table
@@ -287,6 +334,9 @@ class TpuNode:
         self._fd_stop = threading.Event()
         self._fd_thread: Optional[threading.Thread] = None
         self._fd_failures: Dict[str, int] = {}
+        # fresh per process start — the allocation-id analog that lets
+        # the master tell a restarted node from a live one on re-join
+        self.incarnation = _uuidlib.uuid4().hex[:12]
         self.transport = TransportService(name, cluster_name, port=port)
         self.state: dict = {
             "version": 0,
@@ -337,17 +387,27 @@ class TpuNode:
             recovered = {
                 "version": (persisted or {}).get("version", 0) + 1,
                 "master": self.name,
-                "nodes": {self.name: {"address": list(self.transport.address)}},
+                "nodes": {
+                    self.name: {
+                        "address": list(self.transport.address),
+                        "uuid": self.incarnation,
+                    }
+                },
                 "indices": (persisted or {}).get("indices", {}),
                 "aliases": (persisted or {}).get("aliases", {}),
                 "templates": (persisted or {}).get("templates", {}),
+                "repositories": (persisted or {}).get("repositories", {}),
             }
             self._apply_state(recovered)
         else:
             state = self.transport.send(
                 peers[master],
                 "cluster:join",
-                {"node": self.name, "address": list(self.transport.address)},
+                {
+                    "node": self.name,
+                    "address": list(self.transport.address),
+                    "uuid": self.incarnation,
+                },
             )
             self._apply_state(state)
         self._fd_thread = threading.Thread(
@@ -447,6 +507,9 @@ class TpuNode:
         )
         t.register_handler("cluster:shard/failed", self._handle_shard_failed)
         t.register_handler("cluster:shard/started", self._handle_shard_started)
+        t.register_handler(ACTION_SNAPSHOT_SHARD, self._handle_snapshot_shard)
+        t.register_handler("cluster:repository/put", self._handle_repo_put)
+        t.register_handler("cluster:repository/delete", self._handle_repo_delete)
 
     # ---- membership + publication ----
 
@@ -454,7 +517,19 @@ class TpuNode:
         with self._state_lock:
             self._require_master()
             new = _copy_state(self.state)
-            new["nodes"][p["node"]] = {"address": p["address"]}
+            prev = new["nodes"].get(p["node"])
+            new["nodes"][p["node"]] = {
+                "address": p["address"],
+                "uuid": p.get("uuid"),
+            }
+            if prev is not None and prev.get("uuid") != p.get("uuid"):
+                # a RESTARTED incarnation: its copies may have missed
+                # acked writes, so they leave every in-sync set and
+                # peer-recover back in (the allocation-id freshness check
+                # of IndexMetadata.inSyncAllocationIds). A shard whose
+                # only copy it holds keeps it as primary — whatever is on
+                # its disk is all the data that exists.
+                _demote_node_copies(new, p["node"])
             # a (re)joining node is a fresh allocation target for any
             # under-replicated shard (AllocationService.reroute on join)
             _fill_replicas(new)
@@ -1081,6 +1156,28 @@ class TpuNode:
             self._publish(new)
             return {"acknowledged": True}
 
+    def _handle_repo_put(self, p: dict) -> dict:
+        with self._state_lock:
+            self._require_master()
+            # reuse the single-node validation + write probe, then ride
+            # the registry through state publication
+            ClusterService.put_repository(self.cluster, p["name"], p["body"])
+            new = _copy_state(self.state)
+            new["repositories"] = dict(self.cluster.repositories)
+            new["version"] += 1
+            self._publish(new)
+            return {"acknowledged": True}
+
+    def _handle_repo_delete(self, p: dict) -> dict:
+        with self._state_lock:
+            self._require_master()
+            ClusterService.delete_repository(self.cluster, p["name"])
+            new = _copy_state(self.state)
+            new["repositories"] = dict(self.cluster.repositories)
+            new["version"] += 1
+            self._publish(new)
+            return {"acknowledged": True}
+
     def _handle_put_template(self, p: dict) -> dict:
         with self._state_lock:
             self._require_master()
@@ -1224,6 +1321,22 @@ class TpuNode:
             else:
                 eng.delete_replica(op["id"], op["version"], op["seq_no"])
         return {"acks": len(p["ops"]), "local_checkpoint": eng.max_seq_no}
+
+    def _handle_snapshot_shard(self, p: dict) -> dict:
+        """Owning-node side of snapshot collection: b64 files on the
+        wire, or the doc dump for diskless engines."""
+        import base64
+
+        idx = self._index_service(p["index"])
+        payload = idx.snapshot_shard_local(int(p["shard"]))
+        if "files" in payload:
+            return {
+                "files_b64": {
+                    rel: base64.b64encode(data).decode("ascii")
+                    for rel, data in payload["files"].items()
+                }
+            }
+        return {"docs": payload["docs"]}
 
     def _handle_get(self, p: dict) -> dict:
         idx = self._index_service(p["index"])
@@ -1370,6 +1483,33 @@ def _remove_node_from_state(state: dict, nid: str) -> None:
             routing[sid] = entry
 
 
+def _demote_node_copies(state: dict, nid: str) -> None:
+    """A restarted node's copies drop out of the in-sync sets (and out
+    of any primary slot another in-sync copy can fill) until peer
+    recovery re-validates them."""
+    for meta in state.get("indices", {}).values():
+        routing = meta.get("routing", {})
+        for sid, raw in routing.items():
+            entry = norm_shard_routing(raw)
+            if entry["primary"] == nid:
+                promote = [
+                    n for n in entry["in_sync"]
+                    if n != nid and n in entry["replicas"]
+                ]
+                if promote:
+                    entry["primary"] = promote[0]
+                    entry["replicas"].remove(promote[0])
+                    entry["replicas"].append(nid)
+                    entry["primary_term"] += 1
+                else:
+                    # sole copy: stays primary, stays in-sync
+                    routing[sid] = entry
+                    continue
+            if nid in entry["in_sync"]:
+                entry["in_sync"].remove(nid)
+            routing[sid] = entry
+
+
 def _fill_replicas(state: dict) -> None:
     """Allocates missing replica copies onto nodes that hold no copy of
     the shard (BalancedShardsAllocator, radically simplified: spread by
@@ -1393,10 +1533,12 @@ def _fill_replicas(state: dict) -> None:
         routing = meta.get("routing", {})
         for sid, raw in routing.items():
             entry = norm_shard_routing(raw)
-            holders = set(
-                ([entry["primary"]] if entry["primary"] else [])
-                + entry["replicas"]
-            )
+            if entry["primary"] is None:
+                # a red shard has no recovery source — allocating
+                # replicas would strand phantom initializing copies
+                routing[sid] = entry
+                continue
+            holders = set([entry["primary"]] + entry["replicas"])
             while len(entry["replicas"]) < desired:
                 candidates = [n for n in nodes if n not in holders]
                 if not candidates:

@@ -54,6 +54,8 @@ class ClusterService:
         self.aliases: Dict[str, Dict[str, dict]] = {}
         # template name → {"index_patterns": [...], "template": {...}, "priority": N}
         self.templates: Dict[str, dict] = {}
+        # repository name → {"type": "fs", "settings": {"location": ...}}
+        self.repositories: Dict[str, dict] = {}
         self._scrolls: Dict[str, dict] = {}
         self._pits: Dict[str, dict] = {}
         self._lock = threading.RLock()
@@ -78,6 +80,7 @@ class ClusterService:
             "cluster_name": self.cluster_name,
             "aliases": self.aliases,
             "templates": self.templates,
+            "repositories": self.repositories,
             "indices": {
                 name: {
                     "settings": {k: v for k, v in idx.settings.items()},
@@ -104,6 +107,7 @@ class ClusterService:
         self.version = state.get("version", 0)
         self.aliases = state.get("aliases", {})
         self.templates = state.get("templates", {})
+        self.repositories = state.get("repositories", {})
         for name, meta in state.get("indices", {}).items():
             path = self._index_path(name)
             # prefer the per-index _meta.json written at flush — it carries
@@ -681,6 +685,299 @@ class ClusterService:
             found = self._pits.pop(pit_id, None) is not None
         return {"succeeded": found, "num_freed": 1 if found else 0}
 
+    # ------------------------------------------------------------------
+    # snapshots (SnapshotsService / RepositoriesService)
+    # ------------------------------------------------------------------
+
+    def put_repository(self, name: str, body: dict) -> dict:
+        body = body or {}
+        rtype = body.get("type")
+        if rtype != "fs":
+            raise ClusterError(
+                400,
+                f"repository type [{rtype}] does not exist (only [fs] is "
+                "supported)",
+                "repository_exception",
+            )
+        location = (body.get("settings") or {}).get("location")
+        if not location:
+            raise ClusterError(
+                400,
+                "[fs] missing location",
+                "repository_exception",
+            )
+        # verify: the location must be creatable+writable (the analog of
+        # VerifyRepositoryAction's write-read roundtrip)
+        try:
+            os.makedirs(location, exist_ok=True)
+            probe = os.path.join(location, ".verify")
+            with open(probe, "w") as f:
+                f.write("ok")
+            os.remove(probe)
+        except OSError as e:
+            raise ClusterError(
+                500,
+                f"[{name}] cannot access repository location: {e}",
+                "repository_verification_exception",
+            )
+        with self._lock:
+            self.repositories[name] = {
+                "type": "fs",
+                "settings": {"location": location},
+            }
+            self.version += 1
+            self._persist()
+        return {"acknowledged": True}
+
+    def get_repository(self, name: Optional[str] = None) -> dict:
+        if name is None or name in ("_all", "*"):
+            return dict(self.repositories)
+        repo = self.repositories.get(name)
+        if repo is None:
+            raise ClusterError(
+                404, f"[{name}] missing", "repository_missing_exception"
+            )
+        return {name: repo}
+
+    def delete_repository(self, name: str) -> dict:
+        with self._lock:
+            if self.repositories.pop(name, None) is None:
+                raise ClusterError(
+                    404, f"[{name}] missing", "repository_missing_exception"
+                )
+            self.version += 1
+            self._persist()
+        return {"acknowledged": True}
+
+    def _repo(self, name: str):
+        from ..snapshots import FsRepository
+
+        meta = self.repositories.get(name)
+        if meta is None:
+            raise ClusterError(
+                404, f"[{name}] missing", "repository_missing_exception"
+            )
+        return FsRepository(name, meta["settings"]["location"])
+
+    def _snapshot_indices(self, expression) -> List[str]:
+        """Resolves a snapshot/restore indices expression (list or
+        comma-string, wildcards) against existing indices."""
+        import fnmatch
+
+        if expression is None:
+            expression = "_all"
+        parts = (
+            expression
+            if isinstance(expression, list)
+            else [p.strip() for p in str(expression).split(",") if p.strip()]
+        )
+        out: List[str] = []
+        for part in parts:
+            if part in ("_all", "*"):
+                out.extend(self.indices.keys())
+            elif "*" in part or "?" in part:
+                out.extend(
+                    n for n in self.indices if fnmatch.fnmatch(n, part)
+                )
+            elif part in self.indices:
+                out.append(part)
+            else:
+                raise IndexNotFoundError(part)
+        seen: Dict[str, None] = {}
+        for n in out:
+            seen.setdefault(n)
+        return list(seen)
+
+    def create_snapshot(self, repo: str, snap: str, body: Optional[dict] = None) -> dict:
+        from ..snapshots import SnapshotError
+
+        body = body or {}
+        repository = self._repo(repo)
+        names = self._snapshot_indices(body.get("indices"))
+        payloads: Dict[str, dict] = {}
+        for name in names:
+            idx = self.indices[name]
+            meta_settings = {k: v for k, v in idx.settings.items()}
+            if idx.analysis_config:
+                meta_settings["analysis"] = idx.analysis_config
+            payloads[name] = {
+                "settings": meta_settings,
+                "mappings": idx.mappings.to_json(),
+                "uuid": idx.uuid,
+                "num_shards": idx.num_shards,
+                "shards": idx.snapshot_shards(),
+            }
+        try:
+            entry = repository.create(snap, payloads)
+        except SnapshotError as e:
+            raise ClusterError(e.status, e.reason, e.err_type)
+        return {
+            "snapshot": {
+                "snapshot": snap,
+                "uuid": entry["uuid"],
+                "state": entry["state"],
+                "indices": names,
+                "shards": {
+                    "total": sum(self.indices[n].num_shards for n in names),
+                    "failed": 0,
+                    "successful": sum(
+                        self.indices[n].num_shards for n in names
+                    ),
+                },
+            }
+        }
+
+    def get_snapshot(self, repo: str, snap: str) -> dict:
+        from ..snapshots import SnapshotError
+
+        repository = self._repo(repo)
+        try:
+            if snap in ("_all", "*"):
+                entries = repository.list()
+            else:
+                entries = [repository.get(s) for s in snap.split(",")]
+        except SnapshotError as e:
+            raise ClusterError(e.status, e.reason, e.err_type)
+        return {
+            "snapshots": [
+                {
+                    "snapshot": e["snapshot"],
+                    "uuid": e["uuid"],
+                    "state": e["state"],
+                    "indices": sorted(e["indices"].keys()),
+                    "start_time_in_millis": e["start_time_in_millis"],
+                    "end_time_in_millis": e["end_time_in_millis"],
+                }
+                for e in entries
+            ]
+        }
+
+    def delete_snapshot(self, repo: str, snap: str) -> dict:
+        from ..snapshots import SnapshotError
+
+        repository = self._repo(repo)
+        try:
+            repository.delete(snap)
+        except SnapshotError as e:
+            raise ClusterError(e.status, e.reason, e.err_type)
+        return {"acknowledged": True}
+
+    def restore_snapshot(self, repo: str, snap: str, body: Optional[dict] = None) -> dict:
+        """Restore = recovery from the repository (restoreShard): file
+        snapshots are materialized into the index path and the engines
+        recover from them, preserving versions and seqnos; doc-mode
+        shards replay with their recorded version/seqno stamps."""
+        import fnmatch
+        import re as _re
+
+        from ..snapshots import SnapshotError
+
+        body = body or {}
+        repository = self._repo(repo)
+        try:
+            entry = repository.get(snap)
+        except SnapshotError as e:
+            raise ClusterError(e.status, e.reason, e.err_type)
+        expression = body.get("indices", "_all")
+        parts = (
+            expression
+            if isinstance(expression, list)
+            else [p.strip() for p in str(expression).split(",") if p.strip()]
+        )
+        chosen: List[str] = []
+        for part in parts:
+            if part in ("_all", "*"):
+                chosen.extend(entry["indices"].keys())
+            else:
+                matched = [
+                    n for n in entry["indices"] if fnmatch.fnmatch(n, part)
+                ]
+                if not matched:
+                    raise IndexNotFoundError(part)
+                chosen.extend(matched)
+        pattern = body.get("rename_pattern")
+        replacement = body.get("rename_replacement", "")
+        restored: List[str] = []
+        for source_name in dict.fromkeys(chosen):
+            target = (
+                _re.sub(pattern, replacement, source_name)
+                if pattern
+                else source_name
+            )
+            if target in self.indices:
+                raise ClusterError(
+                    400,
+                    f"cannot restore index [{target}] because an open index "
+                    "with same name already exists in the cluster",
+                    "snapshot_restore_exception",
+                )
+            self._restore_index(repository, snap, entry, source_name, target)
+            restored.append(target)
+        return {
+            "snapshot": {
+                "snapshot": snap,
+                "indices": restored,
+                "shards": {
+                    "total": sum(
+                        entry["indices"][s]["num_shards"] for s in dict.fromkeys(chosen)
+                    ),
+                    "failed": 0,
+                    "successful": sum(
+                        entry["indices"][s]["num_shards"] for s in dict.fromkeys(chosen)
+                    ),
+                },
+            }
+        }
+
+    def _restore_index(
+        self, repository, snap: str, entry: dict, source_name: str, target: str
+    ) -> None:
+        imeta = entry["indices"][source_name]
+        num_shards = int(imeta["num_shards"])
+        index_path = self._index_path(target)
+        file_restore = index_path is not None
+        if file_restore:
+            # phase 1: lay the committed shard files down BEFORE the
+            # engines open — IndexService recovery then treats them
+            # exactly like a local restart (restore-as-recovery-source)
+            for sid in range(num_shards):
+                files = repository.shard_files(snap, source_name, sid)
+                if files is None:
+                    continue
+                shard_dir = os.path.join(index_path, str(sid))
+                for rel, data in files.items():
+                    full = os.path.join(shard_dir, rel)
+                    os.makedirs(os.path.dirname(full), exist_ok=True)
+                    with open(full, "wb") as f:
+                        f.write(data)
+        with self._lock:
+            idx = IndexService(
+                target,
+                settings=imeta.get("settings"),
+                mappings_json=imeta.get("mappings"),
+                base_path=index_path,
+            )
+            self.indices[target] = idx
+            self.version += 1
+            self._persist()
+        # doc-mode shards (or file snapshots restored into a diskless
+        # node) replay with their recorded version/seqno stamps
+        for sid in range(num_shards):
+            docs = repository.shard_docs(snap, source_name, sid)
+            if docs is None and index_path is None:
+                files = repository.shard_files(snap, source_name, sid)
+                if files is not None:
+                    docs = _docs_from_snapshot_files(
+                        files, imeta.get("mappings"), imeta.get("settings")
+                    )
+            if docs:
+                eng = idx.local_shard(sid)
+                for d in docs:
+                    eng.index_replica(
+                        d["id"], d["source"], d["version"], d["seq_no"]
+                    )
+                eng.refresh()
+
     def health(self) -> dict:
         n_primaries = sum(i.num_shards for i in self.indices.values())
         n_replicas = sum(
@@ -715,6 +1012,42 @@ class ClusterService:
     def close(self) -> None:
         for idx in self.indices.values():
             idx.close()
+
+
+def _docs_from_snapshot_files(
+    files: Dict[str, bytes], mappings_json: Optional[dict], settings: Optional[dict]
+) -> List[dict]:
+    """Opens a file-mode shard snapshot in a scratch directory and dumps
+    its live docs — the bridge from file snapshots to doc-replay
+    restores (diskless nodes, distributed mode)."""
+    import shutil
+    import tempfile
+
+    from ..analysis import AnalysisRegistry
+    from ..index.engine import ShardEngine
+    from ..index.mapping import Mappings
+    from .indices import dump_engine_docs
+
+    tmp = tempfile.mkdtemp(prefix="restore-shard-")
+    try:
+        for rel, data in files.items():
+            full = os.path.join(tmp, rel)
+            os.makedirs(os.path.dirname(full), exist_ok=True)
+            with open(full, "wb") as f:
+                f.write(data)
+        analysis_cfg = (settings or {}).get("analysis")
+        eng = ShardEngine(
+            Mappings(mappings_json or {}),
+            AnalysisRegistry(
+                {"analysis": analysis_cfg} if analysis_cfg else None
+            ),
+            path=tmp,
+        )
+        docs = dump_engine_docs(eng)
+        eng.close()
+        return docs
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
 
 
 def _empty_search_response() -> dict:

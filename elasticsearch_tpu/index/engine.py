@@ -104,6 +104,8 @@ class ShardEngine:
         self._buffered_deletes: Dict[str, _VersionEntry] = {}
 
         self._next_seq = 0
+        # an in-memory merge not yet reflected in the on-disk manifest
+        self._merge_uncommitted = False
         # bumped whenever the searchable state changes (refresh/merge) —
         # lets callers cache readers/executors per generation
         self.change_generation = 0
@@ -386,6 +388,14 @@ class ShardEngine:
             self.op_stats["flush_total"] += 1
             if self.path is None:
                 return
+            if (
+                not self._merge_uncommitted
+                and self.committed_seq_no == self._next_seq - 1
+                and os.path.exists(os.path.join(self.path, "manifest.json"))
+            ):
+                # nothing since the last commit — idempotent flush, the
+                # manifest (and thus snapshot blobs) stays byte-identical
+                return
             from .segment import fsync_dir, fsync_path
 
             self.committed_generation += 1
@@ -441,6 +451,7 @@ class ShardEngine:
             os.replace(tmp, os.path.join(self.path, "manifest.json"))
             fsync_dir(self.path)
             self.committed_seq_no = committed_seq
+            self._merge_uncommitted = False
             if self.translog is not None:
                 self.translog.trim_unreferenced(committed_seq)
             self._gc_segments(seg_entries)
@@ -507,6 +518,7 @@ class ShardEngine:
             self._locations = new_locations
             self.change_generation += 1
             self.op_stats["merge_total"] += 1
+            self._merge_uncommitted = True
             return True
 
     # ------------------------------------------------------------------

@@ -68,6 +68,17 @@ class RestActions:
         add("DELETE", "/_pit", self.close_pit)
         add("POST", "/_analyze", self.analyze)
         add("GET", "/_analyze", self.analyze)
+        # snapshots & repositories
+        add("PUT", "/_snapshot/{repo}", self.put_repository)
+        add("POST", "/_snapshot/{repo}/_verify", self.verify_repository)
+        add("GET", "/_snapshot", self.get_repository)
+        add("GET", "/_snapshot/{repo}", self.get_repository)
+        add("DELETE", "/_snapshot/{repo}", self.delete_repository)
+        add("PUT", "/_snapshot/{repo}/{snap}", self.create_snapshot)
+        add("POST", "/_snapshot/{repo}/{snap}", self.create_snapshot)
+        add("GET", "/_snapshot/{repo}/{snap}", self.get_snapshot)
+        add("DELETE", "/_snapshot/{repo}/{snap}", self.delete_snapshot)
+        add("POST", "/_snapshot/{repo}/{snap}/_restore", self.restore_snapshot)
         # aliases & templates
         add("POST", "/_aliases", self.update_aliases)
         add("GET", "/_alias", self.get_alias)
@@ -197,6 +208,40 @@ class RestActions:
 
     def put_cluster_settings(self, body, params, qs):
         return 200, self.cluster.update_cluster_settings(body or {})
+
+    # ---- snapshots ----
+
+    def put_repository(self, body, params, qs):
+        return 200, self.cluster.put_repository(params["repo"], body)
+
+    def verify_repository(self, body, params, qs):
+        self.cluster.get_repository(params["repo"])  # existence check
+        self.cluster.put_repository(
+            params["repo"], self.cluster.repositories[params["repo"]]
+        )  # re-runs the write probe
+        return 200, {"nodes": {self.cluster.node_name: {"name": self.cluster.node_name}}}
+
+    def get_repository(self, body, params, qs):
+        return 200, self.cluster.get_repository(params.get("repo"))
+
+    def delete_repository(self, body, params, qs):
+        return 200, self.cluster.delete_repository(params["repo"])
+
+    def create_snapshot(self, body, params, qs):
+        return 200, self.cluster.create_snapshot(
+            params["repo"], params["snap"], body
+        )
+
+    def get_snapshot(self, body, params, qs):
+        return 200, self.cluster.get_snapshot(params["repo"], params["snap"])
+
+    def delete_snapshot(self, body, params, qs):
+        return 200, self.cluster.delete_snapshot(params["repo"], params["snap"])
+
+    def restore_snapshot(self, body, params, qs):
+        return 200, self.cluster.restore_snapshot(
+            params["repo"], params["snap"], body
+        )
 
     def nodes_stats(self, body, params, qs):
         import resource

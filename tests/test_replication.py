@@ -217,6 +217,40 @@ class TestPeerRecovery:
         finally:
             a.close()
 
+    def test_fast_rejoin_demotes_stale_copies(self, tmp_path):
+        """A node that restarts BEFORE failure detection fires must not
+        keep serving from its (possibly stale) copies: the join-time
+        incarnation check drops it from every in-sync set until peer
+        recovery re-validates it (allocation-id analog)."""
+        # fd so slow it never removes the bounced node mid-test
+        nodes = make_cluster(2, tmp_path, fd_interval=30.0)
+        a, b = nodes
+        try:
+            a.create_index("fr", {"settings": {"number_of_shards": 2,
+                                               "number_of_replicas": 1}})
+            for i in range(8):
+                a.index_doc("fr", f"d{i}", {"body": f"doc {i}"})
+            b.close()
+            b2 = TpuNode("node-1", seeds=[a.address],
+                         data_path=str(tmp_path / "node-1"),
+                         fd_interval=0.1, fd_retries=2).start()
+            # immediately after the re-join, node-1 is OUT of in_sync
+            # (it may have missed writes) even though it is still listed
+            # as a replica — then recovery brings it back
+            wait_until(
+                lambda: all(
+                    "node-1" in e["in_sync"]
+                    for e in a.state["indices"]["fr"]["routing"].values()
+                ),
+                msg="bounced node to re-validate via peer recovery",
+            )
+            assert a.cluster.health()["status"] == "green"
+            idx_b = b2.indices["fr"]
+            assert sum(e.num_docs for e in idx_b.local_shards.values()) == 8
+            b2.close()
+        finally:
+            a.close()
+
     def test_in_sync_set_excludes_failed_copy_until_recovered(self, tmp_path):
         nodes = make_cluster(2, tmp_path)
         a, b = nodes

@@ -1,0 +1,187 @@
+"""Snapshots: repository registration, create/get/delete, restore.
+
+Reference analogs (SURVEY.md §2.1): SnapshotsService,
+BlobStoreRepository.snapshotShard/restoreShard, restore-as-recovery.
+"""
+
+import os
+
+import pytest
+
+from elasticsearch_tpu.cluster.service import ClusterError, ClusterService
+
+
+@pytest.fixture
+def cluster(tmp_path):
+    c = ClusterService(data_path=str(tmp_path / "data"))
+    yield c
+    c.close()
+
+
+def repo_body(tmp_path, name="repo1"):
+    return {"type": "fs", "settings": {"location": str(tmp_path / name)}}
+
+
+def seed(cluster, name="src", n=12):
+    cluster.create_index(
+        name,
+        {
+            "settings": {"number_of_shards": 2},
+            "mappings": {"properties": {"body": {"type": "text"},
+                                        "n": {"type": "integer"}}},
+        },
+    )
+    idx = cluster.get_index(name)
+    for i in range(n):
+        idx.index_doc(f"d{i}", {"body": f"snapshot doc number {i}", "n": i})
+    idx.refresh()
+    return idx
+
+
+class TestRepository:
+    def test_register_get_delete(self, cluster, tmp_path):
+        assert cluster.put_repository("r", repo_body(tmp_path))["acknowledged"]
+        assert "r" in cluster.get_repository("r")
+        assert cluster.delete_repository("r")["acknowledged"]
+        with pytest.raises(ClusterError) as ei:
+            cluster.get_repository("r")
+        assert ei.value.status == 404
+
+    def test_bad_type_rejected(self, cluster):
+        with pytest.raises(ClusterError):
+            cluster.put_repository("r", {"type": "s3", "settings": {}})
+
+    def test_repositories_survive_restart(self, cluster, tmp_path):
+        cluster.put_repository("r", repo_body(tmp_path))
+        c2 = ClusterService(data_path=cluster.data_path)
+        assert "r" in c2.get_repository("r")
+        c2.close()
+
+
+class TestSnapshotRestore:
+    def test_snapshot_delete_index_restore(self, cluster, tmp_path):
+        seed(cluster)
+        cluster.put_repository("r", repo_body(tmp_path))
+        out = cluster.create_snapshot("r", "snap1", {"indices": "src"})
+        assert out["snapshot"]["state"] == "SUCCESS"
+        baseline = cluster.search("src", {"query": {"match": {"body": "snapshot"}},
+                                          "size": 20})
+        cluster.delete_index("src")
+        cluster.restore_snapshot("r", "snap1")
+        restored = cluster.search("src", {"query": {"match": {"body": "snapshot"}},
+                                          "size": 20})
+        assert restored["hits"]["total"] == baseline["hits"]["total"]
+        assert [h["_id"] for h in restored["hits"]["hits"]] == [
+            h["_id"] for h in baseline["hits"]["hits"]
+        ]
+        assert [h["_score"] for h in restored["hits"]["hits"]] == [
+            h["_score"] for h in baseline["hits"]["hits"]
+        ]
+
+    def test_restore_preserves_versions_and_seqnos(self, cluster, tmp_path):
+        idx = seed(cluster, n=4)
+        idx.index_doc("d0", {"body": "updated snapshot doc", "n": 100})
+        idx.refresh()
+        cluster.put_repository("r", repo_body(tmp_path))
+        cluster.create_snapshot("r", "s", {"indices": "src"})
+        before = idx.get_doc("d0")
+        cluster.delete_index("src")
+        cluster.restore_snapshot("r", "s")
+        after = cluster.get_index("src").get_doc("d0")
+        assert after["_version"] == before["_version"] == 2
+        assert after["_seq_no"] == before["_seq_no"]
+        assert after["_source"]["n"] == 100
+
+    def test_restore_with_rename(self, cluster, tmp_path):
+        seed(cluster)
+        cluster.put_repository("r", repo_body(tmp_path))
+        cluster.create_snapshot("r", "s", {"indices": "src"})
+        cluster.restore_snapshot(
+            "r", "s", {"indices": "src", "rename_pattern": "src",
+                       "rename_replacement": "copy"}
+        )
+        assert cluster.count("copy")["count"] == 12
+        assert cluster.count("src")["count"] == 12  # original untouched
+
+    def test_restore_refuses_existing_index(self, cluster, tmp_path):
+        seed(cluster)
+        cluster.put_repository("r", repo_body(tmp_path))
+        cluster.create_snapshot("r", "s", {"indices": "src"})
+        with pytest.raises(ClusterError) as ei:
+            cluster.restore_snapshot("r", "s")
+        assert "already exists" in str(ei.value)
+
+    def test_incremental_blob_dedup(self, cluster, tmp_path):
+        seed(cluster)
+        cluster.put_repository("r", repo_body(tmp_path))
+        cluster.create_snapshot("r", "s1", {"indices": "src"})
+        blobs = os.path.join(str(tmp_path / "repo1"), "blobs")
+        n1 = len(os.listdir(blobs))
+        # unchanged index: second snapshot adds no new blobs
+        cluster.create_snapshot("r", "s2", {"indices": "src"})
+        assert len(os.listdir(blobs)) == n1
+        out = cluster.get_snapshot("r", "_all")
+        assert {s["snapshot"] for s in out["snapshots"]} == {"s1", "s2"}
+
+    def test_delete_snapshot_gcs_blobs(self, cluster, tmp_path):
+        seed(cluster)
+        cluster.put_repository("r", repo_body(tmp_path))
+        cluster.create_snapshot("r", "s1", {"indices": "src"})
+        cluster.delete_snapshot("r", "s1")
+        blobs = os.path.join(str(tmp_path / "repo1"), "blobs")
+        assert os.listdir(blobs) == []
+        with pytest.raises(ClusterError) as ei:
+            cluster.get_snapshot("r", "s1")
+        assert ei.value.status == 404
+
+
+class TestInMemorySnapshots:
+    def test_docs_mode_roundtrip(self, tmp_path):
+        c = ClusterService()  # diskless: doc-mode snapshot payloads
+        try:
+            c.create_index("mem", {"settings": {"number_of_shards": 1}})
+            idx = c.get_index("mem")
+            for i in range(5):
+                idx.index_doc(f"m{i}", {"body": f"memory doc {i}"})
+            idx.refresh()
+            c.put_repository("r", repo_body(tmp_path))
+            c.create_snapshot("r", "s", {"indices": "mem"})
+            c.delete_index("mem")
+            c.restore_snapshot("r", "s")
+            assert c.count("mem")["count"] == 5
+        finally:
+            c.close()
+
+
+class TestDistributedSnapshots:
+    def test_snapshot_and_restore_across_nodes(self, tmp_path):
+        from elasticsearch_tpu.cluster.node import TpuNode
+
+        a = TpuNode("node-0", data_path=str(tmp_path / "n0"),
+                    fd_interval=0.2).start()
+        b = TpuNode("node-1", seeds=[a.address],
+                    data_path=str(tmp_path / "n1"), fd_interval=0.2).start()
+        try:
+            a.create_index("dist", {"settings": {"number_of_shards": 4,
+                                                 "number_of_replicas": 0}})
+            for i in range(20):
+                a.index_doc("dist", f"d{i}", {"body": f"distributed doc {i}"})
+            a.refresh("dist")
+            a.cluster.put_repository("r", repo_body(tmp_path))
+            out = a.cluster.create_snapshot("r", "s", {"indices": "dist"})
+            assert out["snapshot"]["state"] == "SUCCESS"
+            a.delete_index("dist")
+            a.cluster.restore_snapshot("r", "s")
+            a.refresh("dist")
+            resp = a.search("dist", {"query": {"match": {"body": "distributed"}},
+                                     "size": 30})
+            assert resp["hits"]["total"]["value"] == 20
+            # restored shards spread over both nodes again
+            owners = {
+                e["primary"]
+                for e in a.state["indices"]["dist"]["routing"].values()
+            }
+            assert owners == {"node-0", "node-1"}
+        finally:
+            b.close()
+            a.close()
