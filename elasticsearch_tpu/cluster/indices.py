@@ -605,6 +605,7 @@ class IndexService:
             )
         from ..search.executor import filter_source
 
+        script_fields = body.get("script_fields")
         reader = ex.reader
         hits = []
         for i, h in enumerate(td.hits):
@@ -622,6 +623,24 @@ class IndexService:
                 hl = self._highlight_hit(src, highlight_specs, highlight_terms)
                 if hl:
                     entry["highlight"] = hl
+            if script_fields:
+                from ..script import ScriptError, script_service
+                from ..search.executor import _source_field_lookup
+
+                lookup = _source_field_lookup(
+                    reader.segments[h.segment], h.local_doc
+                )
+                flds: Dict[str, list] = {}
+                for fname, spec in script_fields.items():
+                    try:
+                        v = script_service.run_field(
+                            spec.get("script") if isinstance(spec, dict) else spec,
+                            lookup,
+                        )
+                    except ScriptError as e:
+                        raise dsl.QueryParseError(str(e))
+                    flds[fname] = v if isinstance(v, list) else [v]
+                entry["fields"] = flds
             hits.append(entry)
         out = {
             "total": int(td.total),

@@ -142,6 +142,14 @@ class DistributedClusterService(ClusterService):
             "cluster:repository/delete", {"name": name}
         )
 
+    def put_pipeline(self, pid: str, body: dict) -> dict:
+        return self.node.master_request(
+            "cluster:pipeline/put", {"id": pid, "body": body or {}}
+        )
+
+    def delete_pipeline(self, pid: str) -> dict:
+        return self.node.master_request("cluster:pipeline/delete", {"id": pid})
+
     def get_or_autocreate(self, name: str) -> IndexService:
         """Unlike the single-node base, this must NOT hold the service
         lock across the master round-trip (the publish-apply thread
@@ -170,6 +178,7 @@ class DistributedClusterService(ClusterService):
         self.aliases = state.get("aliases", {})
         self.templates = state.get("templates", {})
         self.repositories = state.get("repositories", {})
+        self.ingest.load(state.get("pipelines", {}))
         recoveries: Dict[str, List[int]] = {}
         for name, meta in state.get("indices", {}).items():
             idx = self.indices.get(name)
@@ -397,6 +406,7 @@ class TpuNode:
                 "aliases": (persisted or {}).get("aliases", {}),
                 "templates": (persisted or {}).get("templates", {}),
                 "repositories": (persisted or {}).get("repositories", {}),
+                "pipelines": (persisted or {}).get("pipelines", {}),
             }
             self._apply_state(recovered)
         else:
@@ -510,6 +520,10 @@ class TpuNode:
         t.register_handler(ACTION_SNAPSHOT_SHARD, self._handle_snapshot_shard)
         t.register_handler("cluster:repository/put", self._handle_repo_put)
         t.register_handler("cluster:repository/delete", self._handle_repo_delete)
+        t.register_handler("cluster:pipeline/put", self._handle_pipeline_put)
+        t.register_handler(
+            "cluster:pipeline/delete", self._handle_pipeline_delete
+        )
 
     # ---- membership + publication ----
 
@@ -1174,6 +1188,26 @@ class TpuNode:
             ClusterService.delete_repository(self.cluster, p["name"])
             new = _copy_state(self.state)
             new["repositories"] = dict(self.cluster.repositories)
+            new["version"] += 1
+            self._publish(new)
+            return {"acknowledged": True}
+
+    def _handle_pipeline_put(self, p: dict) -> dict:
+        with self._state_lock:
+            self._require_master()
+            ClusterService.put_pipeline(self.cluster, p["id"], p["body"])
+            new = _copy_state(self.state)
+            new["pipelines"] = self.cluster.ingest.bodies()
+            new["version"] += 1
+            self._publish(new)
+            return {"acknowledged": True}
+
+    def _handle_pipeline_delete(self, p: dict) -> dict:
+        with self._state_lock:
+            self._require_master()
+            ClusterService.delete_pipeline(self.cluster, p["id"])
+            new = _copy_state(self.state)
+            new["pipelines"] = self.cluster.ingest.bodies()
             new["version"] += 1
             self._publish(new)
             return {"acknowledged": True}

@@ -56,6 +56,11 @@ class ClusterService:
         self.templates: Dict[str, dict] = {}
         # repository name → {"type": "fs", "settings": {"location": ...}}
         self.repositories: Dict[str, dict] = {}
+        from ..ingest import IngestService
+        from ..tasks import TaskManager
+
+        self.ingest = IngestService()
+        self.tasks = TaskManager(node_name)
         self._scrolls: Dict[str, dict] = {}
         self._pits: Dict[str, dict] = {}
         self._lock = threading.RLock()
@@ -81,6 +86,7 @@ class ClusterService:
             "aliases": self.aliases,
             "templates": self.templates,
             "repositories": self.repositories,
+            "pipelines": self.ingest.bodies(),
             "indices": {
                 name: {
                     "settings": {k: v for k, v in idx.settings.items()},
@@ -108,6 +114,7 @@ class ClusterService:
         self.aliases = state.get("aliases", {})
         self.templates = state.get("templates", {})
         self.repositories = state.get("repositories", {})
+        self.ingest.load(state.get("pipelines", {}))
         for name, meta in state.get("indices", {}).items():
             path = self._index_path(name)
             # prefer the per-index _meta.json written at flush — it carries
@@ -684,6 +691,77 @@ class ClusterService:
         with self._lock:
             found = self._pits.pop(pit_id, None) is not None
         return {"succeeded": found, "num_freed": 1 if found else 0}
+
+    # ------------------------------------------------------------------
+    # ingest pipelines (IngestService registry behind the cluster state)
+    # ------------------------------------------------------------------
+
+    def put_pipeline(self, pid: str, body: dict) -> dict:
+        from ..ingest import IngestError
+
+        try:
+            self.ingest.put_pipeline(pid, body or {})
+        except IngestError as e:
+            raise ClusterError(400, str(e), e.err_type)
+        with self._lock:
+            self.version += 1
+            self._persist()
+        return {"acknowledged": True}
+
+    def get_pipeline(self, pid: Optional[str] = None) -> dict:
+        from ..ingest import IngestError
+
+        try:
+            return self.ingest.get_pipeline(pid)
+        except IngestError as e:
+            raise ClusterError(404, str(e), e.err_type)
+
+    def delete_pipeline(self, pid: str) -> dict:
+        from ..ingest import IngestError
+
+        try:
+            self.ingest.delete_pipeline(pid)
+        except IngestError as e:
+            raise ClusterError(404, str(e), e.err_type)
+        with self._lock:
+            self.version += 1
+            self._persist()
+        return {"acknowledged": True}
+
+    def simulate_pipeline(self, pid: Optional[str], body: dict) -> dict:
+        from ..ingest import IngestError
+
+        try:
+            return self.ingest.simulate(pid, body or {})
+        except IngestError as e:
+            status = 404 if e.err_type == "resource_not_found_exception" else 400
+            raise ClusterError(status, str(e), e.err_type)
+
+    def apply_ingest(
+        self,
+        index_name: str,
+        idx: IndexService,
+        source: dict,
+        doc_id: Optional[str],
+        pipeline: Optional[str] = None,
+    ) -> Optional[dict]:
+        """Runs the request pipeline (?pipeline=) or the index's
+        default_pipeline, then final_pipeline (IngestService
+        .executeBulkRequest ordering). None = document dropped."""
+        from ..ingest import IngestError
+
+        pid = pipeline if pipeline is not None else idx.settings.get(
+            "default_pipeline"
+        )
+        out: Optional[dict] = source
+        for p in (pid, idx.settings.get("final_pipeline")):
+            if not p or p == "_none" or out is None:
+                continue
+            try:
+                out = self.ingest.execute(p, out, index_name, doc_id)
+            except IngestError as e:
+                raise ClusterError(400, str(e), e.err_type)
+        return out
 
     # ------------------------------------------------------------------
     # snapshots (SnapshotsService / RepositoriesService)

@@ -111,6 +111,8 @@ INDEX_SETTINGS: Dict[str, Setting] = {
         Setting("knn.quantization", "none", INDEX_SCOPE),
         Setting("hidden", False, INDEX_SCOPE, parser=_parse_bool),
         Setting("codec", "default", INDEX_SCOPE, dynamic=False),
+        Setting("default_pipeline", None, INDEX_SCOPE),
+        Setting("final_pipeline", None, INDEX_SCOPE),
     ]
 }
 

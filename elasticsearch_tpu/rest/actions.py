@@ -68,6 +68,20 @@ class RestActions:
         add("DELETE", "/_pit", self.close_pit)
         add("POST", "/_analyze", self.analyze)
         add("GET", "/_analyze", self.analyze)
+        # tasks + by-scroll actions
+        add("GET", "/_tasks", self.list_tasks)
+        add("GET", "/_tasks/{task_id}", self.get_task)
+        add("POST", "/_tasks/{task_id}/_cancel", self.cancel_task)
+        add("POST", "/_reindex", self.reindex)
+        add("POST", "/{index}/_update_by_query", self.update_by_query)
+        add("POST", "/{index}/_delete_by_query", self.delete_by_query)
+        # ingest pipelines
+        add("PUT", "/_ingest/pipeline/{id}", self.put_pipeline)
+        add("GET", "/_ingest/pipeline", self.get_pipeline)
+        add("GET", "/_ingest/pipeline/{id}", self.get_pipeline)
+        add("DELETE", "/_ingest/pipeline/{id}", self.delete_pipeline)
+        add("POST", "/_ingest/pipeline/{id}/_simulate", self.simulate_pipeline)
+        add("POST", "/_ingest/pipeline/_simulate", self.simulate_pipeline)
         # snapshots & repositories
         add("PUT", "/_snapshot/{repo}", self.put_repository)
         add("POST", "/_snapshot/{repo}/_verify", self.verify_repository)
@@ -208,6 +222,124 @@ class RestActions:
 
     def put_cluster_settings(self, body, params, qs):
         return 200, self.cluster.update_cluster_settings(body or {})
+
+    # ---- tasks + by-scroll actions (reindex module) ----
+
+    def list_tasks(self, body, params, qs):
+        actions = qs.get("actions", [None])[0]
+        tasks = self.cluster.tasks.list(actions)
+        return 200, {
+            "nodes": {
+                self.cluster.node_name: {
+                    "name": self.cluster.node_name,
+                    "tasks": {t.id: t.info() for t in tasks},
+                }
+            }
+        }
+
+    def get_task(self, body, params, qs):
+        task = self.cluster.tasks.get(params["task_id"])
+        if task is None:
+            return 404, error_body(
+                404,
+                "resource_not_found_exception",
+                f"task [{params['task_id']}] isn't running and hasn't stored "
+                "its results",
+            )
+        out = {"completed": task.completed, "task": task.info()}
+        if task.response is not None:
+            out["response"] = task.response
+        if task.error is not None:
+            out["error"] = task.error
+        return 200, out
+
+    def cancel_task(self, body, params, qs):
+        cancelled = self.cluster.tasks.cancel(params["task_id"])
+        return 200, {
+            "nodes": {
+                self.cluster.node_name: {
+                    "tasks": {t.id: t.info() for t in cancelled}
+                }
+            }
+        }
+
+    def _by_scroll(self, action: str, description: str, qs, fn):
+        """Shared driver: foreground, or background with
+        wait_for_completion=false (the task keeps the response)."""
+        from ..tasks import TaskCancelledException
+
+        task = self.cluster.tasks.register(action, description)
+        wait = qs.get("wait_for_completion", ["true"])[0] != "false"
+        if wait:
+            try:
+                return 200, fn(task)
+            finally:
+                self.cluster.tasks.unregister(task)
+
+        import threading
+
+        def run():
+            try:
+                task.response = fn(task)
+            except TaskCancelledException as e:
+                task.error = {
+                    "type": e.err_type, "reason": str(e),
+                }
+            except ClusterError as e:
+                task.error = {"type": e.err_type, "reason": str(e)}
+            except Exception as e:  # keep the task record, not the stack
+                task.error = {"type": "exception", "reason": str(e)}
+            finally:
+                self.cluster.tasks.unregister(task, keep=True)
+
+        threading.Thread(target=run, name=f"task-{task.id}", daemon=True).start()
+        return 200, {"task": task.id}
+
+    def reindex(self, body, params, qs):
+        from ..reindex import reindex as _reindex
+
+        src = ((body or {}).get("source") or {}).get("index")
+        dst = ((body or {}).get("dest") or {}).get("index")
+        return self._by_scroll(
+            "indices:data/write/reindex",
+            f"reindex from [{src}] to [{dst}]",
+            qs,
+            lambda task: _reindex(self.cluster, body, task),
+        )
+
+    def update_by_query(self, body, params, qs):
+        from ..reindex import update_by_query as _ubq
+
+        return self._by_scroll(
+            "indices:data/write/update/byquery",
+            f"update-by-query [{params['index']}]",
+            qs,
+            lambda task: _ubq(self.cluster, params["index"], body, task),
+        )
+
+    def delete_by_query(self, body, params, qs):
+        from ..reindex import delete_by_query as _dbq
+
+        return self._by_scroll(
+            "indices:data/write/delete/byquery",
+            f"delete-by-query [{params['index']}]",
+            qs,
+            lambda task: _dbq(self.cluster, params["index"], body, task),
+        )
+
+    # ---- ingest pipelines ----
+
+    def put_pipeline(self, body, params, qs):
+        return 200, self.cluster.put_pipeline(params["id"], body)
+
+    def get_pipeline(self, body, params, qs):
+        return 200, self.cluster.get_pipeline(params.get("id"))
+
+    def delete_pipeline(self, body, params, qs):
+        return 200, self.cluster.delete_pipeline(params["id"])
+
+    def simulate_pipeline(self, body, params, qs):
+        return 200, self.cluster.simulate_pipeline(params.get("id"), body)
 
     # ---- snapshots ----
 
@@ -416,8 +548,19 @@ class RestActions:
             kwargs["if_seq_no"] = int(qs["if_seq_no"][0])
         if "if_primary_term" in qs:
             kwargs["if_primary_term"] = int(qs["if_primary_term"][0])
+        source = self.cluster.apply_ingest(
+            index_name, idx, body or {}, params["id"],
+            pipeline=qs.get("pipeline", [None])[0],
+        )
+        if source is None:  # dropped by the pipeline
+            return 200, {
+                "_index": params["index"],
+                "_id": params["id"],
+                "result": "noop",
+                "_shards": {"total": 0, "successful": 0, "failed": 0},
+            }
         r = idx.index_doc(
-            params["id"], body or {}, op_type=op, routing=routing, **kwargs
+            params["id"], source, op_type=op, routing=routing, **kwargs
         )
         self._maybe_refresh(idx, qs)
         return (201 if r.result == "created" else 200), self._doc_response(
@@ -578,7 +721,17 @@ class RestActions:
             return 200, self.cluster.create_scroll(
                 name, body, qs["scroll"][0] or "1m"
             )
-        return 200, self.cluster.search(params["index"], body)
+        # every search runs as a registered task (TaskManager.register
+        # around TransportSearchAction) so GET _tasks shows it
+        task = self.cluster.tasks.register(
+            "indices:data/read/search",
+            f"indices[{params['index']}]",
+            cancellable=False,
+        )
+        try:
+            return 200, self.cluster.search(params["index"], body)
+        finally:
+            self.cluster.tasks.unregister(task)
 
     def search_no_index(self, body, params, qs):
         body = body or {}
@@ -776,7 +929,19 @@ class RestActions:
                     if doc_id is None:
                         doc_id = _auto_id()
                     op = "create" if action == "create" else "index"
-                    r = idx.index_doc(doc_id, doc or {}, op_type=op, routing=routing)
+                    source = self.cluster.apply_ingest(
+                        index, idx, doc or {}, doc_id,
+                        pipeline=meta.get(
+                            "pipeline", qs.get("pipeline", [None])[0]
+                        ),
+                    )
+                    if source is None:  # dropped by the pipeline
+                        items.append(
+                            {action: {"_index": index, "_id": doc_id,
+                                      "result": "noop", "status": 200}}
+                        )
+                        continue
+                    r = idx.index_doc(doc_id, source, op_type=op, routing=routing)
                     items.append(
                         {
                             action: {

@@ -112,6 +112,7 @@ class ScoreFunction:
     weight: Optional[float] = None
     field_value_factor: Optional[dict] = None  # {field, factor, modifier, missing}
     random_score: Optional[dict] = None  # {seed, field}
+    script_score: Optional[dict] = None  # {"script": {...}} (ScriptScoreFunction)
 
 
 @dataclass
@@ -122,6 +123,25 @@ class FunctionScoreQuery(Query):
     boost_mode: str = "multiply"  # multiply | sum | replace | avg | max | min
     max_boost: Optional[float] = None
     min_score: Optional[float] = None
+
+
+@dataclass
+class ScriptScoreQuery(Query):
+    """script_score query: base query matches, the script replaces the
+    score (ScriptScoreQueryBuilder — the reference's brute-force kNN
+    vehicle via cosineSimilarity, SURVEY.md §3.4)."""
+
+    query: Query = None  # type: ignore[assignment]
+    script: Any = None
+    min_score: Optional[float] = None
+
+
+@dataclass
+class ScriptQuery(Query):
+    """script query (filter context): the script decides matching per
+    doc (ScriptQueryBuilder)."""
+
+    script: Any = None
 
 
 @dataclass
@@ -437,7 +457,7 @@ def _parse_function_score(params):
         # single-function shorthand at the top level
         single = {
             k: params[k]
-            for k in ("weight", "field_value_factor", "random_score")
+            for k in ("weight", "field_value_factor", "random_score", "script_score")
             if k in params
         }
         if single:
@@ -445,7 +465,10 @@ def _parse_function_score(params):
     for fn in raw_fns:
         if not isinstance(fn, dict):
             raise QueryParseError("[function_score] malformed function")
-        known = {"filter", "weight", "field_value_factor", "random_score"}
+        known = {
+            "filter", "weight", "field_value_factor", "random_score",
+            "script_score",
+        }
         unknown = set(fn) - known
         if unknown:
             raise QueryParseError(
@@ -457,6 +480,7 @@ def _parse_function_score(params):
                 weight=float(fn["weight"]) if "weight" in fn else None,
                 field_value_factor=fn.get("field_value_factor"),
                 random_score=fn.get("random_score"),
+                script_score=fn.get("script_score"),
             )
         )
     return FunctionScoreQuery(
@@ -468,6 +492,25 @@ def _parse_function_score(params):
         min_score=params.get("min_score"),
         boost=float(params.get("boost", 1.0)),
     )
+
+
+def _parse_script_score(params):
+    if "query" not in params or "script" not in params:
+        raise QueryParseError("[script_score] requires [query] and [script]")
+    return ScriptScoreQuery(
+        query=parse_query(params["query"]),
+        script=params["script"],
+        min_score=(
+            float(params["min_score"]) if "min_score" in params else None
+        ),
+        boost=float(params.get("boost", 1.0)),
+    )
+
+
+def _parse_script_query(params):
+    if "script" not in params:
+        raise QueryParseError("[script] requires [script]")
+    return ScriptQuery(script=params["script"], boost=float(params.get("boost", 1.0)))
 
 
 def _parse_query_string(params):
@@ -509,6 +552,8 @@ _PARSERS = {
     "dis_max": _parse_dis_max,
     "boosting": _parse_boosting,
     "function_score": _parse_function_score,
+    "script_score": _parse_script_score,
+    "script": _parse_script_query,
     "query_string": _parse_query_string,
     "simple_query_string": _parse_simple_query_string,
 }

@@ -426,6 +426,10 @@ class NumpyExecutor:
             return self._exec_boosting(q, seg)
         if isinstance(q, dsl.FunctionScoreQuery):
             return self._exec_function_score(q, seg)
+        if isinstance(q, dsl.ScriptScoreQuery):
+            return self._exec_script_score(q, seg)
+        if isinstance(q, dsl.ScriptQuery):
+            return self._exec_script_query(q, seg)
         if isinstance(q, dsl.QueryStringQuery):
             return self._exec(rewrite_query_string(q, self.reader.mappings), seg)
         raise QueryParseError(f"unsupported query node [{type(q).__name__}]")
@@ -536,6 +540,46 @@ class NumpyExecutor:
         scores = (scores * np.float32(q.boost)).astype(np.float32)
         return pm, np.where(pm, scores, 0).astype(np.float32)
 
+    def _exec_script_score(
+        self, q: "dsl.ScriptScoreQuery", seg: Segment
+    ) -> Tuple[np.ndarray, np.ndarray]:
+        """ScriptScoreQuery: the script runs per matching doc with
+        doc-value + vector-function bindings (host-side, exactly where
+        the reference runs painless)."""
+        from ..script import ScriptError, script_service
+
+        mask, base = self._exec(q.query, seg)
+        scores = np.zeros(seg.num_docs, np.float32)
+        try:
+            for d in np.nonzero(mask)[0]:
+                scores[d] = script_service.run_score(
+                    q.script,
+                    _source_field_lookup(seg, int(d)),
+                    score=float(base[d]),
+                )
+        except ScriptError as e:
+            raise QueryParseError(str(e))
+        if q.min_score is not None:
+            mask = mask & (scores >= np.float32(q.min_score))
+        scores = (scores * np.float32(q.boost)).astype(np.float32)
+        return mask, np.where(mask, scores, 0).astype(np.float32)
+
+    def _exec_script_query(
+        self, q: "dsl.ScriptQuery", seg: Segment
+    ) -> Tuple[np.ndarray, np.ndarray]:
+        from ..script import ScriptError, script_service
+
+        n = seg.num_docs
+        mask = np.zeros(n, bool)
+        try:
+            for d in range(n):
+                mask[d] = script_service.run_filter(
+                    q.script, _source_field_lookup(seg, d)
+                )
+        except ScriptError as e:
+            raise QueryParseError(str(e))
+        return mask, np.where(mask, np.float32(q.boost), 0).astype(np.float32)
+
     def _exec_function_score(
         self, q: "dsl.FunctionScoreQuery", seg: Segment
     ) -> Tuple[np.ndarray, np.ndarray]:
@@ -555,6 +599,20 @@ class NumpyExecutor:
                 val = np.asarray(
                     [_stable_random(seed, d) for d in seg.doc_ids], np.float32
                 ) if n else np.zeros(0, np.float32)
+            elif fn.script_score is not None:
+                from ..script import ScriptError, script_service
+
+                script = fn.script_score.get("script")
+                val = np.zeros(n, np.float32)
+                try:
+                    for d in np.nonzero(fmask & mask)[0]:
+                        val[d] = script_service.run_score(
+                            script,
+                            _source_field_lookup(seg, int(d)),
+                            score=float(base[d]),
+                        )
+                except ScriptError as e:
+                    raise QueryParseError(str(e))
             if fn.weight is not None:
                 val = val * np.float32(fn.weight)
             # functions only apply where their filter matches; identity
@@ -1146,6 +1204,28 @@ def _levenshtein_at_most(a: str, b: str, k: int) -> bool:
             return False
         prev = cur
     return prev[-1] <= k
+
+
+def _source_field_lookup(seg: Segment, local: int):
+    """doc['field'] resolver for scripts: dotted-path lookup into the
+    stored source (ScriptDocValues backed by _source — the reference
+    reads typed doc values; sources carry the same values here,
+    including dense vectors)."""
+    src = seg.sources[local]
+
+    def lookup(field: str) -> list:
+        node = src
+        for part in field.split("."):
+            if isinstance(node, dict):
+                node = node.get(part)
+            else:
+                node = None
+                break
+        if node is None:
+            return []
+        return node if isinstance(node, list) else [node]
+
+    return lookup
 
 
 def _field_value_factor(cfg: dict, seg: Segment) -> np.ndarray:

@@ -1,0 +1,224 @@
+"""Ingest pipelines: processors, failure handling, REST + write path.
+
+Reference analogs (SURVEY.md §2.1 Ingest, §2.3 ingest-common):
+IngestService.executeBulkRequest, Pipeline/CompoundProcessor,
+the processor pack, simulate API.
+"""
+
+import json
+
+import pytest
+
+from elasticsearch_tpu.cluster.service import ClusterError, ClusterService
+from elasticsearch_tpu.ingest import IngestError, IngestService
+
+
+@pytest.fixture
+def svc():
+    return IngestService()
+
+
+def run(svc, processors, doc, pid="p"):
+    svc.put_pipeline(pid, {"processors": processors})
+    return svc.execute(pid, doc, "idx", "1")
+
+
+class TestProcessors:
+    def test_set_and_template(self, svc):
+        out = run(svc, [{"set": {"field": "greeting",
+                                 "value": "hello {{user.name}}"}}],
+                  {"user": {"name": "kim"}})
+        assert out["greeting"] == "hello kim"
+
+    def test_set_override_false(self, svc):
+        out = run(svc, [{"set": {"field": "a", "value": 2, "override": False}}],
+                  {"a": 1})
+        assert out["a"] == 1
+
+    def test_set_copy_from(self, svc):
+        out = run(svc, [{"set": {"field": "b", "copy_from": "a"}}], {"a": 7})
+        assert out["b"] == 7
+
+    def test_remove_rename(self, svc):
+        out = run(svc, [{"remove": {"field": "gone"}},
+                        {"rename": {"field": "old", "target_field": "new"}}],
+                  {"gone": 1, "old": 2})
+        assert out == {"new": 2}
+
+    def test_convert(self, svc):
+        out = run(svc, [{"convert": {"field": "n", "type": "integer"}},
+                        {"convert": {"field": "f", "type": "boolean"}},
+                        {"convert": {"field": "a", "type": "auto"}}],
+                  {"n": "42", "f": "true", "a": "3.5"})
+        assert out == {"n": 42, "f": True, "a": 3.5}
+
+    def test_string_processors(self, svc):
+        out = run(svc, [{"lowercase": {"field": "a"}},
+                        {"uppercase": {"field": "b"}},
+                        {"trim": {"field": "c"}},
+                        {"html_strip": {"field": "d"}}],
+                  {"a": "ABC", "b": "def", "c": "  x  ",
+                   "d": "<b>bold</b> text"})
+        assert out == {"a": "abc", "b": "DEF", "c": "x", "d": "bold text"}
+
+    def test_split_join_gsub(self, svc):
+        out = run(svc, [{"split": {"field": "csv", "separator": ","}},
+                        {"join": {"field": "csv", "separator": "-",
+                                  "target_field": "joined"}},
+                        {"gsub": {"field": "joined", "pattern": "-",
+                                  "replacement": "_"}}],
+                  {"csv": "a,b,c"})
+        assert out["csv"] == ["a", "b", "c"]
+        assert out["joined"] == "a_b_c"
+
+    def test_append(self, svc):
+        out = run(svc, [{"append": {"field": "tags", "value": ["x", "y"]}}],
+                  {"tags": "a"})
+        assert out["tags"] == ["a", "x", "y"]
+
+    def test_date_iso_and_unix(self, svc):
+        out = run(svc, [{"date": {"field": "t", "formats": ["ISO8601"]}}],
+                  {"t": "2026-07-30T12:00:00Z"})
+        assert out["@timestamp"].startswith("2026-07-30T12:00:00")
+        out2 = run(svc, [{"date": {"field": "t", "formats": ["UNIX"],
+                                   "target_field": "ts"}}],
+                   {"t": 0}, pid="p2")
+        assert out2["ts"].startswith("1970-01-01")
+
+    def test_json_kv_dot_expander(self, svc):
+        out = run(svc, [{"json": {"field": "blob"}},
+                        {"kv": {"field": "kv", "field_split": " ",
+                                "value_split": "="}},
+                        {"dot_expander": {"field": "a.b"}}],
+                  {"blob": json.dumps({"x": 1}), "kv": "k1=v1 k2=v2",
+                   "a.b": 9})
+        assert out["blob"] == {"x": 1}
+        assert out["k1"] == "v1" and out["k2"] == "v2"
+        assert out["a"]["b"] == 9
+
+    def test_script_processor(self, svc):
+        out = run(svc, [{"script": {
+            "source": "ctx['total'] = ctx['a'] + ctx['b'] * params.m",
+            "params": {"m": 10},
+        }}], {"a": 1, "b": 2})
+        assert out["total"] == 21
+
+    def test_drop_and_conditional(self, svc):
+        svc.put_pipeline("p", {"processors": [
+            {"drop": {"if": "ctx['status'] == 'spam'"}},
+            {"set": {"field": "kept", "value": True}},
+        ]})
+        assert svc.execute("p", {"status": "spam"}, "i", "1") is None
+        out = svc.execute("p", {"status": "ham"}, "i", "2")
+        assert out["kept"] is True
+
+    def test_fail_processor(self, svc):
+        with pytest.raises(IngestError) as ei:
+            run(svc, [{"fail": {"message": "bad doc {{id}}"}}], {"id": "x"})
+        assert "bad doc x" in str(ei.value)
+
+    def test_nested_pipeline(self, svc):
+        svc.put_pipeline("inner", {"processors": [
+            {"set": {"field": "inner_ran", "value": True}}]})
+        out = run(svc, [{"pipeline": {"name": "inner"}}], {})
+        assert out["inner_ran"] is True
+
+    def test_drop_in_nested_pipeline_drops_outer_doc(self, svc):
+        svc.put_pipeline("inner", {"processors": [{"drop": {}}]})
+        svc.put_pipeline("outer", {"processors": [
+            {"pipeline": {"name": "inner"}},
+            {"set": {"field": "should_not_run", "value": 1}},
+        ]})
+        assert svc.execute("outer", {"x": 1}, "i", "1") is None
+
+    def test_drop_in_pipeline_on_failure_drops(self, svc):
+        svc.put_pipeline("p", {
+            "processors": [{"fail": {"message": "boom"}}],
+            "on_failure": [{"drop": {}}],
+        })
+        assert svc.execute("p", {}, "i", "1") is None
+
+
+class TestFailureHandling:
+    def test_on_failure_processor_level(self, svc):
+        out = run(svc, [
+            {"rename": {"field": "missing", "target_field": "x",
+                        "on_failure": [
+                            {"set": {"field": "error_seen", "value": True}}]}},
+        ], {})
+        assert out["error_seen"] is True
+
+    def test_on_failure_pipeline_level(self, svc):
+        svc.put_pipeline("p", {
+            "processors": [{"fail": {"message": "boom"}}],
+            "on_failure": [{"set": {"field": "rescued", "value": 1}}],
+        })
+        out = svc.execute("p", {}, "i", "1")
+        assert out["rescued"] == 1
+
+    def test_ignore_failure(self, svc):
+        out = run(svc, [
+            {"rename": {"field": "missing", "target_field": "x",
+                        "ignore_failure": True}},
+            {"set": {"field": "after", "value": 1}},
+        ], {})
+        assert out["after"] == 1
+
+    def test_unknown_processor_rejected(self, svc):
+        with pytest.raises(IngestError):
+            svc.put_pipeline("p", {"processors": [{"nope": {}}]})
+
+
+class TestClusterIntegration:
+    @pytest.fixture
+    def cluster(self):
+        c = ClusterService()
+        yield c
+        c.close()
+
+    def test_default_pipeline_applied_on_index(self, cluster):
+        cluster.put_pipeline("stamp", {"processors": [
+            {"set": {"field": "stamped", "value": True}}]})
+        cluster.create_index("logs", {"settings": {
+            "number_of_shards": 1, "default_pipeline": "stamp"}})
+        idx = cluster.get_index("logs")
+        src = cluster.apply_ingest("logs", idx, {"msg": "hi"}, "1")
+        assert src == {"msg": "hi", "stamped": True}
+
+    def test_final_pipeline_runs_after(self, cluster):
+        cluster.put_pipeline("a", {"processors": [
+            {"set": {"field": "order", "value": "default"}}]})
+        cluster.put_pipeline("z", {"processors": [
+            {"set": {"field": "order", "value": "final"}}]})
+        cluster.create_index("logs", {"settings": {
+            "number_of_shards": 1, "default_pipeline": "a",
+            "final_pipeline": "z"}})
+        idx = cluster.get_index("logs")
+        out = cluster.apply_ingest("logs", idx, {}, "1")
+        assert out["order"] == "final"
+
+    def test_missing_pipeline_is_400(self, cluster):
+        cluster.create_index("logs", {"settings": {"number_of_shards": 1}})
+        idx = cluster.get_index("logs")
+        with pytest.raises(ClusterError) as ei:
+            cluster.apply_ingest("logs", idx, {}, "1", pipeline="nope")
+        assert ei.value.status == 400
+
+    def test_simulate(self, cluster):
+        out = cluster.simulate_pipeline(None, {
+            "pipeline": {"processors": [
+                {"uppercase": {"field": "w"}}]},
+            "docs": [{"_source": {"w": "hi"}},
+                     {"_source": {"w": 42}}],
+        })
+        assert out["docs"][0]["doc"]["_source"]["w"] == "HI"
+        assert "error" in out["docs"][1]
+
+    def test_pipelines_survive_restart(self, tmp_path):
+        c = ClusterService(data_path=str(tmp_path / "d"))
+        c.put_pipeline("keep", {"processors": [
+            {"set": {"field": "x", "value": 1}}]})
+        c.close()
+        c2 = ClusterService(data_path=str(tmp_path / "d"))
+        assert "keep" in c2.get_pipeline()
+        c2.close()

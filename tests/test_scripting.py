@@ -1,0 +1,231 @@
+"""Scripting: script_score / script query / function_score script /
+script_fields, plus the expression engine's sandbox.
+
+Reference analogs (SURVEY.md §2.1 Scripting, §3.4): ScriptService.compile,
+ScoreScript with doc-values + vector functions (the brute-force kNN
+path), ScriptQueryBuilder, script_fields fetch sub-phase.
+"""
+
+import math
+
+import pytest
+
+from elasticsearch_tpu.cluster.service import ClusterService
+from elasticsearch_tpu.script import ScriptError, ScriptService, script_service
+
+
+@pytest.fixture
+def cluster():
+    c = ClusterService()
+    c.create_index(
+        "s",
+        {
+            "settings": {"number_of_shards": 1},
+            "mappings": {
+                "properties": {
+                    "body": {"type": "text"},
+                    "rank": {"type": "integer"},
+                    "vec": {"type": "dense_vector", "dims": 3},
+                }
+            },
+        },
+    )
+    idx = c.get_index("s")
+    rows = [
+        ("a", "quick brown fox", 3, [1.0, 0.0, 0.0]),
+        ("b", "quick dog", 10, [0.0, 1.0, 0.0]),
+        ("c", "lazy fox", 5, [0.7, 0.7, 0.0]),
+        ("d", "quick quick fox", 1, [0.5, 0.5, 0.7]),
+    ]
+    for _id, body, rank, vec in rows:
+        idx.index_doc(_id, {"body": body, "rank": rank, "vec": vec})
+    idx.refresh()
+    yield c
+    c.close()
+
+
+class TestScriptScoreQuery:
+    def test_score_replaces_with_doc_value(self, cluster):
+        r = cluster.search(
+            "s",
+            {
+                "query": {
+                    "script_score": {
+                        "query": {"match": {"body": "quick"}},
+                        "script": {"source": "doc['rank'].value * 2"},
+                    }
+                }
+            },
+        )
+        hits = r["hits"]["hits"]
+        assert [h["_id"] for h in hits] == ["b", "a", "d"]
+        assert hits[0]["_score"] == 20.0
+
+    def test_params_and_score_binding(self, cluster):
+        r = cluster.search(
+            "s",
+            {
+                "query": {
+                    "script_score": {
+                        "query": {"match": {"body": "quick"}},
+                        "script": {
+                            "source": "_score * params.factor + doc['rank'].value",
+                            "params": {"factor": 0.0},
+                        },
+                    }
+                }
+            },
+        )
+        assert [h["_score"] for h in r["hits"]["hits"]] == [10.0, 3.0, 1.0]
+
+    def test_cosine_similarity_brute_force_knn(self, cluster):
+        """The reference's script_score brute-force kNN
+        (cosineSimilarity(params.query_vector, 'field') + 1.0)."""
+        r = cluster.search(
+            "s",
+            {
+                "query": {
+                    "script_score": {
+                        "query": {"match_all": {}},
+                        "script": {
+                            "source": "cosineSimilarity(params.qv, 'vec') + 1.0",
+                            "params": {"qv": [1.0, 0.0, 0.0]},
+                        },
+                    }
+                }
+            },
+        )
+        hits = r["hits"]["hits"]
+        assert hits[0]["_id"] == "a"
+        assert hits[0]["_score"] == pytest.approx(2.0)
+        by_id = {h["_id"]: h["_score"] for h in hits}
+        assert by_id["c"] == pytest.approx(1.0 + 0.7 / math.sqrt(0.98))
+
+    def test_min_score_filters(self, cluster):
+        r = cluster.search(
+            "s",
+            {
+                "query": {
+                    "script_score": {
+                        "query": {"match_all": {}},
+                        "script": {"source": "doc['rank'].value"},
+                        "min_score": 4,
+                    }
+                }
+            },
+        )
+        assert {h["_id"] for h in r["hits"]["hits"]} == {"b", "c"}
+
+
+class TestScriptQuery:
+    def test_filter_context(self, cluster):
+        r = cluster.search(
+            "s",
+            {
+                "query": {
+                    "bool": {
+                        "filter": [
+                            {"script": {"script": {
+                                "source": "doc['rank'].value >= params.min",
+                                "params": {"min": 4},
+                            }}}
+                        ]
+                    }
+                }
+            },
+        )
+        assert {h["_id"] for h in r["hits"]["hits"]} == {"b", "c"}
+
+
+class TestFunctionScoreScript:
+    def test_script_score_function(self, cluster):
+        r = cluster.search(
+            "s",
+            {
+                "query": {
+                    "function_score": {
+                        "query": {"match": {"body": "fox"}},
+                        "script_score": {
+                            "script": {"source": "doc['rank'].value"}
+                        },
+                        "boost_mode": "replace",
+                    }
+                }
+            },
+        )
+        assert [h["_id"] for h in r["hits"]["hits"]] == ["c", "a", "d"]
+
+
+class TestScriptFields:
+    def test_computed_fields(self, cluster):
+        r = cluster.search(
+            "s",
+            {
+                "query": {"term": {"_id_q": "x"}} if False else {"match": {"body": "dog"}},
+                "script_fields": {
+                    "double_rank": {"script": {"source": "doc['rank'].value * 2"}},
+                    "greeting": {"script": "'rank is ' + str(doc['rank'].value)"},
+                },
+            },
+        )
+        h = r["hits"]["hits"][0]
+        assert h["fields"]["double_rank"] == [20]
+        assert h["fields"]["greeting"] == ["rank is 10"]
+
+
+class TestSandbox:
+    def test_import_rejected(self):
+        svc = ScriptService()
+        with pytest.raises(ScriptError):
+            svc.compile({"source": "__import__('os').system('true')"}, "score")
+
+    def test_dunder_attr_rejected(self):
+        svc = ScriptService()
+        with pytest.raises(ScriptError):
+            svc.compile({"source": "().__class__"}, "score")
+
+    def test_unknown_attr_rejected(self):
+        svc = ScriptService()
+        with pytest.raises(ScriptError):
+            svc.compile({"source": "doc.popitem()"}, "score")
+
+    def test_compile_cache(self):
+        svc = ScriptService()
+        svc.compile({"source": "1 + 1"}, "score")
+        svc.compile({"source": "1 + 1"}, "score")
+        assert svc.stats["compilations"] == 1
+
+    def test_math_bindings(self):
+        out = script_service.run_score(
+            {"source": "Math.log(Math.E) + Math.min(1, 2)"}, lambda f: []
+        )
+        assert out == pytest.approx(2.0)
+
+    def test_math_assignment_rejected(self):
+        svc = ScriptService()
+        with pytest.raises(ScriptError):
+            svc.compile({"source": "Math.sqrt = 0"}, "ingest")
+
+    def test_unbounded_while_loop_limited(self):
+        svc = ScriptService()
+        with pytest.raises(ScriptError) as ei:
+            svc.run_ingest({"source": "while True:\n    pass"}, {})
+        assert "loop limit" in str(ei.value)
+
+    def test_huge_range_limited(self):
+        with pytest.raises(ScriptError) as ei:
+            script_service.run_score(
+                {"source": "sum(1 for _ in range(10**12))"}, lambda f: []
+            )
+        assert "loop limit" in str(ei.value)
+
+    def test_missing_value_raises(self, cluster):
+        with pytest.raises(Exception) as ei:
+            cluster.search(
+                "s",
+                {"query": {"script_score": {
+                    "query": {"match_all": {}},
+                    "script": {"source": "doc['nope'].value"},
+                }}},
+            )
+        assert "doesn't have a value" in str(ei.value)

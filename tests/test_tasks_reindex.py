@@ -1,0 +1,221 @@
+"""Tasks framework + reindex / update_by_query / delete_by_query.
+
+Reference analogs (SURVEY.md §2.1 Tasks, §2.3 reindex):
+TaskManager.register/cancelTaskAndDescendants, BulkByScrollTask,
+Reindexer, TransportUpdateByQueryAction, TransportDeleteByQueryAction.
+"""
+
+import json
+import time
+
+import pytest
+
+from elasticsearch_tpu.cluster.service import ClusterService
+from elasticsearch_tpu.reindex import delete_by_query, reindex, update_by_query
+from elasticsearch_tpu.rest.actions import RestActions
+from elasticsearch_tpu.tasks import TaskCancelledException, TaskManager
+
+
+@pytest.fixture
+def cluster():
+    c = ClusterService()
+    c.create_index("src", {"settings": {"number_of_shards": 2}})
+    idx = c.get_index("src")
+    for i in range(50):
+        idx.index_doc(
+            f"d{i}", {"body": f"doc number {i}", "n": i,
+                      "parity": "even" if i % 2 == 0 else "odd"}
+        )
+    idx.refresh()
+    yield c
+    c.close()
+
+
+def make_task(action="test"):
+    return TaskManager("n").register(action)
+
+
+class TestTaskManager:
+    def test_register_list_unregister(self):
+        tm = TaskManager("n0")
+        t = tm.register("indices:data/read/search", "desc")
+        assert tm.get(t.id) is t
+        assert [x.id for x in tm.list()] == [t.id]
+        assert tm.list("indices:data/write/*") == []
+        assert tm.list("indices:data/read/*") == [t]
+        tm.unregister(t)
+        assert tm.get(t.id) is None
+
+    def test_cancel_cascades_to_children(self):
+        tm = TaskManager("n0")
+        parent = tm.register("parent")
+        child = tm.register("child", parent_task_id=parent.id)
+        out = tm.cancel(parent.id)
+        assert {t.id for t in out} == {parent.id, child.id}
+        with pytest.raises(TaskCancelledException):
+            child.check_cancelled()
+
+    def test_completed_tasks_keep_response(self):
+        tm = TaskManager("n0")
+        t = tm.register("bg")
+        t.response = {"ok": 1}
+        tm.unregister(t, keep=True)
+        got = tm.get(t.id)
+        assert got.completed and got.response == {"ok": 1}
+
+
+class TestReindex:
+    def test_basic_copy(self, cluster):
+        r = reindex(cluster, {"source": {"index": "src"},
+                              "dest": {"index": "dst"}}, make_task())
+        assert r["created"] == 50
+        assert cluster.count("dst")["count"] == 50
+
+    def test_query_filter_and_max_docs(self, cluster):
+        r = reindex(cluster, {
+            "source": {"index": "src",
+                       "query": {"term": {"parity": "even"}}},
+            "dest": {"index": "dst"},
+            "max_docs": 10,
+        }, make_task())
+        assert r["created"] == 10
+        assert cluster.count("dst")["count"] == 10
+
+    def test_script_modifies_and_noops(self, cluster):
+        r = reindex(cluster, {
+            "source": {"index": "src"},
+            "dest": {"index": "dst"},
+            "script": {"source":
+                       "ctx['op'] = 'noop' if ctx['_source']['n'] >= 10 "
+                       "else ctx['op']\n"
+                       "ctx['_source']['n2'] = ctx['_source']['n'] * 2"},
+        }, make_task())
+        assert r["created"] == 10
+        assert r["noops"] == 40
+        doc = cluster.get_index("dst").get_doc("d3")
+        assert doc["_source"]["n2"] == 6
+
+    def test_dest_pipeline(self, cluster):
+        cluster.put_pipeline("mark", {"processors": [
+            {"set": {"field": "via", "value": "pipeline"}}]})
+        reindex(cluster, {"source": {"index": "src"},
+                          "dest": {"index": "dst", "pipeline": "mark"}},
+                make_task())
+        assert cluster.get_index("dst").get_doc("d0")["_source"]["via"] == "pipeline"
+
+    def test_op_type_create_with_conflicts_proceed(self, cluster):
+        cluster.create_index("dst")
+        cluster.get_index("dst").index_doc("d1", {"existing": True})
+        r = reindex(cluster, {
+            "source": {"index": "src"},
+            "dest": {"index": "dst", "op_type": "create"},
+            "conflicts": "proceed",
+        }, make_task())
+        assert r["created"] == 49
+        assert r["version_conflicts"] == 1
+
+
+class TestReindexMultiIndex:
+    def test_list_of_source_indices(self, cluster):
+        cluster.create_index("src2")
+        idx2 = cluster.get_index("src2")
+        for i in range(5):
+            idx2.index_doc(f"e{i}", {"body": f"extra {i}"})
+        idx2.refresh()
+        r = reindex(cluster, {"source": {"index": ["src", "src2"]},
+                              "dest": {"index": "dst"}}, make_task())
+        assert r["created"] == 55
+        assert cluster.count("dst")["count"] == 55
+
+
+class TestUpdateByQuery:
+    def test_size_means_max_docs(self, cluster):
+        r = update_by_query(cluster, "src", {
+            "size": 3,
+            "script": {"source": "ctx['_source']['touched'] = True"},
+        }, make_task())
+        assert r["updated"] == 3
+        touched = sum(
+            1 for i in range(50)
+            if cluster.get_index("src").get_doc(f"d{i}")["_source"].get("touched")
+        )
+        assert touched == 3
+
+    def test_script_update(self, cluster):
+        r = update_by_query(cluster, "src", {
+            "query": {"term": {"parity": "odd"}},
+            "script": {"source": "ctx['_source']['flagged'] = True"},
+        }, make_task())
+        assert r["updated"] == 25
+        assert cluster.get_index("src").get_doc("d1")["_source"]["flagged"] is True
+        assert "flagged" not in cluster.get_index("src").get_doc("d2")["_source"]
+
+    def test_script_delete_op(self, cluster):
+        r = update_by_query(cluster, "src", {
+            "query": {"range": {"n": {"lt": 5}}},
+            "script": {"source": "ctx['op'] = 'delete'"},
+        }, make_task())
+        assert r["deleted"] == 5
+        assert cluster.count("src")["count"] == 45
+
+
+class TestDeleteByQuery:
+    def test_deletes_matching(self, cluster):
+        r = delete_by_query(cluster, "src",
+                            {"query": {"term": {"parity": "even"}}},
+                            make_task())
+        assert r["deleted"] == 25
+        assert cluster.count("src")["count"] == 25
+
+    def test_requires_query(self, cluster):
+        from elasticsearch_tpu.cluster.service import ClusterError
+
+        with pytest.raises(ClusterError):
+            delete_by_query(cluster, "src", {}, make_task())
+
+
+class TestRestSurface:
+    @pytest.fixture
+    def actions(self, cluster):
+        return RestActions(cluster)
+
+    def test_reindex_endpoint(self, actions):
+        status, resp = actions.router.dispatch("POST", "/_reindex")[0].handler(
+            {"source": {"index": "src"}, "dest": {"index": "dst"}}, {}, {}
+        )
+        assert status == 200 and resp["created"] == 50
+
+    def test_background_task_lifecycle(self, actions, cluster):
+        route, params, _ = actions.router.dispatch(
+            "POST", "/src/_delete_by_query"
+        )
+        status, resp = route.handler(
+            {"query": {"match_all": {}}},
+            {"index": "src"},
+            {"wait_for_completion": ["false"]},
+        )
+        assert status == 200 and "task" in resp
+        tid = resp["task"]
+        deadline = time.time() + 10
+        while time.time() < deadline:
+            s, out = actions.get_task(None, {"task_id": tid}, {})
+            assert s == 200
+            if out["completed"]:
+                assert out["response"]["deleted"] == 50
+                break
+            time.sleep(0.05)
+        else:
+            raise AssertionError("background task never completed")
+
+    def test_tasks_listing_shape(self, actions, cluster):
+        t = cluster.tasks.register("indices:data/read/search", "x")
+        s, resp = actions.list_tasks(None, {}, {})
+        tasks = resp["nodes"][cluster.node_name]["tasks"]
+        assert t.id in tasks
+        cluster.tasks.unregister(t)
+
+    def test_cancel_endpoint(self, actions, cluster):
+        t = cluster.tasks.register("slow", "x")
+        s, resp = actions.cancel_task(None, {"task_id": t.id}, {})
+        assert t.is_cancelled()
+        cluster.tasks.unregister(t)
