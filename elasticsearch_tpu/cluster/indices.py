@@ -25,6 +25,7 @@ deployment shapes share this class:
 from __future__ import annotations
 
 import os
+import threading
 import time
 from concurrent.futures import ThreadPoolExecutor
 from typing import Any, Dict, List, Optional
@@ -56,6 +57,69 @@ ACTION_CTX_OPEN = "indices:data/read/ctx_open"
 ACTION_CTX_CLOSE = "indices:data/read/ctx_close"
 ACTION_SHARD_REPLICA_OPS = "indices:data/write/replica_ops"
 ACTION_SNAPSHOT_SHARD = "internal:snapshot/shard"
+ACTION_SHARD_DFS = "indices:data/read/dfs"
+
+
+def _dfs_terms(query, mappings, analysis) -> Dict[str, set]:
+    """field → scoring terms whose global statistics the DFS round must
+    gather (DfsPhase.execute walks the rewritten query's terms)."""
+    out: Dict[str, set] = {}
+
+    def add(field: str, terms) -> None:
+        out.setdefault(field, set()).update(terms)
+
+    def analyzed(field: str, text: str, override=None):
+        from ..index.mapping import TEXT
+
+        mf = mappings.get(field)
+        if mf is not None and mf.type != TEXT:
+            # match on keyword/numeric degrades to a term query at
+            # execution — stat the raw value
+            return [str(text)]
+        name = override or (
+            (mf.search_analyzer or mf.analyzer) if mf is not None else "standard"
+        )
+        try:
+            return analysis.get(name).terms(str(text))
+        except ValueError:
+            return [str(text)]
+
+    def walk(q) -> None:
+        if q is None:
+            return
+        if isinstance(q, (dsl.MatchQuery, dsl.MatchPhraseQuery)):
+            add(
+                q.field,
+                analyzed(q.field, q.query, getattr(q, "analyzer", None)),
+            )
+        elif isinstance(q, dsl.TermQuery):
+            add(q.field, [str(q.value)])
+        elif isinstance(q, dsl.TermsQuery):
+            add(q.field, [str(v) for v in q.values])
+        elif isinstance(q, dsl.MultiMatchQuery):
+            from ..search.executor import expand_match_fields
+
+            for fname, _ in expand_match_fields(mappings, q.fields):
+                add(fname, analyzed(fname, q.query))
+        elif isinstance(q, dsl.BoolQuery):
+            for sub in list(q.must) + list(q.should) + list(q.filter):
+                walk(sub)
+        elif isinstance(q, dsl.DisMaxQuery):
+            for sub in q.queries:
+                walk(sub)
+        elif isinstance(q, dsl.BoostingQuery):
+            walk(q.positive)
+        elif isinstance(q, dsl.ConstantScoreQuery):
+            walk(q.filter_query)
+        elif isinstance(q, (dsl.FunctionScoreQuery, dsl.ScriptScoreQuery)):
+            walk(q.query)
+        elif isinstance(q, dsl.QueryStringQuery):
+            from ..search.executor import rewrite_query_string
+
+            walk(rewrite_query_string(q, mappings))
+
+    walk(query)
+    return out
 
 
 def norm_shard_routing(entry) -> dict:
@@ -145,6 +209,7 @@ class IndexService:
             )
         # executor cache: shard id → (change_generation, executor)
         self._executors: Dict[int, tuple] = {}
+        self._executor_lock = threading.Lock()
         # created eagerly (its worker thread only starts on first submit)
         # so concurrent first searches can't race a lazy init
         from ..search.batcher import QueryBatcher
@@ -474,15 +539,25 @@ class IndexService:
         cached = self._executors.get(shard.shard_id)
         if cached is not None and cached[0] == shard.change_generation:
             return cached[1]
-        reader = shard.reader()
-        backend = str(self.settings.get("search.backend", "numpy"))
-        if backend == "jax":
-            from ..search.executor_jax import JaxExecutor
+        with self._executor_lock:
+            cached = self._executors.get(shard.shard_id)
+            if cached is not None and cached[0] == shard.change_generation:
+                return cached[1]
+            reader = shard.reader()
+            backend = str(self.settings.get("search.backend", "numpy"))
+            if backend == "jax":
+                from ..search.executor_jax import JaxExecutor
 
-            ex = JaxExecutor(reader)
-        else:
-            ex = NumpyExecutor(reader)
-        self._executors[shard.shard_id] = (shard.change_generation, ex)
+                ex = JaxExecutor(reader)
+            else:
+                ex = NumpyExecutor(reader)
+            old = self._executors.get(shard.shard_id)
+            self._executors[shard.shard_id] = (shard.change_generation, ex)
+        if old is not None and hasattr(old[1], "close"):
+            # release the stale generation's HBM ledger charges (an
+            # executor pinned by scroll/PIT contexts stops charging once
+            # closed — see JaxExecutor._charge)
+            old[1].close()
         return ex
 
     def shard_search_local(
@@ -545,52 +620,99 @@ class IndexService:
         td = None
         masks = None
         svals: List[list] = []
+        # DFS global statistics override for this request (context-
+        # scoped so executor caches stay shard-local)
+        dfs_stats = body.get("_dfs")
+        dfs_token = None
+        dfs_norm_token = None
+        if dfs_stats is not None:
+            from ..search.executor import DFS_NORM_CACHE, DFS_STATS
+
+            dfs_token = DFS_STATS.set(dfs_stats)
+            dfs_norm_token = DFS_NORM_CACHE.set({})
+        prof_phases: Optional[dict] = None
+        prof_token = None
+        if profile:
+            from ..search.executor import PROFILE_CTX
+
+            prof_phases = {}
+            prof_token = PROFILE_CTX.set(prof_phases)
         # ---- batched fast path: flat match plans on the jax backend go
         # through the cross-request micro-batching dispatcher (shared
-        # fixed-shape launches across concurrent requests) ----
+        # fixed-shape launches across concurrent requests). DFS requests
+        # skip it: their weights are request-specific, not cacheable ----
         if (
-            query is not None
-            and knn is None
-            and agg_nodes is None
+            agg_nodes is None
             and sort_specs is None
             and search_after is None
             and min_score is None
             and not profile
             and pinned_executor is None
+            and dfs_stats is None
             and str(self.settings.get("search.backend")) == "jax"
         ):
-            from ..search.batcher import extract_match_plan
+            from ..search.batcher import (
+                extract_knn_plan,
+                extract_match_plan,
+                extract_serve_plan,
+            )
             from ..search.executor_jax import JaxExecutor
 
             if isinstance(ex, JaxExecutor):
-                plan = extract_match_plan(query, self.mappings, self.analysis, tth)
+                plan = None
+                kind = "match"
+                if query is not None and knn is None:
+                    plan = extract_match_plan(
+                        query, self.mappings, self.analysis, tth
+                    )
+                    if plan is None:
+                        plan = extract_serve_plan(
+                            query, self.mappings, self.analysis
+                        )
+                        kind = "serve"
+                elif query is None and knn is not None:
+                    plan = extract_knn_plan(knn, self.mappings)
+                    kind = "knn"
                 if plan is not None:
                     try:
-                        td = self._batcher.execute(ex, plan, k)
+                        td = self._batcher.execute(
+                            ex, plan, k, kind=kind, query=query
+                        )
                     except RuntimeError:
                         td = None  # batcher closed mid-request → unbatched
-        if td is None:
-            if sort_specs is not None:
-                oracle = ex if isinstance(ex, NumpyExecutor) else ex._oracle
-                td, masks, svals = oracle.execute_sorted(
-                    query,
-                    sort_specs,
-                    size=k,
-                    from_=0,
-                    knn=knn,
-                    min_score=min_score,
-                    search_after=search_after,
-                )
-            else:
-                td, masks = ex.execute(
-                    query, size=k, from_=0, knn=knn, min_score=min_score
-                )
-        agg_partial = None
-        if agg_nodes is not None:
-            from ..search.aggs import AggCollector
+        try:
+            if td is None:
+                if sort_specs is not None:
+                    oracle = ex if isinstance(ex, NumpyExecutor) else ex._oracle
+                    td, masks, svals = oracle.execute_sorted(
+                        query,
+                        sort_specs,
+                        size=k,
+                        from_=0,
+                        knn=knn,
+                        min_score=min_score,
+                        search_after=search_after,
+                    )
+                else:
+                    td, masks = ex.execute(
+                        query, size=k, from_=0, knn=knn, min_score=min_score
+                    )
+            agg_partial = None
+            if agg_nodes is not None:
+                from ..search.aggs import AggCollector
 
-            oracle = ex if isinstance(ex, NumpyExecutor) else ex._oracle
-            agg_partial = AggCollector(oracle).collect(agg_nodes, masks)
+                oracle = ex if isinstance(ex, NumpyExecutor) else ex._oracle
+                agg_partial = AggCollector(oracle).collect(agg_nodes, masks)
+        finally:
+            if dfs_token is not None:
+                from ..search.executor import DFS_NORM_CACHE, DFS_STATS
+
+                DFS_STATS.reset(dfs_token)
+                DFS_NORM_CACHE.reset(dfs_norm_token)
+            if prof_token is not None:
+                from ..search.executor import PROFILE_CTX
+
+                PROFILE_CTX.reset(prof_token)
 
         # ---- folded fetch phase: sources + highlight for this shard's
         # candidates (FetchPhase, SURVEY.md §3.3) ----
@@ -652,8 +774,17 @@ class IndexService:
             out["aggs"] = agg_partial
         if profile:
             # per-shard query-phase breakdown ("profile": true —
-            # Profilers/QueryProfiler response shape, device+host time)
+            # Profilers/QueryProfiler response shape). The breakdown
+            # separates DEVICE kernel time (everything queued up to the
+            # block_until_ready barrier), device→host TRANSFER time, and
+            # host merge time (SURVEY §5: "per-kernel device times …
+            # in the same response shape").
             elapsed = time.perf_counter_ns() - ts
+            phases = prof_phases or {}
+            device_ns = int(phases.get("device_scoring_ns", 0))
+            transfer_ns = int(phases.get("device_transfer_ns", 0))
+            merge_ns = int(phases.get("host_merge_ns", 0))
+            accounted = device_ns + transfer_ns + merge_ns
             out["profile"] = {
                 "id": f"[{self.uuid}][{self.name}][{sid}]",
                 "searches": [
@@ -668,7 +799,12 @@ class IndexService:
                                 ),
                                 "time_in_nanos": elapsed,
                                 "breakdown": {
-                                    "score": elapsed,
+                                    "device_scoring": device_ns,
+                                    "device_transfer": transfer_ns,
+                                    "host_merge": merge_ns,
+                                    "host_other": max(
+                                        0, elapsed - accounted
+                                    ),
                                     "backend": str(
                                         self.settings.get("search.backend")
                                     ),
@@ -680,7 +816,7 @@ class IndexService:
                             {
                                 "name": "SimpleTopDocsCollector",
                                 "reason": "search_top_hits",
-                                "time_in_nanos": elapsed,
+                                "time_in_nanos": merge_ns or elapsed,
                             }
                         ],
                     }
@@ -688,6 +824,67 @@ class IndexService:
                 "aggregations": [],
             }
         return out
+
+    # ---- DFS phase (search_type=dfs_query_then_fetch) ----
+
+    def shard_dfs_local(self, sid: int, spec: Dict[str, List[str]]) -> dict:
+        """One shard's term/field statistics for the DFS round
+        (DfsPhase.execute → DfsSearchResult)."""
+        ex = self._executor(self.local_shard(sid))
+        reader = ex.reader
+        fields: Dict[str, list] = {}
+        terms: Dict[str, dict] = {}
+        for f, ts in spec.items():
+            dc, ttf = reader.field_stats(f)
+            fields[f] = [dc, ttf]
+            terms[f] = {t: reader.term_stats(f, t)[0] for t in ts}
+        return {"fields": fields, "terms": terms}
+
+    def _dfs_round(self, body: dict) -> Optional[dict]:
+        """Aggregates df/doc_count/sum_ttf across every shard for the
+        query's terms (SearchPhaseController.aggregateDfs); the result
+        rides the per-shard request as `_dfs` and overrides shard-local
+        statistics during scoring."""
+        if "query" not in body:
+            return None
+        try:
+            q = dsl.parse_query(body["query"])
+        except dsl.QueryParseError:
+            return None
+        wanted = _dfs_terms(q, self.mappings, self.analysis)
+        if not wanted:
+            return None
+        spec = {f: sorted(ts) for f, ts in wanted.items()}
+
+        def one(sid: int) -> dict:
+            owner = self._search_node(sid)
+            if owner is None or owner == self.local_node:
+                return self.shard_dfs_local(sid, spec)
+            return self.remote_call(
+                owner,
+                ACTION_SHARD_DFS,
+                {"index": self.name, "shard": sid, "spec": spec},
+            )
+
+        agg_fields = {f: [0, 0] for f in spec}
+        agg_terms: Dict[str, Dict[str, int]] = {
+            f: {t: 0 for t in ts} for f, ts in spec.items()
+        }
+        if self.num_shards == 1:
+            results = [one(0)]
+        else:
+            futs = [
+                _FANOUT_POOL.submit(one, sid) for sid in range(self.num_shards)
+            ]
+            results = [f.result() for f in futs]
+        for r in results:
+            for f, (dc, ttf) in r["fields"].items():
+                agg_fields[f][0] += int(dc)
+                agg_fields[f][1] += int(ttf)
+            for f, tmap in r["terms"].items():
+                for t, df in tmap.items():
+                    agg_terms[f][t] += int(df)
+        return {"fields": agg_fields, "terms": agg_terms}
 
     def shard_count_local(self, sid: int, body: Optional[dict]) -> dict:
         body = body or {}
@@ -820,6 +1017,10 @@ class IndexService:
 
         # every shard returns the full global page's worth of hits
         sub = {**body, "from": 0, "size": from_ + size}
+        if body.get("search_type") == "dfs_query_then_fetch":
+            dfs = self._dfs_round(body)
+            if dfs is not None:
+                sub["_dfs"] = dfs
         shard_results = self._fan_out(sub, pinned_executors)
 
         # ---- coordinator reduce (SearchPhaseController.reducedQueryPhase:

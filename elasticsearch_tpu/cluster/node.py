@@ -42,6 +42,7 @@ from .indices import (
     ACTION_CTX_CLOSE,
     ACTION_CTX_OPEN,
     ACTION_SHARD_COUNT,
+    ACTION_SHARD_DFS,
     ACTION_SHARD_FLUSH,
     ACTION_SHARD_GET,
     ACTION_SHARD_OPS,
@@ -507,6 +508,7 @@ class TpuNode:
         t.register_handler(ACTION_SHARD_GET, self._handle_get)
         t.register_handler(ACTION_SHARD_SEARCH, self._handle_search_shard)
         t.register_handler(ACTION_SHARD_COUNT, self._handle_count_shard)
+        t.register_handler(ACTION_SHARD_DFS, self._handle_dfs_shard)
         t.register_handler(ACTION_CTX_OPEN, self._handle_ctx_open)
         t.register_handler(ACTION_CTX_CLOSE, self._handle_ctx_close)
         t.register_handler(ACTION_SHARD_REPLICA_OPS, self._handle_replica_ops)
@@ -1391,6 +1393,10 @@ class TpuNode:
     def _handle_count_shard(self, p: dict) -> dict:
         idx = self._index_service(p["index"])
         return idx.shard_count_local(int(p["shard"]), p.get("body"))
+
+    def _handle_dfs_shard(self, p: dict) -> dict:
+        idx = self._index_service(p["index"])
+        return idx.shard_dfs_local(int(p["shard"]), p.get("spec") or {})
 
     # ---- pinned reader contexts (scroll/PIT across nodes) ----
 

@@ -1,0 +1,115 @@
+"""Device-memory accounting: the HBM ledger + circuit breaker.
+
+Reference analogs: HierarchyCircuitBreakerService (parent + child
+breakers; CircuitBreakingException → HTTP 429) and the fielddata /
+request breakers (SURVEY.md §2.1 Memory management row). The TPU-native
+resource is HBM: device-resident postings tiles, doc-value columns,
+vectors, norm caches, and dense hot-term rows all charge the ledger at
+upload. When a WOULD-BE upload cannot fit, the allocator either
+degrades (dense hot rows are an optimization — the chunked scorer path
+covers correctness without them) or trips the breaker.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from typing import Dict
+
+
+class CircuitBreakingException(Exception):
+    """es analog: circuit_breaking_exception, HTTP 429."""
+
+    def __init__(self, reason: str, bytes_wanted: int, limit: int):
+        super().__init__(reason)
+        self.reason = reason
+        self.bytes_wanted = bytes_wanted
+        self.limit = limit
+        self.status = 429
+        self.err_type = "circuit_breaking_exception"
+
+
+def _default_budget() -> int:
+    # v5e has 16 GiB HBM; leave headroom for XLA scratch + accumulators.
+    # Overridable for tests and other parts.
+    env = os.environ.get("ES_TPU_HBM_BUDGET_BYTES")
+    if env:
+        return int(env)
+    return 12 * 1024**3
+
+
+class HbmLedger:
+    """Byte accounting per category with a hard budget.
+
+    Not a malloc hook — JAX owns real allocation. This tracks the
+    framework's OWN resident uploads (the analog of ES accounting its
+    own BigArrays rather than the JVM heap) so admission control can
+    refuse or degrade before the device OOMs.
+    """
+
+    def __init__(self, budget: int | None = None):
+        self.budget = budget if budget is not None else _default_budget()
+        self._lock = threading.Lock()
+        self._by_category: Dict[str, int] = {}
+        self.stats_counters = {"tripped": 0, "degraded": 0}
+
+    @property
+    def used(self) -> int:
+        with self._lock:
+            return sum(self._by_category.values())
+
+    def would_fit(self, nbytes: int) -> bool:
+        return self.used + nbytes <= self.budget
+
+    def add(self, category: str, nbytes: int, breaker: bool = True) -> None:
+        """Charges the ledger; raises CircuitBreakingException when the
+        budget would be exceeded and `breaker` is set (non-breaker adds
+        record overage instead — better a tracked overage than a lying
+        ledger)."""
+        with self._lock:
+            used = sum(self._by_category.values())
+            if breaker and used + nbytes > self.budget:
+                self.stats_counters["tripped"] += 1
+                raise CircuitBreakingException(
+                    f"[hbm] Data too large: would use "
+                    f"{used + nbytes} bytes, limit {self.budget}",
+                    bytes_wanted=nbytes,
+                    limit=self.budget,
+                )
+            self._by_category[category] = (
+                self._by_category.get(category, 0) + nbytes
+            )
+
+    def release(self, category: str, nbytes: int) -> None:
+        with self._lock:
+            left = self._by_category.get(category, 0) - nbytes
+            if left <= 0:
+                self._by_category.pop(category, None)
+            else:
+                self._by_category[category] = left
+
+    def note_degraded(self) -> None:
+        with self._lock:
+            self.stats_counters["degraded"] += 1
+
+    def stats(self) -> dict:
+        with self._lock:
+            used = sum(self._by_category.values())
+            return {
+                "limit_size_in_bytes": self.budget,
+                "estimated_size_in_bytes": used,
+                "by_category": dict(self._by_category),
+                "tripped": self.stats_counters["tripped"],
+                "degraded_allocations": self.stats_counters["degraded"],
+            }
+
+
+# process-wide ledger (one device per process in this deployment shape)
+hbm_ledger = HbmLedger()
+
+
+def array_nbytes(a) -> int:
+    try:
+        return int(a.nbytes)
+    except AttributeError:
+        return 0

@@ -457,6 +457,183 @@ def _fused_query(doc_ids, tfs, inv_norm, live, dense, plan, t_rare, n_hot, k, wi
     )
 
 
+# ---------------------------------------------------------------------------
+# Multi-field fused scorer — round-5 extension of the single-round-trip
+# design to the remaining BASELINE shapes:
+#
+#   * bool must/should multi-term on one field  → per-slot REQUIRED flags
+#     (must terms count toward the match threshold, should terms only
+#     score). The flag rides the SIGN of the packed weight: w > 0 counts,
+#     w < 0 scores with |w| but does not count. ES analog: BooleanQuery's
+#     required vs optional scorers in ConjunctionDISI/WANDScorer.
+#   * multi_match title/body → one program scores F fields (each with its
+#     own postings/norms/dense rows) and combines per-field accumulators:
+#     "sum" = most_fields, "max_tie" = best_fields/dis_max
+#     (DisjunctionMaxQuery: max + tie_breaker * (sum - max)).
+#
+# Everything else follows the single-field fused design: one packed
+# int32 plan upload, whole query phase on device, one packed download.
+# ---------------------------------------------------------------------------
+
+
+class MultiFusedScorer:
+    """One-call batched BM25 query phase over one segment and F fields.
+
+    Per-field plan section (int32[2*T + 2*H]): rare tile ids + signed
+    float32 weights (bitcast) + dense hot row ids + signed hot weights.
+    Trailing int32: msm (count threshold over POSITIVE-weight slots).
+    """
+
+    def __init__(self, fields, parts, live, t_rare=FUSED_T_RARE,
+                 n_hot_slots=FUSED_H):
+        # parts: per field dict(doc_ids, tfs, inv_norm, dense, hot_rank)
+        self.fields = tuple(fields)
+        self.parts = parts
+        self.live = jnp.asarray(live) if live is not None else None
+        self.n_docs = int(parts[0]["inv_norm"].shape[0])
+        self.t_rare = t_rare
+        self.n_hot_slots = n_hot_slots
+
+    def pack_plans(self, plans) -> np.ndarray:
+        """plans: per job, a list of F per-field tuples
+        (rare_tiles i64[], rare_w_signed f32[], hot_ranks i64[],
+        hot_w_signed f32[]) plus a trailing msm int."""
+        T, H = self.t_rare, self.n_hot_slots
+        F = len(self.fields)
+        sec = 2 * T + 2 * H
+        out = np.full((BPAD, F * sec + 1), -1, np.int32)
+        for f in range(F):
+            base = f * sec
+            out[:, base + T: base + 2 * T] = 0
+            out[:, base + 2 * T + H: base + sec] = 0
+        out[:, F * sec] = 0
+        fout = out.view(np.float32)
+        for j, (field_plans, msm) in enumerate(plans):
+            for f, (rt, rw, hr, hw) in enumerate(field_plans):
+                base = f * sec
+                nt, nh = len(rt), len(hr)
+                out[j, base: base + nt] = rt
+                fout[j, base + T: base + T + nt] = rw
+                out[j, base + 2 * T: base + 2 * T + nh] = hr
+                fout[j, base + 2 * T + H: base + 2 * T + H + nh] = hw
+            out[j, F * sec] = msm
+        return out
+
+    def search(self, plans, k: int, combine: str, tie: float):
+        k = min(k, self.n_docs)
+        packed = self.pack_plans(plans)
+        out = np.asarray(
+            _fused_query_mf(
+                tuple(p["doc_ids"] for p in self.parts),
+                tuple(p["tfs"] for p in self.parts),
+                tuple(p["inv_norm"] for p in self.parts),
+                tuple(p["dense"] for p in self.parts),
+                self.live,
+                jax.device_put(packed),
+                jnp.float32(tie),
+                t_rare=self.t_rare,
+                n_hot=self.n_hot_slots,
+                k=k,
+                combine=combine,
+            )
+        )
+        scores = out[:, :k].copy().view(np.float32)
+        docs = out[:, k: 2 * k]
+        totals = out[:, 2 * k].astype(np.int64)
+        return scores, docs, totals
+
+
+@functools.partial(
+    jax.jit, static_argnames=("t_rare", "n_hot", "k", "combine")
+)
+def _fused_query_mf(
+    doc_ids_f, tfs_f, inv_norm_f, dense_f, live, plan, tie,
+    t_rare, n_hot, k, combine,
+):
+    F = len(doc_ids_f)
+    n = inv_norm_f[0].shape[0]
+    T, H = t_rare, n_hot
+    sec = 2 * T + 2 * H
+    B = plan.shape[0]
+    msm = plan[:, F * sec]
+    cnt = jnp.zeros((B, n + 1), jnp.int32)
+    accs = []
+    for f in range(F):
+        base = f * sec
+        rare_ti = plan[:, base: base + T]
+        rare_tw = jax.lax.bitcast_convert_type(
+            plan[:, base + T: base + 2 * T], jnp.float32
+        )
+        hot_ids = plan[:, base + 2 * T: base + 2 * T + H]
+        hot_w = jax.lax.bitcast_convert_type(
+            plan[:, base + 2 * T + H: base + sec], jnp.float32
+        )
+        doc_ids, tfs, inv_norm, dense = (
+            doc_ids_f[f], tfs_f[f], inv_norm_f[f], dense_f[f]
+        )
+        # rare terms: tile gather + scatter-add; |w| scores, w>0 counts
+        tile_ok = rare_ti >= 0
+        rows_d = doc_ids[jnp.clip(rare_ti, 0, doc_ids.shape[0] - 1)]
+        rows_t = tfs[jnp.clip(rare_ti, 0, doc_ids.shape[0] - 1)]
+        valid = (rows_d >= 0) & tile_ok[:, :, None]
+        tgt = jnp.where(valid, rows_d, n)
+        inv = inv_norm[jnp.clip(rows_d, 0, n - 1)]
+        w = jnp.abs(rare_tw)[:, :, None]
+        s = w - w / (jnp.float32(1.0) + rows_t.astype(jnp.float32) * inv)
+        s = jnp.where(valid, s, 0.0)
+        acc = jnp.zeros((B, n + 1), jnp.float32)
+        acc = jax.vmap(lambda a, d, v: a.at[d.ravel()].add(v.ravel()))(
+            acc, tgt, s
+        )
+        counted = valid & (rare_tw > 0)[:, :, None]
+        cnt = jax.vmap(
+            lambda c, d, v: c.at[d.ravel()].add(v.ravel().astype(jnp.int32))
+        )(cnt, tgt, counted)
+        acc = acc[:, :n]
+        # hot terms: dense rows
+        if dense is not None and dense.shape[0] > 0:
+            for h in range(H):
+                hid = hot_ids[:, h]
+                ok = hid >= 0
+                row_tf = dense[jnp.clip(hid, 0, dense.shape[0] - 1)].astype(
+                    jnp.float32
+                )
+                wa = jnp.where(ok, jnp.abs(hot_w[:, h]), 0.0)[:, None]
+                contrib = wa - wa / (
+                    jnp.float32(1.0) + row_tf * inv_norm[None, :]
+                )
+                match = (row_tf > 0) & ok[:, None]
+                acc = acc + jnp.where(match, contrib, 0.0)
+                counted_h = match & (hot_w[:, h] > 0)[:, None]
+                cnt = cnt.at[:, :n].add(counted_h.astype(jnp.int32))
+        accs.append(acc)
+    cnt = cnt[:, :n]
+    if F == 1:
+        combined = accs[0]
+    elif combine == "sum":
+        combined = accs[0]
+        for a in accs[1:]:
+            combined = combined + a
+    else:  # max_tie (DisjunctionMaxQuery)
+        stack = jnp.stack(accs)
+        best = stack.max(axis=0)
+        combined = best + tie * (stack.sum(axis=0) - best)
+    mask = cnt >= jnp.maximum(msm, 1)[:, None]
+    if live is not None:
+        mask = mask & live[None, :]
+    masked = jnp.where(mask, combined, -jnp.inf)
+    top_s, top_d = jax.lax.top_k(masked, k)
+    totals = mask.sum(axis=1, dtype=jnp.int32)
+    return jnp.concatenate(
+        [
+            jax.lax.bitcast_convert_type(top_s, jnp.int32),
+            top_d,
+            totals[:, None],
+        ],
+        axis=1,
+    )
+
+
 # ---------------- kNN ----------------
 
 
@@ -487,6 +664,28 @@ def knn_scores(
         else:
             raise ValueError(f"unknown similarity [{similarity}]")
     return scores.astype(jnp.float32)
+
+
+@functools.partial(jax.jit, static_argnames=("similarity", "k"))
+def knn_topk_batch(
+    queries: jax.Array,  # float32[BPAD, d] (padded rows are zeros)
+    valid: jax.Array,  # bool[BPAD] real rows
+    vectors: jax.Array,  # float32[N, d]
+    exists: jax.Array,  # bool[N]
+    similarity: str,
+    k: int,
+) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """Serving-path batched brute-force kNN: one MXU matmul scores BPAD
+    concurrent queries against a whole segment, one packed download
+    (scores[B,k], docs[B,k], totals[B]). The batch dimension rides the
+    matmul's M axis — the fused-scorer recipe applied to vectors
+    (BASELINE config 4)."""
+    scores = knn_scores(queries, vectors, similarity)
+    mask = exists[None, :] & valid[:, None]
+    masked = jnp.where(mask, scores, -jnp.inf)
+    s, d = jax.lax.top_k(masked, k)
+    totals = mask.sum(axis=1, dtype=jnp.int32)
+    return s, d, totals
 
 
 @functools.partial(jax.jit, static_argnames=("similarity", "k"))

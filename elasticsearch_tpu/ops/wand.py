@@ -101,6 +101,18 @@ def get_tiling(
     if tiling is None:
         tiling = _build_tiling(pf, n_docs, block_size, hot_min_postings_per_block)
         cache[key] = tiling
+        # charge the HBM ledger for the device-resident retiled postings;
+        # the tiling lives as long as its (immutable) PostingsField, so
+        # the release is tied to the tiling's own GC
+        import weakref
+
+        from ..common.memory import hbm_ledger
+
+        nbytes = int(tiling.doc_ids.nbytes) + int(tiling.tfs.nbytes)
+        hbm_ledger.add("postings_tiles", nbytes, breaker=False)
+        weakref.finalize(
+            tiling, hbm_ledger.release, "postings_tiles", nbytes
+        )
     return tiling
 
 

@@ -378,8 +378,28 @@ class RestActions:
     def nodes_stats(self, body, params, qs):
         import resource
 
+        from ..common.memory import hbm_ledger
+
         ru = resource.getrusage(resource.RUSAGE_SELF)
         total_docs = sum(i.num_docs for i in self.cluster.indices.values())
+        hbm = hbm_ledger.stats()
+        # batcher dispatch counters across indices (threadpool analog:
+        # queue/rejected for the `search` pool)
+        batch = {
+            "jobs": 0, "launches": 0, "rejected": 0, "fused_jobs": 0,
+            "pruned_jobs": 0, "fused_overflow_jobs": 0,
+        }
+        queue_capacity = 0
+        for idx in self.cluster.indices.values():
+            b = getattr(idx, "_batcher", None)
+            if b is not None:
+                for k in batch:
+                    batch[k] += b.stats.get(k, 0)
+                queue_capacity = max(queue_capacity, b._queue.maxsize)
+        if queue_capacity == 0:
+            from ..search.batcher import QUEUE_CAPACITY
+
+            queue_capacity = QUEUE_CAPACITY
         return 200, {
             "cluster_name": self.cluster.cluster_name,
             "nodes": {
@@ -394,6 +414,32 @@ class RestActions:
                     "process": {
                         "open_file_descriptors": 0,
                         "max_file_descriptors": 0,
+                    },
+                    "breakers": {
+                        "hbm": {
+                            "limit_size_in_bytes": hbm["limit_size_in_bytes"],
+                            "estimated_size_in_bytes": hbm[
+                                "estimated_size_in_bytes"
+                            ],
+                            "tripped": hbm["tripped"],
+                            "by_category": hbm["by_category"],
+                            "degraded_allocations": hbm[
+                                "degraded_allocations"
+                            ],
+                        }
+                    },
+                    "thread_pool": {
+                        "search": {
+                            "queue_capacity": queue_capacity,
+                            "completed": batch["jobs"],
+                            "rejected": batch["rejected"],
+                            "launches": batch["launches"],
+                            "fused_jobs": batch["fused_jobs"],
+                            "pruned_jobs": batch["pruned_jobs"],
+                            "fused_overflow_jobs": batch[
+                                "fused_overflow_jobs"
+                            ],
+                        }
                     },
                     "uptime_in_millis": int(
                         (time.time() - self.started_at) * 1000
@@ -703,6 +749,8 @@ class RestActions:
         if "q" in qs:
             # query_string lite: field:value or plain terms on all text fields
             body["query"] = _parse_q_param(qs["q"][0])
+        if "search_type" in qs:
+            body["search_type"] = qs["search_type"][0]
         if "scroll" in qs:
             targets = self.cluster.resolve(params["index"])
             if len(targets) != 1:

@@ -20,9 +20,11 @@ from typing import Optional
 from urllib.parse import parse_qs, unquote, urlparse
 
 from ..cluster import ClusterError, ClusterService
+from ..common.memory import CircuitBreakingException
 from ..index.engine import EngineError, VersionConflictError
 from ..index.mapping import MappingParseError
 from ..search.aggs import AggParseError
+from ..search.batcher import EsRejectedExecutionError
 from ..search.dsl import QueryParseError
 from .actions import RestActions
 from .router import error_body
@@ -105,6 +107,16 @@ class ElasticHandler(BaseHTTPRequestHandler):
             )
         except (QueryParseError, MappingParseError, AggParseError) as e:
             status, payload = 400, error_body(400, "parsing_exception", str(e))
+        except EsRejectedExecutionError as e:
+            # bounded-queue overflow → 429, the ThreadPool rejection
+            # contract (EsRejectedExecutionException)
+            status, payload = 429, error_body(
+                429, "es_rejected_execution_exception", str(e)
+            )
+        except CircuitBreakingException as e:
+            status, payload = 429, error_body(
+                429, "circuit_breaking_exception", str(e)
+            )
         except EngineError as e:
             status, payload = 500, error_body(500, "engine_exception", str(e))
         except json.JSONDecodeError as e:

@@ -130,6 +130,29 @@ class ShardReader:
         return sum(s.num_docs for s in self.segments)
 
 
+import contextvars
+
+# global term statistics for the CURRENT request in DFS mode:
+# {"fields": {field: [doc_count, sum_ttf]},
+#  "terms": {field: {term: doc_freq}}}
+DFS_STATS: contextvars.ContextVar = contextvars.ContextVar(
+    "dfs_stats", default=None
+)
+
+# per-request device-array cache for DFS norm uploads (kept OUT of the
+# DFS stats dict, which rides the wire as JSON)
+DFS_NORM_CACHE: contextvars.ContextVar = contextvars.ContextVar(
+    "dfs_norm_cache", default=None
+)
+
+# "profile": true phase accounting for the CURRENT request: executors
+# add device_scoring_ns / device_transfer_ns / host_merge_ns entries
+# (the per-kernel breakdown SURVEY §5 asks profile=true to return)
+PROFILE_CTX: contextvars.ContextVar = contextvars.ContextVar(
+    "profile_ctx", default=None
+)
+
+
 class NumpyExecutor:
     """The oracle: executes a query tree densely per segment."""
 
@@ -141,8 +164,19 @@ class NumpyExecutor:
         self._norm_cache: Dict[str, np.ndarray] = {}
 
     # ---- term weight / norm cache (BM25Similarity.scorer) ----
+    #
+    # DFS mode (search_type=dfs_query_then_fetch): the coordinator's
+    # aggregated cross-shard statistics ride a request-scoped context
+    # variable (DFS_STATS) and override the shard-local stats WITHOUT
+    # touching the per-executor caches (SearchPhaseController
+    # .aggregateDfs feeding Weight creation, SURVEY §2.1 DFS row).
 
     def _field_cache(self, field: str) -> np.ndarray:
+        dfs = DFS_STATS.get()
+        if dfs is not None and field in dfs.get("fields", {}):
+            dc, ttf = dfs["fields"][field]
+            avgdl = bm25.avg_field_length(ttf, dc)
+            return bm25.norm_inverse_cache(avgdl, self.k1, self.b)
         cache = self._norm_cache.get(field)
         if cache is None:
             dc, ttf = self.reader.field_stats(field)
@@ -152,6 +186,14 @@ class NumpyExecutor:
         return cache
 
     def _term_weight(self, field: str, term: str) -> float:
+        dfs = DFS_STATS.get()
+        if dfs is not None and field in dfs.get("fields", {}):
+            df = dfs.get("terms", {}).get(field, {}).get(term)
+            if df is not None:
+                dc, _ = dfs["fields"][field]
+                return float(bm25.idf(dc, df)) if df > 0 else 0.0
+            # a term the DFS walker missed (analyzer edge) falls back to
+            # shard-local stats rather than silently scoring 0
         key = (field, term)
         w = self._weight_cache.get(key)
         if w is None:

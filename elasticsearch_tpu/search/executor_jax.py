@@ -14,6 +14,7 @@ north star's "posting lists block-decoded once into HBM-resident arrays".
 from __future__ import annotations
 
 import threading
+import time
 from typing import Dict, List, Optional, Tuple
 
 import jax
@@ -57,17 +58,33 @@ class DevicePostings:
         self.tfs = jax.device_put(pf.tfs, device)
 
 
+def _tree_nbytes(v) -> int:
+    if hasattr(v, "nbytes"):
+        return int(v.nbytes)
+    if isinstance(v, (tuple, list)):
+        return sum(_tree_nbytes(x) for x in v)
+    if hasattr(v, "__dict__"):
+        return sum(
+            int(x.nbytes) for x in vars(v).values() if hasattr(x, "nbytes")
+        )
+    return 0
+
+
 class _LazyDeviceMap:
     """Per-field device uploads, materialized on first use. Uploading
     every field of every segment eagerly (round 2) burns HBM and makes
     executor regeneration after refresh O(index) instead of O(touched
-    fields)."""
+    fields). Every upload charges the HBM ledger; `charge` is a
+    (category, nbytes, breaker) recorder owned by the executor so
+    close() can release exactly what was charged."""
 
-    def __init__(self, names, build):
+    def __init__(self, names, build, charge=None, category="other"):
         self._names = set(names)
         self._build = build
         self._cache: Dict[str, object] = {}
         self._lock = threading.Lock()
+        self._charge = charge
+        self._category = category
 
     def get(self, name, default=None):
         if name not in self._names:
@@ -78,6 +95,8 @@ class _LazyDeviceMap:
                 v = self._cache.get(name)
                 if v is None:
                     v = self._build(name)
+                    if self._charge is not None:
+                        self._charge(self._category, _tree_nbytes(v), False)
                     self._cache[name] = v
         return v
 
@@ -91,11 +110,12 @@ class _LazyDeviceMap:
 class DeviceSegment:
     """Device-resident mirror of a Segment's hot arrays (lazy per field)."""
 
-    def __init__(self, seg: Segment, device=None):
+    def __init__(self, seg: Segment, device=None, charge=None):
         self.seg = seg
         self.device = device
         self.postings = _LazyDeviceMap(
-            seg.postings, lambda f: DevicePostings(seg.postings[f], device)
+            seg.postings, lambda f: DevicePostings(seg.postings[f], device),
+            charge=charge, category="postings",
         )
         self.numerics = _LazyDeviceMap(
             seg.numerics,
@@ -103,12 +123,29 @@ class DeviceSegment:
                 jax.device_put(seg.numerics[f].values, device),
                 jax.device_put(seg.numerics[f].exists, device),
             ),
+            charge=charge, category="doc_values",
         )
 
         def _vec(f):
             vf = seg.vectors[f]
             mat = vf.unit_vectors if vf.similarity == "cosine" else vf.vectors
-            return (jax.device_put(mat, device), jax.device_put(vf.exists, device))
+            if charge is not None:
+                # vectors are the big uploads: trip the breaker BEFORE
+                # shipping them (HierarchyCircuitBreakerService
+                # .addEstimateBytesAndMaybeBreak)
+                charge(
+                    "vectors",
+                    int(mat.nbytes) + int(vf.exists.nbytes),
+                    True,
+                    precheck_only=True,
+                )
+            out = (
+                jax.device_put(mat, device),
+                jax.device_put(vf.exists, device),
+            )
+            if charge is not None:
+                charge("vectors", _tree_nbytes(out), False)
+            return out
 
         self.vectors = _LazyDeviceMap(seg.vectors, _vec)
         # multi-value ordinal CSR for device range/terms masks
@@ -118,6 +155,7 @@ class DeviceSegment:
                 jax.device_put(seg.ordinals[f].mv_ords, device),
                 jax.device_put(seg.ordinals[f].mv_offsets.astype(np.int32), device),
             ),
+            charge=charge, category="doc_values",
         )
 
 
@@ -135,7 +173,16 @@ class JaxExecutor:
         self.k1 = k1
         self.b = b
         self.device = device
-        self.device_segments = [DeviceSegment(s, device) for s in reader.segments]
+        # HBM ledger integration: every device upload is charged and
+        # released when the executor is discarded (reader generation
+        # change); see common/memory.py
+        self._charges: List[Tuple[str, int]] = []
+        self._charges_lock = threading.Lock()
+        self._closed = False
+        self.device_segments = [
+            DeviceSegment(s, device, charge=self._charge)
+            for s in reader.segments
+        ]
         # the oracle is reused for stats, weights, and host-only nodes
         # (match_phrase position verification)
         self._oracle = NumpyExecutor(reader, k1, b)
@@ -148,6 +195,8 @@ class JaxExecutor:
         self._block_indexes: Dict[Tuple[int, str], object] = {}
         self._chunked_scorers: Dict[Tuple[int, str], object] = {}
         self._fused_scorers: Dict[Tuple[int, str], object] = {}
+        self._fused_parts: Dict[Tuple[int, str], object] = {}
+        self._fused_mf: Dict[Tuple[int, tuple], object] = {}
         self._seg_weights: Dict[Tuple[int, str], np.ndarray] = {}
         self._df_maps: Dict[str, Dict[str, int]] = {}
         self._shard_dfs: Dict[Tuple[str, str], int] = {}
@@ -160,7 +209,73 @@ class JaxExecutor:
 
     # ---- per-(segment, field) dense inverse-norm array ----
 
+    def _charge(
+        self, category: str, nbytes: int, breaker: bool,
+        precheck_only: bool = False,
+    ) -> None:
+        from ..common.memory import hbm_ledger
+
+        if precheck_only:
+            if breaker and nbytes and not hbm_ledger.would_fit(nbytes):
+                from ..common.memory import CircuitBreakingException
+
+                hbm_ledger.stats_counters["tripped"] += 1
+                raise CircuitBreakingException(
+                    f"[hbm] Data too large for [{category}]: "
+                    f"{nbytes} bytes would exceed the budget",
+                    bytes_wanted=nbytes,
+                    limit=hbm_ledger.budget,
+                )
+            return
+        with self._charges_lock:
+            if self._closed:
+                # a pinned scroll/PIT context kept using this executor
+                # after its generation was replaced: don't record bytes
+                # nobody will ever release
+                return
+            hbm_ledger.add(category, nbytes, breaker=False)
+            self._charges.append((category, nbytes))
+
+    def close(self) -> None:
+        """Releases this executor's HBM ledger charges (the device
+        arrays themselves are freed by JAX when the references die)."""
+        from ..common.memory import hbm_ledger
+
+        with self._charges_lock:
+            self._closed = True
+            charges, self._charges = self._charges, []
+        for category, nbytes in charges:
+            hbm_ledger.release(category, nbytes)
+
     def _inv_norm(self, si: int, field: str, n: int) -> jax.Array:
+        from .executor import DFS_STATS
+
+        dfs = DFS_STATS.get()
+        if dfs is not None and field in dfs.get("fields", {}):
+            # DFS avgdl differs from the shard's — cached per request
+            # (DFS_NORM_CACHE contextvar) so each (segment, field) norm
+            # array uploads at most once per request
+            from .executor import DFS_NORM_CACHE
+
+            req_cache = DFS_NORM_CACHE.get()
+            key = (id(self), si, field)
+            if req_cache is not None:
+                arr = req_cache.get(key)
+                if arr is not None:
+                    return arr
+            cache = self._oracle._field_cache(field)  # ctx-aware
+            pf = self.reader.segments[si].postings.get(field)
+            mf = self.reader.mappings.get(field)
+            if pf is None:
+                host = np.zeros(n, np.float32)
+            elif mf is not None and mf.type != TEXT:
+                host = np.full(n, cache[1], np.float32)
+            else:
+                host = cache[pf.norms.astype(np.int64)]
+            arr = jax.device_put(host, self.device)
+            if req_cache is not None:
+                req_cache[key] = arr
+            return arr
         key = (si, field)
         arr = self._inv_norm_cache.get(key)
         if arr is None:
@@ -179,6 +294,7 @@ class JaxExecutor:
                 else:
                     host = cache[pf.norms.astype(np.int64)]
                 arr = jax.device_put(host, self.device)
+                self._charge("norms", int(host.nbytes), False)
                 self._inv_norm_cache[key] = arr
         return arr
 
@@ -202,8 +318,12 @@ class JaxExecutor:
         knn: Optional[List[KnnSection]] = None,
         min_score: Optional[float] = None,
     ) -> Tuple[TopDocs, List[np.ndarray]]:
+        from .executor import PROFILE_CTX
+
+        prof = PROFILE_CTX.get()
+        t0 = time.perf_counter_ns() if prof is not None else 0
         knn_sets = [self._knn_topk_global(sec) for sec in (knn or [])]
-        per_segment: List[Tuple[np.ndarray, np.ndarray]] = []
+        device_pairs: List[Tuple[jax.Array, jax.Array]] = []
         for si, seg in enumerate(self.reader.segments):
             n = seg.num_docs
             if query is None and not knn_sets:
@@ -224,7 +344,23 @@ class JaxExecutor:
                 mask = mask & jnp.asarray(live)
             if min_score is not None:
                 mask = mask & (scores >= jnp.float32(min_score))
-            per_segment.append((np.asarray(mask), np.asarray(scores)))
+            device_pairs.append((mask, scores))
+        if prof is not None:
+            # phase boundary: everything queued so far is device work
+            jax.block_until_ready([a for pair in device_pairs for a in pair])
+            t1 = time.perf_counter_ns()
+            prof["device_scoring_ns"] = prof.get("device_scoring_ns", 0) + (
+                t1 - t0
+            )
+        per_segment: List[Tuple[np.ndarray, np.ndarray]] = [
+            (np.asarray(m), np.asarray(s)) for m, s in device_pairs
+        ]
+        if prof is not None:
+            t2 = time.perf_counter_ns()
+            prof["device_transfer_ns"] = prof.get("device_transfer_ns", 0) + (
+                t2 - t1
+            )
+            t0 = t2  # host merge starts here
 
         # global collection (same ordering as the oracle): score desc,
         # (segment, doc) asc — vectorized over the matching docs only
@@ -240,6 +376,10 @@ class JaxExecutor:
                 cand_doc.append(idx.astype(np.int64))
         masks = [m for m, _ in per_segment]
         if not cand_scores:
+            if prof is not None:
+                prof["host_merge_ns"] = prof.get("host_merge_ns", 0) + (
+                    time.perf_counter_ns() - t0
+                )
             return TopDocs(total=total, hits=[], max_score=None), masks
         s = np.concatenate(cand_scores)
         sg = np.concatenate(cand_seg)
@@ -264,6 +404,10 @@ class JaxExecutor:
             )
             for i in top
         ]
+        if prof is not None:
+            prof["host_merge_ns"] = prof.get("host_merge_ns", 0) + (
+                time.perf_counter_ns() - t0
+            )
         return TopDocs(total=total, hits=hits, max_score=max_score), masks
 
     # ---- node dispatch ----
@@ -488,12 +632,62 @@ class JaxExecutor:
         with self._build_lock:
             return self._fused_scorer_build(key, si, field)
 
+    def fused_parts(self, si: int, field: str):
+        """Cached per-(segment, field) device arrays for fused scoring:
+        dict(doc_ids, tfs, inv_norm, dense, hot_rank), or None when the
+        field has no postings / the segment is below FUSED_MIN_DOCS.
+        Shared by the single-field FusedScorer and the multi-field
+        MultiFusedScorer so dense hot rows are built once per field."""
+        key = (si, field)
+        if key in self._fused_parts:
+            return self._fused_parts[key]
+        with self._build_lock:
+            if key in self._fused_parts:
+                return self._fused_parts[key]
+            parts = self._fused_parts_build(si, field)
+            self._fused_parts[key] = parts
+            return parts
+
+    def fused_scorer_mf(self, si: int, fields: tuple):
+        """Cached MultiFusedScorer over one segment and a field tuple
+        (the multi_match / bool serving engine); None when any field
+        lacks parts."""
+        key = (si, tuple(fields))
+        if key in self._fused_mf:
+            return self._fused_mf[key]
+        with self._build_lock:
+            if key in self._fused_mf:
+                return self._fused_mf[key]
+            parts = [self.fused_parts(si, f) for f in fields]
+            if any(p is None for p in parts):
+                fs = None
+            else:
+                fs = scoring.MultiFusedScorer(
+                    fields, parts, self.reader.live_docs[si]
+                )
+            self._fused_mf[key] = fs
+            return fs
+
     def _fused_scorer_build(self, key, si: int, field: str):
         if key in self._fused_scorers:
             return self._fused_scorers[key]
+        parts = self.fused_parts(si, field)
+        fs = None
+        if parts is not None:
+            fs = scoring.FusedScorer(
+                parts["doc_ids"],
+                parts["tfs"],
+                parts["inv_norm"],
+                self.reader.live_docs[si],
+                parts["dense"],
+            )
+            fs.hot_rank = parts["hot_rank"]
+        self._fused_scorers[key] = fs
+        return fs
+
+    def _fused_parts_build(self, si: int, field: str):
         seg = self.reader.segments[si]
         pf = seg.postings.get(field)
-        fs = None
         if pf is not None and seg.num_docs >= FUSED_MIN_DOCS:
             n = seg.num_docs
             dp = self.device_segments[si].postings[field]
@@ -514,11 +708,19 @@ class JaxExecutor:
                 term_max_tf <= scoring.DENSE_TF_MAX
             )
             hot_ids = np.nonzero(hot_mask)[0]
-            # HBM budget for dense rows (uint8 per doc per hot term)
+            # HBM budget for dense rows (uint8 per doc per hot term):
+            # the static per-field cap AND the live global ledger — when
+            # HBM is tight the fused path degrades to sparse tiles (an
+            # optimization lost, not correctness) and counts it
+            from ..common.memory import hbm_ledger
+
             max_hot = max(0, DENSE_ROWS_HBM_BUDGET // max(n, 1))
+            headroom = max(0, hbm_ledger.budget - hbm_ledger.used)
+            max_hot = min(max_hot, headroom // max(n + 1, 1))
             if len(hot_ids) > max_hot:
                 order = np.argsort(-pf.term_df[hot_ids])
                 hot_ids = np.sort(hot_ids[order[:max_hot]])
+                hbm_ledger.note_degraded()
             if len(hot_ids):
                 sel = np.isin(term_of_tile, hot_ids)
                 hot_tiles = tile_of[sel]
@@ -534,20 +736,68 @@ class JaxExecutor:
                     n_hot=len(hot_ids),
                     n_docs=n,
                 )
+                self._charge("dense_rows", _tree_nbytes(dense), False)
                 hot_rank = rank_map
             else:
                 dense = None
                 hot_rank = {}
-            fs = scoring.FusedScorer(
-                dp.doc_ids,
-                dp.tfs,
-                self._inv_norm(si, field, n),
-                self.reader.live_docs[si],
-                dense,
+            return {
+                "doc_ids": dp.doc_ids,
+                "tfs": dp.tfs,
+                "inv_norm": self._inv_norm(si, field, n),
+                "dense": dense,
+                "hot_rank": hot_rank,
+            }
+        return None
+
+    def fused_plan_field(
+        self, si: int, field: str, parts, terms_flagged, boost: float
+    ):
+        """One field's section of a MultiFusedScorer plan:
+        (rare_tiles, rare_w_signed, hot_ranks, hot_w_signed) — weight
+        sign marks whether a term counts toward the match threshold
+        (positive = required/counted). terms_flagged: [(term, term_boost,
+        counted)]. None on slot-budget overflow."""
+        pf = self.reader.segments[si].postings.get(field)
+        if pf is None:
+            return (
+                np.empty(0, np.int64), np.empty(0, np.float32),
+                np.empty(0, np.int64), np.empty(0, np.float32),
             )
-            fs.hot_rank = hot_rank
-        self._fused_scorers[key] = fs
-        return fs
+        weights = self._segment_weights(si, field)
+        rt: list = []
+        rw: list = []
+        hr: list = []
+        hw: list = []
+        for t, tb, counted in terms_flagged:
+            tid = pf.term_id(t)
+            if tid < 0:
+                continue
+            w = float(weights[tid]) * boost * tb
+            if w == 0.0:
+                # a zero weight can't carry the count flag in its sign;
+                # nudge to the smallest positive float so required terms
+                # still count (score contribution is ~0 either way)
+                w = 1e-30
+            if not counted:
+                w = -w
+            r = parts["hot_rank"].get(tid)
+            if r is not None:
+                hr.append(r)
+                hw.append(w)
+            else:
+                s0 = int(pf.term_tile_start[tid])
+                c = int(pf.term_tile_count[tid])
+                rt.extend(range(s0, s0 + c))
+                rw.extend([w] * c)
+        if len(rt) > scoring.FUSED_T_RARE or len(hr) > scoring.FUSED_H:
+            return None
+        return (
+            np.asarray(rt, np.int64),
+            np.asarray(rw, np.float32),
+            np.asarray(hr, np.int64),
+            np.asarray(hw, np.float32),
+        )
 
     def fused_plan(self, fs, si: int, field: str, terms, boost: float, msm: int):
         """(rare_tiles, rare_w, hot_ranks, hot_w, msm) for FusedScorer,
@@ -581,6 +831,24 @@ class JaxExecutor:
             np.asarray(hw, np.float32),
             msm,
         )
+
+    def segment_topk(self, query: Query, si: int, k: int):
+        """(scores[k], docs[k], total) for one parsed query on one
+        segment — the batcher's per-segment fallback when a fused
+        launch isn't available (small segment / slot overflow)."""
+        seg = self.reader.segments[si]
+        n = seg.num_docs
+        if n == 0:
+            return (
+                np.zeros(0, np.float32), np.zeros(0, np.int32), 0
+            )
+        mask, scores = self._exec(query, si)
+        live = self.reader.live_docs[si]
+        if live is not None:
+            mask = mask & jnp.asarray(live)
+        s, d = scoring.topk_hits(scores, mask, min(k, n))
+        total = int(np.asarray(mask.sum()))
+        return np.asarray(s), np.asarray(d), total
 
     def _exec_match(self, q: MatchQuery, si: int) -> Tuple[jax.Array, jax.Array]:
         seg = self.reader.segments[si]

@@ -1,0 +1,144 @@
+"""Backpressure + HBM accounting (VERDICT r3 #9).
+
+Reference analogs (SURVEY.md §2.1): ThreadPool bounded queues with
+EsRejectedExecutionException → 429, HierarchyCircuitBreakerService
+(CircuitBreakingException → 429), fielddata-style degradation.
+"""
+
+import numpy as np
+import pytest
+
+from elasticsearch_tpu.cluster.indices import IndexService
+from elasticsearch_tpu.common.memory import (
+    CircuitBreakingException,
+    HbmLedger,
+    hbm_ledger,
+)
+from elasticsearch_tpu.search import dsl
+from elasticsearch_tpu.search.batcher import (
+    EsRejectedExecutionError,
+    QueryBatcher,
+    extract_match_plan,
+)
+
+
+class TestLedger:
+    def test_charge_release(self):
+        led = HbmLedger(budget=1000)
+        led.add("postings", 400)
+        led.add("vectors", 500)
+        assert led.used == 900
+        assert not led.would_fit(200)
+        led.release("vectors", 500)
+        assert led.used == 400
+        assert led.would_fit(200)
+
+    def test_breaker_trips(self):
+        led = HbmLedger(budget=100)
+        led.add("a", 90)
+        with pytest.raises(CircuitBreakingException) as ei:
+            led.add("b", 20, breaker=True)
+        assert ei.value.status == 429
+        assert led.stats()["tripped"] == 1
+        # non-breaker adds record overage instead of lying
+        led.add("b", 20, breaker=False)
+        assert led.used == 110
+
+    def test_stats_shape(self):
+        led = HbmLedger(budget=10)
+        led.add("x", 4)
+        s = led.stats()
+        assert s["limit_size_in_bytes"] == 10
+        assert s["estimated_size_in_bytes"] == 4
+        assert s["by_category"] == {"x": 4}
+
+
+class TestExecutorCharges:
+    def test_uploads_charged_and_released(self):
+        svc = IndexService(
+            "led",
+            settings={"number_of_shards": 1, "search.backend": "jax"},
+            mappings_json={"properties": {"body": {"type": "text"}}},
+        )
+        try:
+            for i in range(40):
+                svc.index_doc(str(i), {"body": f"alpha beta doc {i}"})
+            svc.refresh()
+            before = hbm_ledger.used
+            svc.search({"query": {"match": {"body": "alpha"}}})
+            after_search = hbm_ledger.used
+            assert after_search > before  # postings + norms charged
+            # a refresh produces a new generation; replacing the
+            # executor releases the old charges
+            svc.index_doc("new", {"body": "alpha gamma"})
+            svc.refresh()
+            svc.search({"query": {"match": {"body": "alpha"}}})
+            # old gen released, new gen charged: no unbounded growth
+            assert hbm_ledger.used < after_search * 2 + 1
+        finally:
+            svc.close()
+            # executor cache drops with the service; release remainder
+            for _, ex in svc._executors.values():
+                if hasattr(ex, "close"):
+                    ex.close()
+
+
+class TestQueueRejection:
+    def test_flood_gets_rejections_not_hangs(self):
+        svc = IndexService(
+            "flood",
+            settings={"number_of_shards": 1, "search.backend": "jax"},
+            mappings_json={"properties": {"body": {"type": "text"}}},
+        )
+        try:
+            for i in range(30):
+                svc.index_doc(str(i), {"body": f"alpha beta doc {i}"})
+            svc.refresh()
+            ex = svc._executor(svc.shards[0])
+            plan = extract_match_plan(
+                dsl.parse_query({"match": {"body": "alpha"}}),
+                svc.mappings, svc.analysis, False,
+            )
+            tiny = QueryBatcher(workers=1, queue_capacity=4)
+            # stall the worker by filling beyond capacity before start
+            jobs = []
+            rejected = 0
+            for _ in range(64):
+                try:
+                    jobs.append(tiny.submit(ex, plan, 5))
+                except EsRejectedExecutionError:
+                    rejected += 1
+            assert rejected > 0
+            assert tiny.stats["rejected"] == rejected
+            for j in jobs:
+                td = QueryBatcher.wait(j, timeout=30)
+                assert td is not None
+            tiny.close()
+        finally:
+            svc.close()
+
+    def test_rejection_maps_to_429(self):
+        from elasticsearch_tpu.rest.router import error_body
+
+        e = EsRejectedExecutionError("queue full")
+        assert e.status == 429
+        body = error_body(429, e.err_type, str(e))
+        assert body["error"]["type"] == "es_rejected_execution_exception"
+
+
+class TestNodesStatsExposure:
+    def test_breakers_and_threadpool_sections(self):
+        from elasticsearch_tpu.cluster.service import ClusterService
+        from elasticsearch_tpu.rest.actions import RestActions
+
+        c = ClusterService()
+        try:
+            actions = RestActions(c)
+            _, resp = actions.nodes_stats(None, {}, {})
+            node = resp["nodes"]["node-0"]
+            assert "hbm" in node["breakers"]
+            assert "limit_size_in_bytes" in node["breakers"]["hbm"]
+            assert "search" in node["thread_pool"]
+            assert "rejected" in node["thread_pool"]["search"]
+        finally:
+            c.close()

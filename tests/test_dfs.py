@@ -1,0 +1,109 @@
+"""DFS mode (search_type=dfs_query_then_fetch): global term statistics.
+
+The acceptance contract (VERDICT r3 #7): multi-shard scores with DFS
+equal the single-shard-union oracle scores hit-for-hit; without DFS,
+per-shard IDF divergence shows (the documented non-DFS behavior).
+
+Reference analogs (SURVEY.md §2.1 DFS row, §3.3): DfsPhase.execute,
+DfsSearchResult, SearchPhaseController.aggregateDfs.
+"""
+
+import pytest
+
+from elasticsearch_tpu.cluster.indices import IndexService
+
+# doc id → body; murmur3 routes these across 2 shards unevenly enough
+# that per-shard df("rare") differs from the global df
+DOCS = {
+    f"d{i}": body
+    for i, body in enumerate(
+        [
+            "rare alpha beta",
+            "alpha beta gamma",
+            "beta gamma delta",
+            "rare gamma delta",
+            "alpha delta epsilon",
+            "beta epsilon zeta",
+            "rare epsilon zeta",
+            "gamma zeta alpha",
+            "delta alpha beta",
+            "rare beta gamma",
+            "epsilon gamma delta",
+            "zeta delta epsilon",
+        ]
+    )
+}
+
+
+def make(n_shards, backend):
+    svc = IndexService(
+        f"dfs-{n_shards}-{backend}",
+        settings={"number_of_shards": n_shards, "search.backend": backend},
+        mappings_json={"properties": {"body": {"type": "text"}}},
+    )
+    for did, body in DOCS.items():
+        svc.index_doc(did, {"body": body})
+    svc.refresh()
+    return svc
+
+
+QUERIES = [
+    {"match": {"body": "rare alpha"}},
+    {"match": {"body": "rare"}},
+    {"bool": {"must": [{"term": {"body": "rare"}}],
+              "should": [{"match": {"body": "gamma"}}]}},
+    {"multi_match": {"query": "rare epsilon", "fields": ["body"]}},
+]
+
+
+def hits(svc, query, dfs=False):
+    """(id, score) pairs normalized by (-score, id): cross-shard ties
+    legitimately order by shard, exactly as in the reference, so the
+    parity contract is score equality per document."""
+    body = {"query": query, "size": 20}
+    if dfs:
+        body["search_type"] = "dfs_query_then_fetch"
+    out = [
+        (h["_id"], round(h["_score"], 5))
+        for h in svc.search(body)["hits"]["hits"]
+    ]
+    return sorted(out, key=lambda p: (-p[1], p[0]))
+
+
+@pytest.mark.parametrize("backend", ["numpy", "jax"])
+class TestDfsParity:
+    def test_dfs_matches_single_shard_union(self, backend):
+        single = make(1, backend)
+        multi = make(2, backend)
+        try:
+            for q in QUERIES:
+                assert hits(multi, q, dfs=True) == hits(single, q), q
+        finally:
+            single.close()
+            multi.close()
+
+    def test_without_dfs_shard_local_idf_diverges(self, backend):
+        """Sanity: the non-DFS path really does use shard-local stats —
+        otherwise the DFS test above proves nothing."""
+        single = make(1, backend)
+        multi = make(2, backend)
+        try:
+            diverged = any(
+                hits(multi, q) != hits(single, q) for q in QUERIES
+            )
+            assert diverged, "expected per-shard IDF divergence without DFS"
+        finally:
+            single.close()
+            multi.close()
+
+    def test_dfs_does_not_pollute_caches(self, backend):
+        """A DFS request must not change the scores later non-DFS
+        requests see (context-scoped stats, not cache writes)."""
+        multi = make(2, backend)
+        try:
+            q = QUERIES[0]
+            before = hits(multi, q)
+            hits(multi, q, dfs=True)
+            assert hits(multi, q) == before
+        finally:
+            multi.close()

@@ -1,0 +1,269 @@
+"""Round-5 serving-path extension: bool / multi_match / knn plans ride
+the batched device kernels (BASELINE configs 2-4).
+
+Parity contract: every batched result must be hit-for-hit identical to
+the unbatched executor path (forced via min_score=0, which the fast
+path rejects).
+"""
+
+import numpy as np
+import pytest
+
+from elasticsearch_tpu.cluster.indices import IndexService
+from elasticsearch_tpu.search import dsl
+from elasticsearch_tpu.search.batcher import (
+    extract_knn_plan,
+    extract_serve_plan,
+)
+
+WORDS = [
+    "alpha", "beta", "gamma", "delta", "epsilon", "zeta", "eta", "theta",
+    "iota", "kappa", "lam", "mu", "nu", "xi", "omicron", "pi",
+]
+
+
+def _zipf(n):
+    w = 1.0 / np.arange(1, n + 1)
+    return w / w.sum()
+
+
+def make_service(n_docs=300, n_shards=1, seed=0, dims=8):
+    rng = np.random.default_rng(seed)
+    svc = IndexService(
+        "sp",
+        settings={"number_of_shards": n_shards, "search.backend": "jax"},
+        mappings_json={
+            "properties": {
+                "title": {"type": "text"},
+                "body": {"type": "text"},
+                "vec": {"type": "dense_vector", "dims": dims,
+                        "similarity": "cosine"},
+            }
+        },
+    )
+    for i in range(n_docs):
+        kt = int(rng.integers(1, 4))
+        kb = int(rng.integers(3, 12))
+        svc.index_doc(
+            str(i),
+            {
+                "title": " ".join(rng.choice(WORDS, kt, p=_zipf(len(WORDS)))),
+                "body": " ".join(rng.choice(WORDS, kb, p=_zipf(len(WORDS)))),
+                "vec": [float(x) for x in rng.normal(size=dims)],
+            },
+        )
+    svc.refresh()
+    return svc
+
+
+@pytest.fixture(scope="module")
+def service():
+    svc = make_service()
+    yield svc
+    svc.close()
+
+
+def _ids_scores(resp):
+    return [
+        (h["_id"], round(h["_score"], 4)) for h in resp["hits"]["hits"]
+    ]
+
+
+def check_parity(svc, body, require_total=True):
+    batched = svc.search(body)
+    unbatched = svc.search({**body, "min_score": 0})
+    assert _ids_scores(batched) == _ids_scores(unbatched), body
+    if require_total:
+        assert (
+            batched["hits"]["total"]["value"]
+            == unbatched["hits"]["total"]["value"]
+        )
+    return batched
+
+
+class TestExtraction:
+    def test_bool_must_should(self, service):
+        q = dsl.parse_query({"bool": {
+            "must": [{"match": {"body": "alpha"}},
+                     {"term": {"body": "beta"}}],
+            "should": [{"match": {"title": "gamma delta"}}],
+        }})
+        plan = extract_serve_plan(q, service.mappings, service.analysis)
+        assert plan is not None
+        assert plan.msm == 2 and plan.combine == "sum"
+        by_field = {g.field: g.terms for g in plan.groups}
+        assert by_field["body"] == (("alpha", 1.0, True), ("beta", 1.0, True))
+        assert by_field["title"] == (("gamma", 1.0, False),
+                                     ("delta", 1.0, False))
+
+    def test_bool_pure_should_msm(self, service):
+        q = dsl.parse_query({"bool": {
+            "should": [{"match": {"body": "alpha"}},
+                       {"match": {"body": "beta"}},
+                       {"match": {"body": "gamma"}}],
+            "minimum_should_match": 2,
+        }})
+        plan = extract_serve_plan(q, service.mappings, service.analysis)
+        assert plan is not None and plan.msm == 2
+        assert all(t[2] for g in plan.groups for t in g.terms)
+
+    def test_rejections(self, service):
+        cases = [
+            {"bool": {"must_not": [{"match": {"body": "x"}}],
+                      "should": [{"match": {"body": "y"}}]}},
+            {"bool": {"filter": [{"term": {"body": "x"}}],
+                      "must": [{"match": {"body": "y"}}]}},
+            # multi-term must clause needs clause-local OR
+            {"bool": {"must": [{"match": {"body": "alpha beta"}}]}},
+            {"multi_match": {"query": "a", "fields": ["title", "body"],
+                             "operator": "and"}},
+            {"multi_match": {"query": "a", "fields": ["title", "body"],
+                             "type": "cross_fields"}},
+        ]
+        for c in cases:
+            q = dsl.parse_query(c)
+            assert extract_serve_plan(
+                q, service.mappings, service.analysis
+            ) is None, c
+
+    def test_multi_match_plan(self, service):
+        q = dsl.parse_query({"multi_match": {
+            "query": "alpha beta", "fields": ["title^2", "body"],
+            "type": "best_fields", "tie_breaker": 0.3,
+        }})
+        plan = extract_serve_plan(q, service.mappings, service.analysis)
+        assert plan is not None
+        assert plan.combine == "max_tie" and plan.tie == 0.3
+        boosts = {g.field: g.terms[0][1] for g in plan.groups}
+        assert boosts == {"title": 2.0, "body": 1.0}
+
+    def test_knn_plan(self, service):
+        secs = [dsl.parse_knn({"field": "vec", "query_vector": [1.0] * 8,
+                               "k": 5, "num_candidates": 20})]
+        plan = extract_knn_plan(secs, service.mappings)
+        assert plan is not None and plan.k == 5
+        secs[0].filter = dsl.parse_query({"term": {"body": "alpha"}})
+        assert extract_knn_plan(secs, service.mappings) is None
+
+
+BOOL_BODIES = [
+    {"query": {"bool": {
+        "must": [{"match": {"body": "alpha"}}],
+        "should": [{"match": {"body": "gamma delta"}}],
+    }}, "size": 10},
+    {"query": {"bool": {
+        "must": [{"term": {"body": "alpha"}}, {"term": {"body": "beta"}}],
+    }}, "size": 10},
+    {"query": {"bool": {
+        "should": [{"match": {"body": "alpha"}},
+                   {"match": {"body": "epsilon"}},
+                   {"match": {"title": "gamma"}}],
+        "minimum_should_match": 2,
+    }}, "size": 10},
+]
+
+MM_BODIES = [
+    {"query": {"multi_match": {
+        "query": "alpha gamma", "fields": ["title", "body"],
+    }}, "size": 10},
+    {"query": {"multi_match": {
+        "query": "alpha gamma", "fields": ["title^2", "body"],
+        "tie_breaker": 0.3,
+    }}, "size": 10},
+    {"query": {"multi_match": {
+        "query": "beta epsilon", "fields": ["title", "body"],
+        "type": "most_fields",
+    }}, "size": 10},
+]
+
+
+class TestServeParityFallback:
+    """Small segments: the serve path falls back to per-segment device
+    execution; results must still be exact."""
+
+    @pytest.mark.parametrize("body", BOOL_BODIES + MM_BODIES)
+    def test_parity(self, service, body):
+        check_parity(service, body)
+
+
+class TestServeParityFused:
+    """Forced fused multi-field kernel (FUSED_MIN_DOCS lowered)."""
+
+    @pytest.fixture(scope="class")
+    def fused_service(self):
+        from elasticsearch_tpu.search import executor_jax
+
+        orig = executor_jax.FUSED_MIN_DOCS
+        executor_jax.FUSED_MIN_DOCS = 10
+        svc = make_service(n_docs=400, seed=7)
+        yield svc
+        executor_jax.FUSED_MIN_DOCS = orig
+        svc.close()
+
+    @pytest.mark.parametrize("body", BOOL_BODIES + MM_BODIES)
+    def test_parity(self, fused_service, body):
+        check_parity(fused_service, body)
+
+    def test_fused_jobs_counted(self, fused_service):
+        base = fused_service._batcher.stats["fused_jobs"]
+        fused_service.search(BOOL_BODIES[0])
+        assert fused_service._batcher.stats["fused_jobs"] > base
+
+    def test_deletes_respected(self, fused_service):
+        body = {"query": {"bool": {
+            "must": [{"match": {"body": "alpha"}}]}}, "size": 1}
+        victim = fused_service.search(body)["hits"]["hits"][0]["_id"]
+        fused_service.delete_doc(victim)
+        fused_service.refresh()
+        after = fused_service.search({**body, "size": 400})
+        assert victim not in [h["_id"] for h in after["hits"]["hits"]]
+
+
+class TestKnnBatched:
+    def test_knn_parity(self, service):
+        body = {
+            "knn": {"field": "vec", "query_vector": [0.5] * 8, "k": 10,
+                    "num_candidates": 50},
+            "size": 10,
+        }
+        check_parity(service, body, require_total=False)
+
+    def test_knn_multi_shard(self):
+        svc = make_service(n_docs=200, n_shards=3, seed=3)
+        try:
+            body = {
+                "knn": {"field": "vec", "query_vector": [1.0] * 8, "k": 8,
+                        "num_candidates": 30},
+                "size": 8,
+            }
+            check_parity(svc, body, require_total=False)
+        finally:
+            svc.close()
+
+    def test_knn_batched_launch_counted(self, service):
+        base = service._batcher.stats["fused_jobs"]
+        service.search({
+            "knn": {"field": "vec", "query_vector": [0.1] * 8, "k": 3,
+                    "num_candidates": 10},
+        })
+        assert service._batcher.stats["fused_jobs"] > base
+
+
+class TestHybridRrf:
+    def test_rrf_retriever_over_batched_children(self, service):
+        resp = service.search({
+            "retriever": {"rrf": {
+                "retrievers": [
+                    {"standard": {"query": {"multi_match": {
+                        "query": "alpha gamma",
+                        "fields": ["title", "body"]}}}},
+                    {"knn": {"field": "vec", "query_vector": [0.5] * 8,
+                             "k": 10, "num_candidates": 40}},
+                ],
+                "rank_constant": 60,
+            }},
+            "size": 10,
+        })
+        assert len(resp["hits"]["hits"]) == 10
+        scores = [h["_score"] for h in resp["hits"]["hits"]]
+        assert scores == sorted(scores, reverse=True)
