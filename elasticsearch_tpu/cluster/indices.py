@@ -70,19 +70,14 @@ def _dfs_terms(query, mappings, analysis) -> Dict[str, set]:
 
     def analyzed(field: str, text: str, override=None):
         from ..index.mapping import TEXT
+        from ..search.executor import search_field_terms
 
         mf = mappings.get(field)
         if mf is not None and mf.type != TEXT:
             # match on keyword/numeric degrades to a term query at
             # execution — stat the raw value
             return [str(text)]
-        name = override or (
-            (mf.search_analyzer or mf.analyzer) if mf is not None else "standard"
-        )
-        try:
-            return analysis.get(name).terms(str(text))
-        except ValueError:
-            return [str(text)]
+        return search_field_terms(mappings, analysis, field, text, override)
 
     def walk(q) -> None:
         if q is None:

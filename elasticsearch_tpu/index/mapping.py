@@ -33,6 +33,8 @@ HALF_FLOAT = "half_float"
 BOOLEAN = "boolean"
 DATE = "date"
 DENSE_VECTOR = "dense_vector"
+GEO_POINT = "geo_point"
+NESTED = "nested"
 
 NUMERIC_TYPES = (LONG, INTEGER, SHORT, BYTE, DOUBLE, FLOAT, HALF_FLOAT)
 _INT_TYPES = (LONG, INTEGER, SHORT, BYTE)
@@ -93,13 +95,24 @@ class Mappings:
             if ftype == "object":
                 self._parse_properties(cfg.get("properties", {}), prefix=f"{path}.")
                 continue
+            if ftype == NESTED:
+                # register the nested root AND its children — children
+                # carry analyzers/types for the per-object evaluator but
+                # are never flattened into parent columns
+                self._add_field(path, ftype, cfg)
+                self._parse_properties(
+                    cfg.get("properties", {}), prefix=f"{path}."
+                )
+                continue
             self._add_field(path, ftype, cfg)
             for sub, subcfg in cfg.get("fields", {}).items():
                 self._add_field(f"{path}.{sub}", subcfg.get("type", KEYWORD), subcfg)
                 self.multi_fields.setdefault(path, []).append(sub)
 
     def _add_field(self, path: str, ftype: str, cfg: dict):
-        known = (TEXT, KEYWORD, BOOLEAN, DATE, DENSE_VECTOR) + NUMERIC_TYPES
+        known = (
+            TEXT, KEYWORD, BOOLEAN, DATE, DENSE_VECTOR, GEO_POINT, NESTED,
+        ) + NUMERIC_TYPES
         if ftype not in known:
             raise MappingParseError(f"No handler for type [{ftype}] declared on field [{path}]")
         f = MappedField(
@@ -271,6 +284,15 @@ class DocumentParser:
             if isinstance(value, dict):
                 f = self.mappings.get(path)
                 if f is not None:
+                    if f.type == GEO_POINT:
+                        self._index_values(f, path, [value], out)
+                        continue
+                    if f.type == NESTED:
+                        # nested objects stay whole in _source: they are
+                        # NOT flattened into parent columns, which is
+                        # exactly why cross-object queries can't match
+                        # (the reference stores them as separate docs)
+                        continue
                     # leaf/object conflict — the reference rejects this at
                     # parse time rather than silently corrupting fields
                     raise MappingParseError(
@@ -285,6 +307,19 @@ class DocumentParser:
             if not values:
                 continue
             f = self.mappings.get(path)
+            if f is not None and f.type == NESTED:
+                continue  # list-of-objects form; see the dict branch
+            if f is not None and f.type == GEO_POINT:
+                # [lon, lat] array form is one point, not multi-values
+                geo_vals = (
+                    [value]
+                    if isinstance(value, list)
+                    and len(value) == 2
+                    and all(isinstance(x, (int, float)) for x in value)
+                    else values
+                )
+                self._index_values(f, path, geo_vals, out)
+                continue
             if f is None:
                 probe = values[0]
                 if isinstance(probe, (int, float, str, bool)):
@@ -365,6 +400,42 @@ class DocumentParser:
                 if v is None:
                     continue
                 nums.append(parse_date_millis(v, f.format))
+        elif f.type == GEO_POINT:
+            lats = out.numeric_values.setdefault(f"{path}.lat", [])
+            lons = out.numeric_values.setdefault(f"{path}.lon", [])
+            for v in values:
+                if v is None:
+                    continue
+                if isinstance(v, dict):
+                    lat, lon = v.get("lat"), v.get("lon")
+                elif isinstance(v, str):
+                    parts = [p.strip() for p in v.split(",")]
+                    if len(parts) != 2:
+                        raise MappingParseError(
+                            f"failed to parse geo_point [{path}]: [{v}]"
+                        )
+                    lat, lon = parts[0], parts[1]
+                elif isinstance(v, (list, tuple)) and len(v) == 2:
+                    lon, lat = v[0], v[1]  # GeoJSON order
+                else:
+                    raise MappingParseError(
+                        f"failed to parse geo_point [{path}]: [{v}]"
+                    )
+                try:
+                    lat_f, lon_f = float(lat), float(lon)
+                except (TypeError, ValueError) as e:
+                    raise MappingParseError(
+                        f"failed to parse geo_point [{path}]"
+                    ) from e
+                if not (-90 <= lat_f <= 90) or not (-180 <= lon_f <= 180):
+                    raise MappingParseError(
+                        f"geo_point [{path}] out of bounds: "
+                        f"{lat_f},{lon_f}"
+                    )
+                lats.append(lat_f)
+                lons.append(lon_f)
+        elif f.type == NESTED:
+            pass  # nested objects live in _source only (see _walk)
         elif f.type == DENSE_VECTOR:
             vec = [float(x) for x in values]
             if f.dims and len(vec) != f.dims:

@@ -94,7 +94,9 @@ class _Validator(ast.NodeVisitor):
                     f"cannot assign to attribute [{node.attr}]",
                     "illegal_argument_exception",
                 )
-        elif root not in ("params", "Math", "ctx") and node.attr not in _ALLOWED_ATTRS:
+        elif root not in (
+            "params", "Math", "ctx", "MovingFunctions"
+        ) and node.attr not in _ALLOWED_ATTRS:
             raise ScriptError(
                 f"unknown or forbidden attribute [{node.attr}]",
                 "illegal_argument_exception",

@@ -22,6 +22,7 @@ filter, filters, missing — all with arbitrary sub-agg nesting.
 
 from __future__ import annotations
 
+import json
 from dataclasses import dataclass, field as dc_field
 from datetime import datetime, timezone
 from typing import Any, Dict, List, Optional, Sequence
@@ -38,11 +39,38 @@ METRIC_TYPES = {
     "max",
     "value_count",
     "stats",
+    "extended_stats",
     "cardinality",
     "percentiles",
+    "median_absolute_deviation",
+    "weighted_avg",
+    "top_hits",
 }
+
+# pipeline aggs run at REDUCE time over sibling/parent buckets
+# (PipelineAggregationBuilder): parent pipelines are declared inside a
+# bucket agg and walk its ordered buckets; sibling pipelines sit next to
+# a multi-bucket agg and summarize a buckets_path into one value
+PARENT_PIPELINE_TYPES = {
+    "derivative",
+    "cumulative_sum",
+    "serial_diff",
+    "moving_fn",
+    "bucket_script",
+    "bucket_selector",
+    "bucket_sort",
+}
+SIBLING_PIPELINE_TYPES = {
+    "avg_bucket",
+    "max_bucket",
+    "min_bucket",
+    "sum_bucket",
+    "stats_bucket",
+}
+PIPELINE_TYPES = PARENT_PIPELINE_TYPES | SIBLING_PIPELINE_TYPES
 BUCKET_TYPES = {
     "terms",
+    "significant_terms",
     "histogram",
     "date_histogram",
     "range",
@@ -50,6 +78,8 @@ BUCKET_TYPES = {
     "filter",
     "filters",
     "missing",
+    "composite",
+    "global",
 }
 
 
@@ -91,11 +121,17 @@ def parse_aggs(body: Any) -> List[AggNode]:
                 params = value if isinstance(value, dict) else {}
         if agg_type is None:
             raise AggParseError(f"agg [{name}] has no type")
-        if agg_type not in METRIC_TYPES | BUCKET_TYPES:
+        if agg_type not in METRIC_TYPES | BUCKET_TYPES | PIPELINE_TYPES:
             raise AggParseError(f"unknown aggregation type [{agg_type}]")
-        if subs and agg_type in METRIC_TYPES:
+        if subs and agg_type in METRIC_TYPES | PIPELINE_TYPES:
             raise AggParseError(
                 f"metric agg [{name}] cannot have sub-aggregations"
+            )
+        if agg_type in PIPELINE_TYPES and agg_type != "bucket_sort" and (
+            "buckets_path" not in params
+        ):
+            raise AggParseError(
+                f"pipeline agg [{name}] requires [buckets_path]"
             )
         nodes.append(AggNode(name, agg_type, params, subs))
     return nodes
@@ -137,8 +173,13 @@ class AggCollector:
     # ---- entry ----
 
     def collect(self, nodes: Sequence[AggNode], masks: List[np.ndarray]) -> dict:
-        """masks: per-segment boolean match arrays (query+live filtered)."""
-        return {n.name: self._collect_node(n, masks) for n in nodes}
+        """masks: per-segment boolean match arrays (query+live filtered).
+        Pipeline aggs collect nothing — they run at reduce time."""
+        return {
+            n.name: self._collect_node(n, masks)
+            for n in nodes
+            if n.type not in PIPELINE_TYPES
+        }
 
     def _collect_node(self, node: AggNode, masks: List[np.ndarray]) -> dict:
         fn = getattr(self, f"_collect_{node.type}", None)
@@ -286,6 +327,107 @@ class AggCollector:
             ),
         }
 
+    def _collect_extended_stats(self, node, masks):
+        v = self._metric_values(node, masks)
+        return {
+            "t": "extended_stats",
+            "count": int(len(v)),
+            "sum": float(v.sum()),
+            "sum_sq": float((v * v).sum()),
+            "min": float(v.min()) if len(v) else None,
+            "max": float(v.max()) if len(v) else None,
+            "sigma": float(node.params.get("sigma", 2.0)),
+        }
+
+    def _collect_median_absolute_deviation(self, node, masks):
+        # exact MAD from retained values (the reference approximates
+        # with a t-digest; exactness beats sketching at this scale)
+        v = self._metric_values(node, masks)
+        return {"t": "median_absolute_deviation", "values": v}
+
+    def _collect_weighted_avg(self, node, masks):
+        vspec = node.params.get("value") or {}
+        wspec = node.params.get("weight") or {}
+        vf, wf = vspec.get("field"), wspec.get("field")
+        if vf is None or wf is None:
+            raise AggParseError(
+                "[weighted_avg] requires [value.field] and [weight.field]"
+            )
+        vsum = 0.0
+        wsum = 0.0
+        for si, mask in enumerate(masks):
+            v, ve = self._numeric_values(si, vf)
+            w, we = self._numeric_values(si, wf)
+            m = mask & ve & we
+            vsum += float((v[m] * w[m]).sum())
+            wsum += float(w[m].sum())
+        return {"t": "weighted_avg", "vsum": vsum, "wsum": wsum}
+
+    def _collect_top_hits(self, node, masks):
+        """Per-bucket hit materialization (TopHitsAggregator). Sort:
+        numeric/date doc-value fields and `_doc`; the default is `_doc`
+        (query scores are not available in the agg phase — documented
+        deviation from the reference's score default)."""
+        size = _int_param(node, "size", 3)
+        sort_spec = node.params.get("sort") or ["_doc"]
+        if isinstance(sort_spec, (str, dict)):
+            sort_spec = [sort_spec]
+        specs = []
+        for s in sort_spec:
+            if isinstance(s, str):
+                specs.append((s, "asc"))
+            elif isinstance(s, dict) and len(s) == 1:
+                fld, spec = next(iter(s.items()))
+                order = (
+                    spec.get("order", "asc")
+                    if isinstance(spec, dict)
+                    else str(spec)
+                )
+                specs.append((fld, order))
+            else:
+                raise AggParseError("[top_hits] malformed sort")
+        source_spec = node.params.get("_source", True)
+        entries = []
+        total = 0
+        for si, mask in enumerate(masks):
+            seg = self.reader.segments[si]
+            idx = np.nonzero(mask)[0]
+            total += len(idx)
+            for d in idx:
+                keys = []
+                raws = []
+                for fld, order in specs:
+                    if fld == "_doc":
+                        v = float(si * 10**9 + int(d))
+                        have = True
+                    else:
+                        col, e = self._numeric_values(si, fld)
+                        have = bool(e[d])
+                        v = float(col[d]) if have else None
+                    raws.append(v)
+                    if not have:
+                        # missing sorts LAST in either direction
+                        keys.append(float("inf"))
+                    else:
+                        keys.append(-v if order == "desc" else v)
+                entries.append((tuple(keys), raws, si, int(d)))
+        entries.sort(key=lambda e: e[0])
+        from .executor import filter_source
+
+        hits = []
+        for keys, raws, si, d in entries[:size]:
+            seg = self.reader.segments[si]
+            src = seg.sources[d]
+            # _k: internal order keys for the cross-shard merge (stripped
+            # at reduce); sort: the raw public values
+            h = {"_id": seg.doc_ids[d], "_score": None, "sort": raws,
+                 "_k": list(keys)}
+            filtered = filter_source(src, source_spec)
+            if filtered is not None and source_spec is not False:
+                h["_source"] = filtered
+            hits.append(h)
+        return {"t": "top_hits", "hits": hits, "total": total, "size": size}
+
     # ---- bucket helpers ----
 
     def _bucket_result(self, doc_count: int, sub_partial: dict) -> dict:
@@ -377,6 +519,168 @@ class AggCollector:
             return mask & has
         v, e = self._numeric_values(si, f)
         return mask & e & (v == float(key))
+
+    def _collect_global(self, node, masks):
+        """global bucket: the whole shard's LIVE docs regardless of the
+        query (GlobalAggregator)."""
+        full = []
+        for si, seg in enumerate(self.reader.segments):
+            live = self.reader.live_docs[si]
+            full.append(
+                np.ones(seg.num_docs, bool) if live is None else live.copy()
+            )
+        return {
+            "t": "global",
+            "doc_count": int(sum(m.sum() for m in full)),
+            "subs": self._sub_collect(node, full),
+        }
+
+    def _collect_significant_terms(self, node, masks):
+        """Foreground (query) vs background (whole shard) term counts;
+        scoring happens at reduce with the summed stats
+        (SignificantTermsAggregatorFactory, JLH heuristic)."""
+        f = _req(node, "field")
+        mf = self.reader.mappings.get(f)
+        if mf is None or mf.type != KEYWORD:
+            raise AggParseError(
+                f"[significant_terms] requires a keyword field, got [{f}]"
+            )
+        fg: Dict[str, int] = {}
+        bg: Dict[str, int] = {}
+        fg_total = 0
+        bg_total = 0
+        for si, mask in enumerate(masks):
+            of = self._keyword_ords(si, f)
+            seg = self.reader.segments[si]
+            live = self.reader.live_docs[si]
+            full = np.ones(seg.num_docs, bool) if live is None else live
+            fg_total += int(mask.sum())
+            bg_total += int(full.sum())
+            if of is None:
+                continue
+            entry_docs = self._entry_docs(si, of)
+            for counts, m in ((fg, mask), (bg, full)):
+                sel = of.mv_ords[m[entry_docs]]
+                bc = np.bincount(sel, minlength=len(of.ord_terms))
+                for o in np.nonzero(bc)[0]:
+                    key = of.ord_terms[o]
+                    counts[key] = counts.get(key, 0) + int(bc[o])
+        return {
+            "t": "significant_terms",
+            "fg": fg,
+            "bg": bg,
+            "fg_total": fg_total,
+            "bg_total": bg_total,
+            "size": _int_param(node, "size", 10),
+        }
+
+    def _collect_composite(self, node, masks):
+        """Composite: multi-source bucket tuples, paginated at reduce
+        via after_key (CompositeAggregator). Sources: terms, histogram,
+        date_histogram (fixed_interval). Multi-valued keywords use the
+        first value."""
+        sources = node.params.get("sources")
+        if not isinstance(sources, list) or not sources:
+            raise AggParseError("[composite] requires [sources]")
+        specs = []
+        for s in sources:
+            if not isinstance(s, dict) or len(s) != 1:
+                raise AggParseError("[composite] malformed source")
+            sname, body = next(iter(s.items()))
+            if not isinstance(body, dict) or len(body) != 1:
+                raise AggParseError("[composite] malformed source")
+            stype, params = next(iter(body.items()))
+            if stype not in ("terms", "histogram", "date_histogram"):
+                raise AggParseError(
+                    f"[composite] unsupported source type [{stype}]"
+                )
+            specs.append((sname, stype, params))
+        buckets: Dict[tuple, dict] = {}
+        for si, mask in enumerate(masks):
+            n = self.reader.segments[si].num_docs
+            cols = []
+            ok = mask.copy()
+            for sname, stype, params in specs:
+                f = params.get("field")
+                if f is None:
+                    raise AggParseError("[composite] source requires [field]")
+                mf = self.reader.mappings.get(f)
+                if stype == "terms" and mf is not None and mf.type == KEYWORD:
+                    of = self._keyword_ords(si, f)
+                    if of is None:
+                        col = np.full(n, None, object)
+                        have = np.zeros(n, bool)
+                    else:
+                        col = np.full(n, None, object)
+                        have = of.ords >= 0
+                        idx = np.nonzero(have)[0]
+                        col[idx] = [of.ord_terms[o] for o in of.ords[idx]]
+                else:
+                    v, e = self._numeric_values(si, f)
+                    have = e
+                    if stype == "histogram":
+                        interval = _float_param(
+                            _req_param(params, "interval", node), node,
+                            "interval",
+                        )
+                        col = np.floor(v / interval) * interval
+                    elif stype == "date_histogram":
+                        iv = params.get("fixed_interval") or params.get(
+                            "calendar_interval"
+                        )
+                        ms = _parse_dh_interval({"fixed_interval": iv})[0] if iv else None
+                        if ms is None:
+                            raise AggParseError(
+                                "[composite] date_histogram needs "
+                                "fixed_interval"
+                            )
+                        col = np.floor(v / ms) * ms
+                    else:
+                        if mf is not None and mf.type in (
+                            "integer", "long", "short", "byte",
+                        ):
+                            col = v.astype(np.int64)
+                        else:
+                            col = v
+                ok &= have
+                cols.append(col)
+            idx = np.nonzero(ok)[0]
+            for d in idx:
+                key = tuple(
+                    c[d] if isinstance(c[d], str) else
+                    (int(c[d]) if float(c[d]).is_integer() else float(c[d]))
+                    for c in cols
+                )
+                cur = buckets.get(key)
+                if cur is None:
+                    buckets[key] = {"count": 1, "docs": [(si, int(d))]}
+                else:
+                    cur["count"] += 1
+                    cur["docs"].append((si, int(d)))
+        # sub-agg collection per composite bucket
+        out_buckets = {}
+        for key, info in buckets.items():
+            subs = {}
+            if node.subs:
+                bucket_masks = [
+                    np.zeros(self.reader.segments[si].num_docs, bool)
+                    for si in range(len(masks))
+                ]
+                for si, d in info["docs"]:
+                    bucket_masks[si][d] = True
+                subs = self._sub_collect(node, bucket_masks)
+            out_buckets[json.dumps(list(key))] = {
+                "key_values": list(key),
+                "doc_count": info["count"],
+                "subs": subs,
+            }
+        return {
+            "t": "composite",
+            "buckets": out_buckets,
+            "source_names": [s[0] for s in specs],
+            "size": _int_param(node, "size", 10),
+            "after": node.params.get("after"),
+        }
 
     # ---- histogram family ----
 
@@ -546,11 +850,31 @@ class AggCollector:
 # ----------------------------------------------------------------------
 
 
-def reduce_aggs(nodes: Sequence[AggNode], shard_partials: List[dict]) -> dict:
+def reduce_aggs(
+    nodes: Sequence[AggNode],
+    shard_partials: List[dict],
+    in_bucket: bool = False,
+) -> dict:
+    """Coordinator reduce. Pipeline aggs run here: sibling pipelines
+    after their targets; parent pipelines are applied by the PARENT
+    bucket agg over its reduced bucket list (in_bucket marks sub-level
+    reduces, where parent-pipeline nodes are handled by the caller via
+    _apply_parent_pipelines)."""
     out = {}
     for node in nodes:
+        if node.type in PIPELINE_TYPES:
+            continue
         parts = [p[node.name] for p in shard_partials if node.name in p]
-        out[node.name] = _reduce_node(node, parts)
+        reduced = _reduce_node(node, parts)
+        out[node.name] = _apply_parent_pipelines(node, reduced)
+    for node in nodes:
+        if node.type in SIBLING_PIPELINE_TYPES:
+            out[node.name] = _sibling_pipeline(node, out)
+        elif node.type in PARENT_PIPELINE_TYPES and not in_bucket:
+            raise AggParseError(
+                f"pipeline agg [{node.name}] of type [{node.type}] must be "
+                "declared inside a multi-bucket aggregation"
+            )
     return out
 
 
@@ -582,6 +906,144 @@ def _reduce_node(node: AggNode, parts: List[dict]) -> dict:
             "avg": (s / c) if c else None,
             "sum": s,
         }
+    if t == "extended_stats":
+        c = sum(p["count"] for p in parts)
+        s = sum(p["sum"] for p in parts)
+        sq = sum(p["sum_sq"] for p in parts)
+        mins = [p["min"] for p in parts if p["min"] is not None]
+        maxs = [p["max"] for p in parts if p["max"] is not None]
+        sigma = parts[0]["sigma"] if parts else 2.0
+        avg = (s / c) if c else None
+        variance = max(sq / c - avg * avg, 0.0) if c else None
+        std = float(np.sqrt(variance)) if variance is not None else None
+        out = {
+            "count": c,
+            "min": min(mins) if mins else None,
+            "max": max(maxs) if maxs else None,
+            "avg": avg,
+            "sum": s,
+            "sum_of_squares": sq if c else None,
+            "variance": variance,
+            "std_deviation": std,
+        }
+        if std is not None:
+            out["std_deviation_bounds"] = {
+                "upper": avg + sigma * std,
+                "lower": avg - sigma * std,
+            }
+        return out
+    if t == "median_absolute_deviation":
+        vals = (
+            np.concatenate([np.asarray(p["values"]) for p in parts])
+            if parts
+            else np.zeros(0)
+        )
+        if not len(vals):
+            return {"value": None}
+        med = np.median(vals)
+        return {"value": float(np.median(np.abs(vals - med)))}
+    if t == "weighted_avg":
+        vsum = sum(p["vsum"] for p in parts)
+        wsum = sum(p["wsum"] for p in parts)
+        return {"value": (vsum / wsum) if wsum else None}
+    if t == "top_hits":
+        size = parts[0]["size"] if parts else 3
+        merged_hits = [h for p in parts for h in p["hits"]]
+        merged_hits.sort(key=lambda h: tuple(h.get("_k", [])))
+        total = sum(p["total"] for p in parts)
+        return {
+            "hits": {
+                "total": {"value": total, "relation": "eq"},
+                "max_score": None,
+                "hits": [
+                    {k: v for k, v in h.items() if k != "_k"}
+                    for h in merged_hits[:size]
+                ],
+            }
+        }
+    if t == "global":
+        return {
+            "doc_count": sum(p["doc_count"] for p in parts),
+            **_reduce_subs(node, [p["subs"] for p in parts]),
+        }
+    if t == "significant_terms":
+        fg: Dict[str, int] = {}
+        bg: Dict[str, int] = {}
+        fg_total = sum(p["fg_total"] for p in parts)
+        bg_total = sum(p["bg_total"] for p in parts)
+        for p in parts:
+            for k, v in p["fg"].items():
+                fg[k] = fg.get(k, 0) + v
+            for k, v in p["bg"].items():
+                bg[k] = bg.get(k, 0) + v
+        size = parts[0]["size"] if parts else 10
+        scored = []
+        for k, f_cnt in fg.items():
+            b_cnt = bg.get(k, f_cnt)
+            if fg_total == 0 or bg_total == 0:
+                continue
+            fg_rate = f_cnt / fg_total
+            bg_rate = b_cnt / bg_total
+            if fg_rate <= bg_rate or bg_rate == 0:
+                continue  # only terms MORE common in the foreground
+            # JLH: (fg% - bg%) * (fg% / bg%) — SignificantTermsHeuristic
+            score = (fg_rate - bg_rate) * (fg_rate / bg_rate)
+            scored.append((score, k, f_cnt, b_cnt))
+        scored.sort(key=lambda x: (-x[0], x[1]))
+        return {
+            "doc_count": fg_total,
+            "bg_count": bg_total,
+            "buckets": [
+                {
+                    "key": k,
+                    "doc_count": f_cnt,
+                    "score": score,
+                    "bg_count": b_cnt,
+                }
+                for score, k, f_cnt, b_cnt in scored[:size]
+            ],
+        }
+    if t == "composite":
+        merged: Dict[str, dict] = {}
+        for p in parts:
+            for bk, b in p["buckets"].items():
+                cur = merged.get(bk)
+                if cur is None:
+                    merged[bk] = {
+                        "key_values": b["key_values"],
+                        "doc_count": b["doc_count"],
+                        "subs": [b["subs"]],
+                    }
+                else:
+                    cur["doc_count"] += b["doc_count"]
+                    cur["subs"].append(b["subs"])
+        source_names = parts[0]["source_names"] if parts else []
+        size = parts[0]["size"] if parts else 10
+        after = parts[0].get("after") if parts else None
+
+        def kkey(b):
+            return tuple(_sort_key(v) for v in b["key_values"])
+
+        ordered = sorted(merged.values(), key=kkey)
+        if after:
+            after_tuple = tuple(
+                _sort_key(after.get(nm)) for nm in source_names
+            )
+            ordered = [b for b in ordered if kkey(b) > after_tuple]
+        page = ordered[:size]
+        buckets = []
+        for b in page:
+            buckets.append(
+                {
+                    "key": dict(zip(source_names, b["key_values"])),
+                    "doc_count": b["doc_count"],
+                    **_reduce_subs(node, b["subs"]),
+                }
+            )
+        out = {"buckets": buckets}
+        if buckets and len(ordered) > size:
+            out["after_key"] = buckets[-1]["key"]
+        return out
     if t == "cardinality":
         n = 0
         for key in ("terms", "nums"):
@@ -740,7 +1202,247 @@ def _reduce_node(node: AggNode, parts: List[dict]) -> dict:
 def _reduce_subs(node: AggNode, sub_partials: List[dict]) -> dict:
     if not node.subs:
         return {}
-    return reduce_aggs(node.subs, [p for p in sub_partials if p])
+    return reduce_aggs(
+        node.subs, [p for p in sub_partials if p], in_bucket=True
+    )
+
+
+# ----------------------------------------------------------------------
+# pipeline aggregations (reduce-time)
+# ----------------------------------------------------------------------
+
+
+def _bucket_path_value(bucket: dict, path: str):
+    """Resolves a buckets_path tail inside ONE bucket: `_count`, a
+    metric agg name, or `name.prop` (e.g. stats.avg, percentiles.50)."""
+    if path == "_count":
+        return bucket.get("doc_count")
+    name, _, prop = path.partition(".")
+    node = bucket.get(name)
+    if node is None:
+        return None
+    if prop:
+        if "values" in node and prop in node["values"]:
+            return node["values"][prop]
+        return node.get(prop)
+    if isinstance(node, dict):
+        return node.get("value")
+    return node
+
+
+def _sibling_pipeline(node: AggNode, reduced: dict) -> dict:
+    """avg/max/min/sum/stats_bucket over a sibling multi-bucket agg
+    (BucketMetricsPipelineAggregator)."""
+    path = str(_req(node, "buckets_path"))
+    head, _, tail = path.partition(">")
+    target = reduced.get(head)
+    while target is not None and ">" in tail:
+        nxt, _, tail = tail.partition(">")
+        target = (target or {}).get(nxt)
+    if target is None or "buckets" not in target:
+        raise AggParseError(
+            f"buckets_path [{path}] of [{node.name}] does not point at a "
+            "multi-bucket aggregation"
+        )
+    buckets = target["buckets"]
+    if isinstance(buckets, dict):
+        buckets = list(buckets.values())
+    gap = node.params.get("gap_policy", "skip")
+    vals = []
+    for b in buckets:
+        v = _bucket_path_value(b, tail or "_count")
+        if v is None:
+            if gap == "insert_zeros":
+                vals.append(0.0)
+            continue
+        vals.append(float(v))
+    t = node.type
+    if t == "avg_bucket":
+        return {"value": (sum(vals) / len(vals)) if vals else None}
+    if t == "max_bucket":
+        m = max(vals) if vals else None
+        keys = [
+            b.get("key")
+            for b in buckets
+            if _bucket_path_value(b, tail or "_count") == m
+        ] if m is not None else []
+        return {"value": m, "keys": keys}
+    if t == "min_bucket":
+        m = min(vals) if vals else None
+        keys = [
+            b.get("key")
+            for b in buckets
+            if _bucket_path_value(b, tail or "_count") == m
+        ] if m is not None else []
+        return {"value": m, "keys": keys}
+    if t == "sum_bucket":
+        return {"value": float(sum(vals))}
+    if t == "stats_bucket":
+        return {
+            "count": len(vals),
+            "min": min(vals) if vals else None,
+            "max": max(vals) if vals else None,
+            "avg": (sum(vals) / len(vals)) if vals else None,
+            "sum": float(sum(vals)),
+        }
+    raise AggParseError(f"unknown sibling pipeline [{t}]")
+
+
+def _run_pipeline_script(script, bindings: dict):
+    from ..script import ScriptError, script_service
+
+    try:
+        compiled = script_service.compile(script, "field")
+        return compiled.run(bindings)
+    except ScriptError as e:
+        raise AggParseError(str(e))
+
+
+def _apply_parent_pipelines(node: AggNode, reduced: dict) -> dict:
+    """Runs the node's parent-pipeline subs over its ordered reduced
+    buckets (derivative, cumulative_sum, serial_diff, moving_fn,
+    bucket_script, bucket_selector, bucket_sort)."""
+    pipes = [s for s in node.subs if s.type in PARENT_PIPELINE_TYPES]
+    if not pipes or not isinstance(reduced.get("buckets"), list):
+        return reduced
+    buckets: List[dict] = reduced["buckets"]
+    for pipe in pipes:
+        t = pipe.type
+        gap = pipe.params.get("gap_policy", "skip")
+
+        def series(path):
+            out = []
+            for b in buckets:
+                v = _bucket_path_value(b, path)
+                if v is None and gap == "insert_zeros":
+                    v = 0.0
+                out.append(None if v is None else float(v))
+            return out
+
+        if t in ("derivative", "cumulative_sum", "serial_diff", "moving_fn"):
+            vals = series(str(_req(pipe, "buckets_path")))
+            if t == "derivative":
+                prev = None
+                for b, v in zip(buckets, vals):
+                    if prev is not None and v is not None:
+                        b[pipe.name] = {"value": v - prev}
+                    prev = v if v is not None else prev
+            elif t == "cumulative_sum":
+                run = 0.0
+                for b, v in zip(buckets, vals):
+                    run += v or 0.0
+                    b[pipe.name] = {"value": run}
+            elif t == "serial_diff":
+                lag = int(pipe.params.get("lag", 1))
+                for i, b in enumerate(buckets):
+                    if i >= lag and vals[i] is not None and vals[i - lag] is not None:
+                        b[pipe.name] = {"value": vals[i] - vals[i - lag]}
+            else:  # moving_fn
+                window = int(_req(pipe, "window"))
+                script = _req(pipe, "script")
+                shift = int(pipe.params.get("shift", 0))
+                for i, b in enumerate(buckets):
+                    lo = i - window + shift
+                    hi = i + shift
+                    win = [
+                        v for v in vals[max(0, lo):max(0, hi)]
+                        if v is not None
+                    ]
+                    out = _run_pipeline_script(
+                        script,
+                        {"values": win, "MovingFunctions": _MovingFunctions},
+                    )
+                    if out is not None:
+                        b[pipe.name] = {"value": float(out)}
+        elif t in ("bucket_script", "bucket_selector"):
+            paths = _req(pipe, "buckets_path")
+            if not isinstance(paths, dict):
+                raise AggParseError(
+                    f"[{t}] buckets_path must be an object of name → path"
+                )
+            script = _req(pipe, "script")
+            kept = []
+            for b in buckets:
+                bindings = {}
+                missing = False
+                for var, path in paths.items():
+                    v = _bucket_path_value(b, str(path))
+                    if v is None:
+                        if gap == "insert_zeros":
+                            v = 0.0
+                        else:
+                            missing = True
+                            break
+                    bindings[var] = float(v)
+                if missing:
+                    if t == "bucket_selector":
+                        continue  # gap skip drops the bucket from selection
+                    kept.append(b)
+                    continue
+                out = _run_pipeline_script(script, bindings)
+                if t == "bucket_script":
+                    if out is not None:
+                        b[pipe.name] = {"value": float(out)}
+                    kept.append(b)
+                else:  # bucket_selector
+                    if bool(out):
+                        kept.append(b)
+            if t == "bucket_selector":
+                buckets[:] = kept
+        elif t == "bucket_sort":
+            sort = pipe.params.get("sort") or []
+            frm = int(pipe.params.get("from", 0))
+            size = pipe.params.get("size")
+
+            def sort_key(b):
+                keys = []
+                for s in sort:
+                    if isinstance(s, str):
+                        path, order = s, "asc"
+                    else:
+                        path, spec = next(iter(s.items()))
+                        order = (
+                            spec.get("order", "asc")
+                            if isinstance(spec, dict)
+                            else str(spec)
+                        )
+                    v = _bucket_path_value(b, path)
+                    v = float("-inf") if v is None else float(v)
+                    keys.append(-v if order == "desc" else v)
+                return tuple(keys)
+
+            if sort:
+                buckets.sort(key=sort_key)
+            end = None if size is None else frm + int(size)
+            buckets[:] = buckets[frm:end]
+    return reduced
+
+
+class _MovingFunctions:
+    """MovingFunctions surface for moving_fn scripts."""
+
+    @staticmethod
+    def max(values):
+        return max(values) if values else None
+
+    @staticmethod
+    def min(values):
+        return min(values) if values else None
+
+    @staticmethod
+    def sum(values):
+        return float(sum(values)) if values else 0.0
+
+    @staticmethod
+    def unweightedAvg(values):
+        return (float(sum(values)) / len(values)) if values else None
+
+    @staticmethod
+    def stdDev(values, avg=None):
+        if not values:
+            return None
+        m = avg if avg is not None else sum(values) / len(values)
+        return float(np.sqrt(sum((v - m) ** 2 for v in values) / len(values)))
 
 
 # ----------------------------------------------------------------------
@@ -767,6 +1469,15 @@ def _order_buckets(counts: Dict[Any, int], order: dict) -> List[tuple]:
         items.sort(key=lambda kv: _sort_key(kv[0]))
         items.sort(key=lambda kv: kv[1], reverse=reverse)
     return items
+
+
+def _req_param(params: dict, name: str, node: AggNode):
+    v = params.get(name)
+    if v is None:
+        raise AggParseError(
+            f"[{node.type}] agg [{node.name}] source requires [{name}]"
+        )
+    return v
 
 
 def _req(node: AggNode, name: str):

@@ -752,37 +752,32 @@ class QueryBatcher:
                 nc = min(j.plan.num_candidates, n)
                 row_s, row_d = s[ji][:nc], d[ji][:nc]
                 finite = np.isfinite(row_s)
+                boost = j.plan.boost
                 for sc, doc in zip(row_s[finite], row_d[finite]):
-                    per_job_cands[ji].append((float(sc), si, int(doc)))
+                    per_job_cands[ji].append(
+                        (float(sc) * boost, si, int(doc))
+                    )
         # global k cut; totals = number of winners (knn semantics)
-        for ji, j in enumerate(jobs):
-            cands = per_job_cands[ji]
-            cands.sort(key=lambda c: (-c[0], c[1], c[2]))
-            page = cands[: j.plan.k][: j.k]
-            boost = j.plan.boost
-            hits = [
-                Hit(
-                    score=s * boost,
-                    segment=si,
-                    local_doc=d,
-                    doc_id=reader.segments[si].doc_ids[d],
-                )
-                for s, si, d in page
-            ]
-            j.result = TopDocs(
-                total=min(len(cands), j.plan.k),
-                hits=hits,
-                max_score=hits[0].score if hits else None,
-                relation="eq",
-            )
-            j.event.set()
+        totals = np.asarray(
+            [min(len(per_job_cands[ji]), j.plan.k)
+             for ji, j in enumerate(jobs)],
+            np.int64,
+        )
+        self._finish_jobs(
+            jobs, per_job_cands, totals, reader,
+            page_caps=[j.plan.k for j in jobs],
+        )
 
-    def _finish_jobs(self, jobs, per_job_cands, totals, reader):
+    def _finish_jobs(self, jobs, per_job_cands, totals, reader,
+                     page_caps=None):
         """Exact (non-pruned) cross-segment merge: score desc,
-        (segment, doc) asc."""
+        (segment, doc) asc. page_caps optionally bounds the candidate
+        pool before the per-job k cut (knn's global num_candidates)."""
         for ji, j in enumerate(jobs):
             cands = per_job_cands[ji]
             cands.sort(key=lambda c: (-c[0], c[1], c[2]))
+            if page_caps is not None:
+                cands = cands[: page_caps[ji]]
             page = cands[: j.k]
             hits = [
                 Hit(

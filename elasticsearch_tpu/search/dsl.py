@@ -126,6 +126,76 @@ class FunctionScoreQuery(Query):
 
 
 @dataclass
+class MatchPhrasePrefixQuery(Query):
+    """match_phrase_prefix: phrase whose LAST term is a prefix expanded
+    against the term dictionary (MatchPhrasePrefixQueryBuilder)."""
+
+    field: str = ""
+    query: str = ""
+    slop: int = 0
+    max_expansions: int = 50
+    analyzer: Optional[str] = None
+
+
+@dataclass
+class SpanTermQuery(Query):
+    field: str = ""
+    value: str = ""
+
+
+@dataclass
+class SpanNearQuery(Query):
+    """span_near over span_term clauses on one field: proximity with
+    slop + in_order (SpanNearQueryBuilder)."""
+
+    clauses: List[SpanTermQuery] = dc_field(default_factory=list)
+    slop: int = 0
+    in_order: bool = True
+
+
+@dataclass
+class MoreLikeThisQuery(Query):
+    """more_like_this: select interesting terms from the liked text/docs
+    by tf-idf, rewrite to a should-bool (MoreLikeThisQueryBuilder)."""
+
+    fields: List[str] = dc_field(default_factory=list)
+    like: List[Any] = dc_field(default_factory=list)  # strings | {"_id": x}
+    max_query_terms: int = 25
+    min_term_freq: int = 2
+    min_doc_freq: int = 5
+    minimum_should_match: str = "30%"
+
+
+@dataclass
+class GeoDistanceQuery(Query):
+    field: str = ""
+    lat: float = 0.0
+    lon: float = 0.0
+    distance_m: float = 0.0
+
+
+@dataclass
+class GeoBoundingBoxQuery(Query):
+    field: str = ""
+    top: float = 0.0
+    left: float = 0.0
+    bottom: float = 0.0
+    right: float = 0.0
+
+
+@dataclass
+class NestedQuery(Query):
+    """nested query: the inner query must match WITHIN one nested
+    object (NestedQueryBuilder). Objects are evaluated per document
+    against _source — the semantics the reference gets from separate
+    hidden Lucene docs."""
+
+    path: str = ""
+    query: dict = dc_field(default_factory=dict)  # raw DSL, per-object eval
+    score_mode: str = "avg"
+
+
+@dataclass
 class ScriptScoreQuery(Query):
     """script_score query: base query matches, the script replaces the
     score (ScriptScoreQueryBuilder — the reference's brute-force kNN
@@ -232,7 +302,15 @@ def parse_query(body: Any) -> Query:
     parser = _PARSERS.get(name)
     if parser is None:
         raise QueryParseError(f"unknown query [{name}]")
-    return parser(params)
+    node = parser(params)
+    # ES rejects negative boost at parse time (AbstractQueryBuilder
+    # .boost); a negative weight would also corrupt the fused kernel's
+    # sign-encoded count flag
+    if getattr(node, "boost", 1.0) < 0:
+        raise QueryParseError(
+            f"[{name}] negative [boost] is not allowed"
+        )
+    return node
 
 
 def _field_params(params: dict, qname: str) -> tuple:
@@ -494,6 +572,164 @@ def _parse_function_score(params):
     )
 
 
+def _parse_match_phrase_prefix(params):
+    if not isinstance(params, dict) or len(params) != 1:
+        raise QueryParseError("[match_phrase_prefix] requires one field")
+    field, spec = next(iter(params.items()))
+    if isinstance(spec, dict):
+        return MatchPhrasePrefixQuery(
+            field=field,
+            query=str(spec.get("query", "")),
+            slop=int(spec.get("slop", 0)),
+            max_expansions=int(spec.get("max_expansions", 50)),
+            analyzer=spec.get("analyzer"),
+            boost=float(spec.get("boost", 1.0)),
+        )
+    return MatchPhrasePrefixQuery(field=field, query=str(spec))
+
+
+def _parse_span_term(params):
+    if not isinstance(params, dict) or len(params) != 1:
+        raise QueryParseError("[span_term] requires one field")
+    field, spec = next(iter(params.items()))
+    if isinstance(spec, dict):
+        return SpanTermQuery(
+            field=field,
+            value=str(spec.get("value", "")),
+            boost=float(spec.get("boost", 1.0)),
+        )
+    return SpanTermQuery(field=field, value=str(spec))
+
+
+def _parse_span_near(params):
+    raw = params.get("clauses")
+    if not isinstance(raw, list) or not raw:
+        raise QueryParseError("[span_near] requires [clauses]")
+    clauses = []
+    for c in raw:
+        q = parse_query(c)
+        if not isinstance(q, SpanTermQuery):
+            raise QueryParseError(
+                "[span_near] clauses must be span_term queries (this build)"
+            )
+        clauses.append(q)
+    if len({c.field for c in clauses}) != 1:
+        raise QueryParseError("[span_near] clauses must target one field")
+    return SpanNearQuery(
+        clauses=clauses,
+        slop=int(params.get("slop", 0)),
+        in_order=bool(params.get("in_order", True)),
+        boost=float(params.get("boost", 1.0)),
+    )
+
+
+def _parse_more_like_this(params):
+    like = params.get("like")
+    if like is None:
+        raise QueryParseError("[more_like_this] requires [like]")
+    return MoreLikeThisQuery(
+        fields=list(params.get("fields", [])),
+        like=like if isinstance(like, list) else [like],
+        max_query_terms=int(params.get("max_query_terms", 25)),
+        min_term_freq=int(params.get("min_term_freq", 2)),
+        min_doc_freq=int(params.get("min_doc_freq", 5)),
+        minimum_should_match=str(params.get("minimum_should_match", "30%")),
+        boost=float(params.get("boost", 1.0)),
+    )
+
+
+def _geo_point(v):
+    try:
+        if isinstance(v, dict):
+            return float(v["lat"]), float(v["lon"])
+        if isinstance(v, str):
+            parts = v.split(",")
+            if len(parts) != 2:
+                raise QueryParseError(f"malformed geo point [{v}]")
+            return float(parts[0]), float(parts[1])
+        if isinstance(v, (list, tuple)) and len(v) == 2:
+            return float(v[1]), float(v[0])  # GeoJSON [lon, lat]
+    except (TypeError, ValueError, KeyError):
+        raise QueryParseError(f"malformed geo point [{v}]")
+    raise QueryParseError(f"malformed geo point [{v}]")
+
+
+_DIST_UNITS = {
+    "mm": 0.001, "cm": 0.01, "m": 1.0, "km": 1000.0,
+    "mi": 1609.344, "miles": 1609.344, "yd": 0.9144, "ft": 0.3048,
+    "in": 0.0254, "nmi": 1852.0, "NM": 1852.0,
+}
+
+
+def parse_distance_meters(s) -> float:
+    if isinstance(s, (int, float)):
+        return float(s)
+    txt = str(s).strip()
+    try:
+        for unit in sorted(_DIST_UNITS, key=len, reverse=True):
+            if txt.endswith(unit):
+                return float(txt[: -len(unit)]) * _DIST_UNITS[unit]
+        return float(txt)
+    except ValueError:
+        raise QueryParseError(f"failed to parse distance [{s}]")
+
+
+def _parse_geo_distance(params):
+    dist = params.get("distance")
+    if dist is None:
+        raise QueryParseError("[geo_distance] requires [distance]")
+    field = None
+    point = None
+    for k, v in params.items():
+        if k in ("distance", "distance_type", "validation_method", "boost"):
+            continue
+        field, point = k, v
+    if field is None:
+        raise QueryParseError("[geo_distance] requires a field")
+    lat, lon = _geo_point(point)
+    return GeoDistanceQuery(
+        field=field,
+        lat=lat,
+        lon=lon,
+        distance_m=parse_distance_meters(dist),
+        boost=float(params.get("boost", 1.0)),
+    )
+
+
+def _parse_geo_bounding_box(params):
+    field = None
+    spec = None
+    for k, v in params.items():
+        if k in ("validation_method", "type", "boost"):
+            continue
+        field, spec = k, v
+    if field is None or not isinstance(spec, dict):
+        raise QueryParseError("[geo_bounding_box] requires a field")
+    tl = spec.get("top_left")
+    br = spec.get("bottom_right")
+    if tl is None or br is None:
+        raise QueryParseError(
+            "[geo_bounding_box] requires [top_left] and [bottom_right]"
+        )
+    top, left = _geo_point(tl)
+    bottom, right = _geo_point(br)
+    return GeoBoundingBoxQuery(
+        field=field, top=top, left=left, bottom=bottom, right=right,
+        boost=float(params.get("boost", 1.0)),
+    )
+
+
+def _parse_nested(params):
+    if "path" not in params or "query" not in params:
+        raise QueryParseError("[nested] requires [path] and [query]")
+    return NestedQuery(
+        path=str(params["path"]),
+        query=params["query"],
+        score_mode=str(params.get("score_mode", "avg")),
+        boost=float(params.get("boost", 1.0)),
+    )
+
+
 def _parse_script_score(params):
     if "query" not in params or "script" not in params:
         raise QueryParseError("[script_score] requires [query] and [script]")
@@ -552,6 +788,13 @@ _PARSERS = {
     "dis_max": _parse_dis_max,
     "boosting": _parse_boosting,
     "function_score": _parse_function_score,
+    "match_phrase_prefix": _parse_match_phrase_prefix,
+    "span_term": _parse_span_term,
+    "span_near": _parse_span_near,
+    "more_like_this": _parse_more_like_this,
+    "geo_distance": _parse_geo_distance,
+    "geo_bounding_box": _parse_geo_bounding_box,
+    "nested": _parse_nested,
     "script_score": _parse_script_score,
     "script": _parse_script_query,
     "query_string": _parse_query_string,

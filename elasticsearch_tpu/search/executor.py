@@ -468,6 +468,20 @@ class NumpyExecutor:
             return self._exec_boosting(q, seg)
         if isinstance(q, dsl.FunctionScoreQuery):
             return self._exec_function_score(q, seg)
+        if isinstance(q, dsl.MatchPhrasePrefixQuery):
+            return self._exec_match_phrase_prefix(q, seg)
+        if isinstance(q, dsl.SpanTermQuery):
+            return self._score_term_dense(seg, q.field, q.value, q.boost)
+        if isinstance(q, dsl.SpanNearQuery):
+            return self._exec_span_near(q, seg)
+        if isinstance(q, dsl.MoreLikeThisQuery):
+            return self._exec(self._rewrite_mlt(q), seg)
+        if isinstance(q, dsl.GeoDistanceQuery):
+            return self._exec_geo_distance(q, seg)
+        if isinstance(q, dsl.GeoBoundingBoxQuery):
+            return self._exec_geo_bbox(q, seg)
+        if isinstance(q, dsl.NestedQuery):
+            return self._exec_nested(q, seg)
         if isinstance(q, dsl.ScriptScoreQuery):
             return self._exec_script_score(q, seg)
         if isinstance(q, dsl.ScriptQuery):
@@ -581,6 +595,307 @@ class NumpyExecutor:
         scores = np.where(nm, ps * np.float32(q.negative_boost), ps)
         scores = (scores * np.float32(q.boost)).astype(np.float32)
         return pm, np.where(pm, scores, 0).astype(np.float32)
+
+    def _exec_match_phrase_prefix(
+        self, q: "dsl.MatchPhrasePrefixQuery", seg: Segment
+    ) -> Tuple[np.ndarray, np.ndarray]:
+        """Phrase with the LAST term prefix-expanded (max_expansions);
+        each expansion is position-verified like match_phrase; a doc's
+        score is the best matching expansion's conjunction score."""
+        n = seg.num_docs
+        mf = self.reader.mappings.get(q.field)
+        if mf is None or mf.type != TEXT:
+            return np.zeros(n, bool), np.zeros(n, np.float32)
+        analyzer_name = q.analyzer or mf.search_analyzer or mf.analyzer
+        toks = self.reader.analysis.get(analyzer_name).analyze(q.query)
+        terms = [t.text for t in toks]
+        if not terms:
+            return np.zeros(n, bool), np.zeros(n, np.float32)
+        pf = seg.postings.get(q.field)
+        if pf is None:
+            return np.zeros(n, bool), np.zeros(n, np.float32)
+        expansions = self._expand_terms(
+            dsl.PrefixQuery(field=q.field, value=terms[-1]), seg
+        )[: q.max_expansions]
+        if not expansions:
+            return np.zeros(n, bool), np.zeros(n, np.float32)
+        qpos = [t.position for t in toks]
+        rel = [p - qpos[0] for p in qpos]
+        fixed = terms[:-1]
+        total_mask = np.zeros(n, bool)
+        total_scores = np.zeros(n, np.float32)
+        for exp in expansions:
+            full = fixed + [exp]
+            conj = np.ones(n, bool)
+            sc = np.zeros(n, np.float32)
+            for t in full:
+                m, s = self._score_term_dense(seg, q.field, t, q.boost)
+                conj &= m
+                sc = (sc + np.where(m, s, 0)).astype(np.float32)
+            cand = np.nonzero(conj)[0]
+            if not len(cand):
+                continue
+            vmask = np.zeros(n, bool)
+            if len(full) == 1:
+                vmask[cand] = True
+            elif pf.has_positions:
+                tids = [pf.term_id(t) for t in full]
+                for doc in cand:
+                    pos_of: Dict[str, List[int]] = {}
+                    ok = True
+                    for t, tid in zip(full, tids):
+                        if t in pos_of:
+                            continue
+                        ps = (
+                            pf.doc_positions(tid, int(doc))
+                            if tid >= 0
+                            else None
+                        )
+                        if ps is None:
+                            ok = False
+                            break
+                        pos_of[t] = ps.tolist()
+                    vmask[doc] = ok and _phrase_match(
+                        pos_of, full, rel, q.slop
+                    )
+            else:
+                # positionless segment: conjunction approximation
+                vmask[cand] = True
+            total_mask |= vmask
+            total_scores = np.maximum(
+                total_scores, np.where(vmask, sc, 0)
+            ).astype(np.float32)
+        return total_mask, np.where(total_mask, total_scores, 0).astype(
+            np.float32
+        )
+
+    def _exec_span_near(
+        self, q: "dsl.SpanNearQuery", seg: Segment
+    ) -> Tuple[np.ndarray, np.ndarray]:
+        """span_near over span_terms: a doc matches when one position
+        per clause can be chosen whose total span fits within slop
+        (in_order optionally enforces clause order). Scores sum the
+        clause term scores (SpanWeight's simpler sloppy-freq scoring is
+        approximated; documented)."""
+        n = seg.num_docs
+        field = q.clauses[0].field if q.clauses else ""
+        pf = seg.postings.get(field)
+        if pf is None or not pf.has_positions:
+            return np.zeros(n, bool), np.zeros(n, np.float32)
+        terms = [c.value for c in q.clauses]
+        conj = np.ones(n, bool)
+        sc = np.zeros(n, np.float32)
+        for t in terms:
+            m, s = self._score_term_dense(seg, field, t, q.boost)
+            conj &= m
+            sc = (sc + np.where(m, s, 0)).astype(np.float32)
+        tids = [pf.term_id(t) for t in terms]
+        if any(tid < 0 for tid in tids):
+            return np.zeros(n, bool), np.zeros(n, np.float32)
+        mask = np.zeros(n, bool)
+        k = len(terms)
+        for doc in np.nonzero(conj)[0]:
+            plists = [pf.doc_positions(tid, int(doc)) for tid in tids]
+            if any(p is None for p in plists):
+                continue
+            mask[doc] = _span_near_match(
+                [p.tolist() for p in plists], q.slop, q.in_order, k
+            )
+        return mask, np.where(mask, sc, 0).astype(np.float32)
+
+    def _rewrite_mlt(self, q: "dsl.MoreLikeThisQuery") -> "dsl.BoolQuery":
+        """MLT → should-bool of the top tf-idf 'interesting' terms from
+        the liked texts/docs (MoreLikeThisQuery.createQuery)."""
+        fields = list(q.fields)
+        if not fields:
+            fields = [
+                f.name
+                for f in self.reader.mappings.fields.values()
+                if f.type == TEXT and "." not in f.name
+            ]
+        tf: Dict[Tuple[str, str], int] = {}
+        exclude_ids: List[str] = []
+        for like in q.like:
+            if isinstance(like, dict):
+                doc_id = like.get("_id")
+                if doc_id is None:
+                    continue
+                exclude_ids.append(str(doc_id))
+                src = None
+                for seg in self.reader.segments:
+                    try:
+                        loc = seg.doc_ids.index(str(doc_id))
+                        src = seg.sources[loc]
+                        break
+                    except ValueError:
+                        continue
+                if src is None:
+                    continue
+                for f in fields:
+                    for v in _extract_field(src, f):
+                        self._mlt_count(f, str(v), tf)
+            else:
+                for f in fields:
+                    self._mlt_count(f, str(like), tf)
+        scored = []
+        for (f, term), freq in tf.items():
+            if freq < q.min_term_freq:
+                continue
+            df, _ = self.reader.term_stats(f, term)
+            if df < q.min_doc_freq:
+                continue
+            dc, _ = self.reader.field_stats(f)
+            idf = float(bm25.idf(dc, df)) if df > 0 else 0.0
+            scored.append((freq * idf, f, term))
+        scored.sort(key=lambda x: (-x[0], x[1], x[2]))
+        should: List[dsl.Query] = [
+            dsl.TermQuery(field=f, value=t)
+            for _, f, t in scored[: q.max_query_terms]
+        ]
+        must_not: List[dsl.Query] = (
+            [dsl.IdsQuery(values=exclude_ids)] if exclude_ids else []
+        )
+        return dsl.BoolQuery(
+            should=should or [dsl.MatchNoneQuery()],
+            must_not=must_not,
+            minimum_should_match=q.minimum_should_match,
+            boost=q.boost,
+        )
+
+    def _mlt_count(self, field: str, text: str, tf: Dict[Tuple[str, str], int]):
+        for t in search_field_terms(
+            self.reader.mappings, self.reader.analysis, field, text
+        ):
+            tf[(field, t)] = tf.get((field, t), 0) + 1
+
+    def _geo_columns(self, seg: Segment, field: str):
+        lat = seg.numerics.get(f"{field}.lat")
+        lon = seg.numerics.get(f"{field}.lon")
+        if lat is None or lon is None:
+            n = seg.num_docs
+            z = np.zeros(n)
+            return z, z, np.zeros(n, bool)
+        return lat.values, lon.values, lat.exists & lon.exists
+
+    def _exec_geo_distance(
+        self, q: "dsl.GeoDistanceQuery", seg: Segment
+    ) -> Tuple[np.ndarray, np.ndarray]:
+        lat, lon, have = self._geo_columns(seg, q.field)
+        dist = _haversine_m(q.lat, q.lon, lat, lon)
+        mask = have & (dist <= q.distance_m)
+        return mask, np.where(mask, np.float32(q.boost), 0).astype(np.float32)
+
+    def _exec_geo_bbox(
+        self, q: "dsl.GeoBoundingBoxQuery", seg: Segment
+    ) -> Tuple[np.ndarray, np.ndarray]:
+        lat, lon, have = self._geo_columns(seg, q.field)
+        lat_ok = (lat <= q.top) & (lat >= q.bottom)
+        if q.left <= q.right:
+            lon_ok = (lon >= q.left) & (lon <= q.right)
+        else:  # dateline-crossing box
+            lon_ok = (lon >= q.left) | (lon <= q.right)
+        mask = have & lat_ok & lon_ok
+        return mask, np.where(mask, np.float32(q.boost), 0).astype(np.float32)
+
+    def _exec_nested(
+        self, q: "dsl.NestedQuery", seg: Segment
+    ) -> Tuple[np.ndarray, np.ndarray]:
+        """nested: the inner query must hold within ONE object of the
+        nested array (per-doc _source evaluation — the semantics the
+        reference realizes with hidden child docs). Constant score."""
+        n = seg.num_docs
+        mask = np.zeros(n, bool)
+        for d in range(n):
+            src = seg.sources[d]
+            if src is None:
+                continue
+            objs = _nested_objects(src, q.path)
+            for obj in objs:
+                if self._nested_obj_match(obj, q.query, q.path):
+                    mask[d] = True
+                    break
+        return mask, np.where(mask, np.float32(q.boost), 0).astype(np.float32)
+
+    def _nested_obj_match(self, obj: dict, spec: dict, path: str) -> bool:
+        if not isinstance(spec, dict) or len(spec) != 1:
+            raise QueryParseError("[nested] inner query malformed")
+        kind, params = next(iter(spec.items()))
+
+        def rel_value(field: str):
+            rel = field[len(path) + 1:] if field.startswith(path + ".") else field
+            node: Any = obj
+            for part in rel.split("."):
+                node = node.get(part) if isinstance(node, dict) else None
+                if node is None:
+                    return []
+            return node if isinstance(node, list) else [node]
+
+        def analyzed_terms(field: str, text: str) -> List[str]:
+            return search_field_terms(
+                self.reader.mappings, self.reader.analysis, field, text
+            )
+
+        if kind == "bool":
+            musts = params.get("must", [])
+            shoulds = params.get("should", [])
+            must_nots = params.get("must_not", [])
+            filters = params.get("filter", [])
+            if any(
+                not self._nested_obj_match(obj, c, path)
+                for c in list(musts) + list(filters)
+            ):
+                return False
+            if any(self._nested_obj_match(obj, c, path) for c in must_nots):
+                return False
+            if shoulds and not (musts or filters):
+                return any(
+                    self._nested_obj_match(obj, c, path) for c in shoulds
+                )
+            return True
+        if kind in ("term", "match"):
+            field, spec2 = next(iter(params.items()))
+            want = (
+                spec2.get("value" if kind == "term" else "query")
+                if isinstance(spec2, dict)
+                else spec2
+            )
+            vals = rel_value(field)
+            if kind == "term":
+                return any(str(v) == str(want) for v in vals)
+            qterms = set(analyzed_terms(field, str(want)))
+            for v in vals:
+                if qterms & set(analyzed_terms(field, str(v))):
+                    return True
+            return False
+        if kind == "terms":
+            field, wants = next(iter(params.items()))
+            vals = {str(v) for v in rel_value(field)}
+            return any(str(w) in vals for w in wants)
+        if kind == "range":
+            field, cond = next(iter(params.items()))
+            for v in rel_value(field):
+                try:
+                    x = float(v)
+                except (TypeError, ValueError):
+                    continue
+                ok = True
+                if "gte" in cond and not x >= float(cond["gte"]):
+                    ok = False
+                if "gt" in cond and not x > float(cond["gt"]):
+                    ok = False
+                if "lte" in cond and not x <= float(cond["lte"]):
+                    ok = False
+                if "lt" in cond and not x < float(cond["lt"]):
+                    ok = False
+                if ok:
+                    return True
+            return False
+        if kind == "exists":
+            return bool(rel_value(params.get("field", "")))
+        raise QueryParseError(
+            f"[nested] unsupported inner query [{kind}] (this build "
+            "supports bool/term/match/terms/range/exists)"
+        )
 
     def _exec_script_score(
         self, q: "dsl.ScriptScoreQuery", seg: Segment
@@ -1246,6 +1561,101 @@ def _levenshtein_at_most(a: str, b: str, k: int) -> bool:
             return False
         prev = cur
     return prev[-1] <= k
+
+
+def search_field_terms(
+    mappings, analysis, field: str, text: str, override: Optional[str] = None
+) -> List[str]:
+    """Search-time analysis of one value: the field's search analyzer
+    (or analyzer, or `standard`), falling back to the raw value when the
+    analyzer name is unknown. Shared by DFS stats gathering, MLT term
+    selection, and nested-object matching."""
+    mf = mappings.get(field)
+    name = override or (
+        (mf.search_analyzer or mf.analyzer) if mf is not None else "standard"
+    )
+    try:
+        return analysis.get(name).terms(str(text))
+    except ValueError:
+        return [str(text)]
+
+
+def _span_near_match(
+    plists: List[List[int]], slop: int, in_order: bool, k: int
+) -> bool:
+    """One-position-per-clause arrangement with span width - k <= slop;
+    in_order additionally requires strictly increasing positions in
+    clause order (SpanNearQuery/NearSpansOrdered semantics, simplified)."""
+    if k == 0:
+        return False
+    if k == 1:
+        return len(plists[0]) > 0
+    if in_order:
+        # for each start position, greedily pick the smallest admissible
+        # position in each subsequent clause (minimal-span witness)
+        for p0 in plists[0]:
+            prev = p0
+            ok = True
+            for lst in plists[1:]:
+                nxt = next((p for p in lst if p > prev), None)
+                if nxt is None:
+                    ok = False
+                    break
+                prev = nxt
+            if ok and (prev - p0 + 1) - k <= slop:
+                return True
+        return False
+    # unordered: smallest window covering one position from every list
+    events = sorted(
+        (p, li) for li, lst in enumerate(plists) for p in lst
+    )
+    from collections import defaultdict
+
+    need = k
+    have: Dict[int, int] = defaultdict(int)
+    missing = need
+    lo = 0
+    for hi, (p, li) in enumerate(events):
+        if have[li] == 0:
+            missing -= 1
+        have[li] += 1
+        while missing == 0:
+            span = p - events[lo][0] + 1
+            if span - k <= slop:
+                return True
+            lp, lli = events[lo]
+            have[lli] -= 1
+            if have[lli] == 0:
+                missing += 1
+            lo += 1
+    return False
+
+
+_EARTH_RADIUS_M = 6371008.7714  # GeoUtils.EARTH_MEAN_RADIUS
+
+
+def _haversine_m(lat1, lon1, lat2, lon2):
+    """Vectorized haversine distance in meters (GeoDistance.ARC)."""
+    la1, lo1 = np.radians(lat1), np.radians(lon1)
+    la2, lo2 = np.radians(lat2), np.radians(lon2)
+    dlat = la2 - la1
+    dlon = lo2 - lo1
+    a = (
+        np.sin(dlat / 2.0) ** 2
+        + np.cos(la1) * np.cos(la2) * np.sin(dlon / 2.0) ** 2
+    )
+    return 2.0 * _EARTH_RADIUS_M * np.arcsin(np.sqrt(np.clip(a, 0, 1)))
+
+
+def _nested_objects(src: dict, path: str) -> List[dict]:
+    node: Any = src
+    for part in path.split("."):
+        node = node.get(part) if isinstance(node, dict) else None
+        if node is None:
+            return []
+    if isinstance(node, dict):
+        return [node]
+    return [o for o in node if isinstance(o, dict)] if isinstance(node, list) else []
 
 
 def _source_field_lookup(seg: Segment, local: int):

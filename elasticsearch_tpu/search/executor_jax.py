@@ -774,6 +774,10 @@ class JaxExecutor:
             if tid < 0:
                 continue
             w = float(weights[tid]) * boost * tb
+            if w < 0.0:
+                # a negative weight (e.g. field^-2) would corrupt the
+                # sign-encoded count flag — exact path handles it
+                return None
             if w == 0.0:
                 # a zero weight can't carry the count flag in its sign;
                 # nudge to the smallest positive float so required terms

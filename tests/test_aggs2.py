@@ -1,0 +1,258 @@
+"""Round-5 aggregation breadth: composite, significant_terms, top_hits,
+global, extended_stats/weighted_avg/MAD, and the pipeline family.
+
+Reference analogs (SURVEY.md §2.1 Aggregations): CompositeAggregator,
+SignificantTermsAggregator (JLH), TopHitsAggregator, GlobalAggregator,
+PipelineAggregationBuilder (bucket metrics + parent pipelines).
+"""
+
+import pytest
+
+from elasticsearch_tpu.cluster.service import ClusterService
+
+
+@pytest.fixture(scope="module")
+def cluster():
+    c = ClusterService()
+    c.create_index(
+        "sales",
+        {
+            "settings": {"number_of_shards": 2, "search.backend": "numpy"},
+            "mappings": {
+                "properties": {
+                    "product": {"type": "keyword"},
+                    "color": {"type": "keyword"},
+                    "price": {"type": "double"},
+                    "qty": {"type": "integer"},
+                    "month": {"type": "integer"},
+                    "body": {"type": "text"},
+                }
+            },
+        },
+    )
+    idx = c.get_index("sales")
+    rows = [
+        # product, color, price, qty, month, body
+        ("car", "red", 100.0, 1, 1, "fast red car"),
+        ("car", "blue", 200.0, 2, 1, "blue car"),
+        ("car", "red", 150.0, 1, 2, "red car again"),
+        ("bike", "red", 50.0, 3, 2, "red bike"),
+        ("bike", "green", 60.0, 1, 3, "green bike"),
+        ("boat", "blue", 300.0, 1, 3, "blue boat"),
+        ("boat", "blue", 400.0, 2, 4, "big blue boat"),
+        ("car", "green", 120.0, 1, 4, "green car"),
+    ]
+    for i, (p, col, price, qty, m, body) in enumerate(rows):
+        idx.index_doc(
+            str(i),
+            {"product": p, "color": col, "price": price, "qty": qty,
+             "month": m, "body": body},
+        )
+    idx.refresh()
+    yield c
+    c.close()
+
+
+def search(c, aggs, query=None, size=0):
+    body = {"aggs": aggs, "size": size}
+    if query:
+        body["query"] = query
+    return c.search("sales", body)["aggregations"]
+
+
+class TestNewMetrics:
+    def test_extended_stats(self, cluster):
+        out = search(cluster, {"s": {"extended_stats": {"field": "price"}}})
+        s = out["s"]
+        assert s["count"] == 8 and s["sum"] == 1380.0
+        assert s["variance"] == pytest.approx(
+            sum((x - 172.5) ** 2 for x in
+                [100, 200, 150, 50, 60, 300, 400, 120]) / 8
+        )
+        assert "std_deviation_bounds" in s
+
+    def test_weighted_avg(self, cluster):
+        out = search(cluster, {"w": {"weighted_avg": {
+            "value": {"field": "price"}, "weight": {"field": "qty"}}}})
+        total_w = 1 + 2 + 1 + 3 + 1 + 1 + 2 + 1
+        total_vw = 100 + 400 + 150 + 150 + 60 + 300 + 800 + 120
+        assert out["w"]["value"] == pytest.approx(total_vw / total_w)
+
+    def test_median_absolute_deviation(self, cluster):
+        out = search(cluster, {"m": {"median_absolute_deviation": {
+            "field": "price"}}})
+        # prices sorted: 50,60,100,120,150,200,300,400 → median 135;
+        # |v-135| sorted: 15,15,35,65,75,85,165,265 → MAD 70
+        assert out["m"]["value"] == pytest.approx(70.0)
+
+    def test_top_hits_in_terms(self, cluster):
+        out = search(cluster, {"prods": {
+            "terms": {"field": "product"},
+            "aggs": {"cheapest": {"top_hits": {
+                "size": 1, "sort": [{"price": {"order": "asc"}}],
+                "_source": ["price", "product"],
+            }}},
+        }})
+        cars = next(b for b in out["prods"]["buckets"] if b["key"] == "car")
+        hit = cars["cheapest"]["hits"]["hits"][0]
+        assert hit["_source"]["price"] == 100.0
+        assert cars["cheapest"]["hits"]["total"]["value"] == 4
+
+
+class TestNewBuckets:
+    def test_global_ignores_query(self, cluster):
+        out = search(
+            cluster,
+            {"all": {"global": {}, "aggs": {
+                "s": {"sum": {"field": "price"}}}},
+             "q_sum": {"sum": {"field": "price"}}},
+            query={"term": {"product": "car"}},
+        )
+        assert out["all"]["doc_count"] == 8
+        assert out["all"]["s"]["value"] == 1380.0
+        assert out["q_sum"]["value"] == 100 + 200 + 150 + 120
+
+    def test_significant_terms(self, cluster):
+        # foreground: cars; "red" and "green" are car-ish vs background
+        out = search(
+            cluster,
+            {"sig": {"significant_terms": {"field": "color"}}},
+            query={"term": {"product": "bike"}},
+        )
+        sig = out["sig"]
+        assert sig["doc_count"] == 2
+        keys = [b["key"] for b in sig["buckets"]]
+        assert "green" in keys  # 1/2 fg vs 2/8 bg → strongly significant
+        for b in sig["buckets"]:
+            assert b["score"] > 0 and b["bg_count"] >= b["doc_count"]
+
+    def test_composite_pagination(self, cluster):
+        aggs = {"comp": {"composite": {
+            "size": 3,
+            "sources": [
+                {"prod": {"terms": {"field": "product"}}},
+                {"mon": {"histogram": {"field": "month", "interval": 2}}},
+            ],
+        }}}
+        out = search(cluster, aggs)
+        page1 = out["comp"]["buckets"]
+        assert len(page1) == 3
+        assert "after_key" in out["comp"]
+        keys = [tuple(b["key"].values()) for b in page1]
+        assert keys == sorted(keys)
+        # next page
+        aggs2 = {"comp": {"composite": {
+            "size": 10,
+            "after": out["comp"]["after_key"],
+            "sources": aggs["comp"]["composite"]["sources"],
+        }}}
+        out2 = search(cluster, aggs2)
+        keys2 = [tuple(b["key"].values()) for b in out2["comp"]["buckets"]]
+        assert all(k > keys[-1] for k in keys2)
+        total = sum(
+            b["doc_count"]
+            for b in page1 + out2["comp"]["buckets"]
+        )
+        assert total == 8
+
+    def test_composite_with_subs(self, cluster):
+        out = search(cluster, {"comp": {
+            "composite": {
+                "size": 20,
+                "sources": [{"prod": {"terms": {"field": "product"}}}],
+            },
+            "aggs": {"avg_p": {"avg": {"field": "price"}}},
+        }})
+        by_key = {b["key"]["prod"]: b for b in out["comp"]["buckets"]}
+        assert by_key["bike"]["avg_p"]["value"] == pytest.approx(55.0)
+
+
+class TestPipelines:
+    HIST = {"months": {
+        "histogram": {"field": "month", "interval": 1},
+        "aggs": {"sales": {"sum": {"field": "price"}}},
+    }}
+
+    def test_sibling_bucket_metrics(self, cluster):
+        out = search(cluster, {
+            **self.HIST,
+            "avg_monthly": {"avg_bucket": {"buckets_path": "months>sales"}},
+            "best": {"max_bucket": {"buckets_path": "months>sales"}},
+            "total": {"sum_bucket": {"buckets_path": "months>sales"}},
+            "spread": {"stats_bucket": {"buckets_path": "months>sales"}},
+        })
+        monthly = [b["sales"]["value"] for b in out["months"]["buckets"]]
+        assert monthly == [300.0, 200.0, 360.0, 520.0]
+        assert out["avg_monthly"]["value"] == pytest.approx(345.0)
+        assert out["best"]["value"] == 520.0
+        assert out["best"]["keys"] == [4.0]
+        assert out["total"]["value"] == 1380.0
+        assert out["spread"]["count"] == 4
+
+    def test_derivative_and_cumsum(self, cluster):
+        out = search(cluster, {"months": {
+            "histogram": {"field": "month", "interval": 1},
+            "aggs": {
+                "sales": {"sum": {"field": "price"}},
+                "delta": {"derivative": {"buckets_path": "sales"}},
+                "running": {"cumulative_sum": {"buckets_path": "sales"}},
+            },
+        }})
+        b = out["months"]["buckets"]
+        assert "delta" not in b[0]
+        assert b[1]["delta"]["value"] == -100.0
+        assert [x["running"]["value"] for x in b] == [300, 500, 860, 1380]
+
+    def test_bucket_script_and_selector(self, cluster):
+        out = search(cluster, {"months": {
+            "histogram": {"field": "month", "interval": 1},
+            "aggs": {
+                "sales": {"sum": {"field": "price"}},
+                "per_doc": {"bucket_script": {
+                    "buckets_path": {"s": "sales", "n": "_count"},
+                    "script": "s / n",
+                }},
+                "big_only": {"bucket_selector": {
+                    "buckets_path": {"s": "sales"},
+                    "script": "s > 250",
+                }},
+            },
+        }})
+        b = out["months"]["buckets"]
+        assert [x["key"] for x in b] == [1.0, 3.0, 4.0]  # month 2 dropped
+        assert b[0]["per_doc"]["value"] == 150.0
+
+    def test_bucket_sort(self, cluster):
+        out = search(cluster, {"months": {
+            "histogram": {"field": "month", "interval": 1},
+            "aggs": {
+                "sales": {"sum": {"field": "price"}},
+                "top2": {"bucket_sort": {
+                    "sort": [{"sales": {"order": "desc"}}], "size": 2,
+                }},
+            },
+        }})
+        vals = [b["sales"]["value"] for b in out["months"]["buckets"]]
+        assert vals == [520.0, 360.0]
+
+    def test_moving_fn(self, cluster):
+        out = search(cluster, {"months": {
+            "histogram": {"field": "month", "interval": 1},
+            "aggs": {
+                "sales": {"sum": {"field": "price"}},
+                "mavg": {"moving_fn": {
+                    "buckets_path": "sales", "window": 2,
+                    "script": "MovingFunctions.unweightedAvg(values)",
+                }},
+            },
+        }})
+        b = out["months"]["buckets"]
+        # window of the two PREVIOUS buckets (shift 0)
+        assert "mavg" not in b[0] or b[0]["mavg"]["value"] is not None
+        assert b[2]["mavg"]["value"] == pytest.approx((300 + 200) / 2)
+
+    def test_top_level_parent_pipeline_rejected(self, cluster):
+        from elasticsearch_tpu.cluster.service import ClusterError
+
+        with pytest.raises(Exception):
+            search(cluster, {"bad": {"derivative": {"buckets_path": "x"}}})

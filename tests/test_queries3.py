@@ -1,0 +1,212 @@
+"""Round-5 query breadth: match_phrase_prefix, span family,
+more_like_this, geo queries + geo_point mapping, nested.
+
+Reference analogs (SURVEY.md §2.1 Query DSL "~50 query types"):
+MatchPhrasePrefixQueryBuilder, SpanTermQueryBuilder/SpanNearQueryBuilder,
+MoreLikeThisQueryBuilder, GeoDistanceQueryBuilder/
+GeoBoundingBoxQueryBuilder, NestedQueryBuilder.
+"""
+
+import pytest
+
+from elasticsearch_tpu.cluster.service import ClusterService
+
+
+@pytest.fixture(scope="module", params=["numpy", "jax"])
+def cluster(request):
+    c = ClusterService()
+    c.create_index(
+        "q3",
+        {
+            "settings": {"number_of_shards": 1,
+                         "search.backend": request.param},
+            "mappings": {
+                "properties": {
+                    "body": {"type": "text"},
+                    "place": {"type": "geo_point"},
+                    "items": {
+                        "type": "nested",
+                        "properties": {
+                            "name": {"type": "keyword"},
+                            "qty": {"type": "integer"},
+                        },
+                    },
+                }
+            },
+        },
+    )
+    idx = c.get_index("q3")
+    docs = {
+        "1": {"body": "the quick brown fox jumps",
+              "place": {"lat": 48.8566, "lon": 2.3522},  # paris
+              "items": [{"name": "apple", "qty": 5},
+                        {"name": "banana", "qty": 2}]},
+        "2": {"body": "quick brownie recipe for dessert",
+              "place": {"lat": 48.8049, "lon": 2.1204},  # versailles
+              "items": [{"name": "apple", "qty": 1},
+                        {"name": "cherry", "qty": 9}]},
+        "3": {"body": "a brown quick fox runs far away",
+              "place": {"lat": 40.7128, "lon": -74.0060},  # nyc
+              "items": [{"name": "banana", "qty": 7}]},
+        "4": {"body": "slow green turtle crawls slowly home",
+              "place": "51.5074,-0.1278",  # london (string form)
+              "items": []},
+    }
+    for did, src in docs.items():
+        idx.index_doc(did, src)
+    idx.refresh()
+    yield c
+    c.close()
+
+
+def ids(c, query, **kw):
+    body = {"query": query, "size": 10, **kw}
+    return {h["_id"] for h in c.search("q3", body)["hits"]["hits"]}
+
+
+class TestMatchPhrasePrefix:
+    def test_prefix_expansion(self, cluster):
+        assert ids(cluster, {"match_phrase_prefix": {"body": "quick brow"}}) \
+            == {"1", "2"}
+
+    def test_full_last_term(self, cluster):
+        assert ids(cluster, {"match_phrase_prefix": {"body": "quick brown"}}) \
+            == {"1", "2"}  # "brown" and "brownie" both expand
+
+    def test_order_enforced(self, cluster):
+        # doc 3 has "brown quick" — wrong order
+        out = ids(cluster, {"match_phrase_prefix": {"body": "quick bro"}})
+        assert "3" not in out
+
+    def test_single_prefix_term(self, cluster):
+        assert ids(cluster, {"match_phrase_prefix": {"body": "turt"}}) == {"4"}
+
+
+class TestSpanQueries:
+    def test_span_term(self, cluster):
+        assert ids(cluster, {"span_term": {"body": "fox"}}) == {"1", "3"}
+
+    def test_span_near_in_order(self, cluster):
+        # doc1 "quick brown fox": gap 1; doc3 "brown quick fox": adjacent
+        q = {"span_near": {
+            "clauses": [{"span_term": {"body": "quick"}},
+                        {"span_term": {"body": "fox"}}],
+            "slop": 1, "in_order": True,
+        }}
+        assert ids(cluster, q) == {"1", "3"}
+        # slop 0 requires adjacency: only doc3 survives
+        q0 = {"span_near": {
+            "clauses": [{"span_term": {"body": "quick"}},
+                        {"span_term": {"body": "fox"}}],
+            "slop": 0, "in_order": True,
+        }}
+        assert ids(cluster, q0) == {"3"}
+        # reversed order never matches in_order
+        qr = {"span_near": {
+            "clauses": [{"span_term": {"body": "fox"}},
+                        {"span_term": {"body": "quick"}}],
+            "slop": 5, "in_order": True,
+        }}
+        assert ids(cluster, qr) == set()
+
+    def test_span_near_unordered_slop(self, cluster):
+        q = {"span_near": {
+            "clauses": [{"span_term": {"body": "fox"}},
+                        {"span_term": {"body": "quick"}}],
+            "slop": 2, "in_order": False,
+        }}
+        assert ids(cluster, q) == {"1", "3"}
+
+
+class TestMoreLikeThis:
+    def test_like_text(self, cluster):
+        out = ids(cluster, {"more_like_this": {
+            "fields": ["body"],
+            "like": "quick brown fox",
+            "min_term_freq": 1,
+            "min_doc_freq": 1,
+            "minimum_should_match": "60%",
+        }})
+        assert "1" in out and "4" not in out
+
+    def test_like_doc_excludes_input(self, cluster):
+        out = ids(cluster, {"more_like_this": {
+            "fields": ["body"],
+            "like": [{"_id": "1"}],
+            "min_term_freq": 1,
+            "min_doc_freq": 1,
+            "minimum_should_match": "30%",
+        }})
+        assert "1" not in out  # the liked doc itself is excluded
+        assert "3" in out  # shares quick/brown/fox
+
+
+class TestGeo:
+    def test_geo_distance(self, cluster):
+        # 20km around paris: paris + versailles (~17km), not nyc/london
+        out = ids(cluster, {"geo_distance": {
+            "distance": "20km",
+            "place": {"lat": 48.8566, "lon": 2.3522},
+        }})
+        assert out == {"1", "2"}
+
+    def test_geo_distance_tight(self, cluster):
+        out = ids(cluster, {"geo_distance": {
+            "distance": "1km",
+            "place": {"lat": 48.8566, "lon": 2.3522},
+        }})
+        assert out == {"1"}
+
+    def test_geo_bounding_box(self, cluster):
+        # box around western europe
+        out = ids(cluster, {"geo_bounding_box": {
+            "place": {
+                "top_left": {"lat": 55.0, "lon": -5.0},
+                "bottom_right": {"lat": 45.0, "lon": 10.0},
+            }
+        }})
+        assert out == {"1", "2", "4"}
+
+    def test_filter_context_compose(self, cluster):
+        out = ids(cluster, {"bool": {
+            "must": [{"match": {"body": "quick"}}],
+            "filter": [{"geo_distance": {
+                "distance": "20km",
+                "place": {"lat": 48.8566, "lon": 2.3522}}}],
+        }})
+        assert out == {"1", "2"}
+
+
+class TestNested:
+    def test_nested_single_object_semantics(self, cluster):
+        # apple with qty >= 5 exists only in doc 1 as ONE object; doc 2
+        # has apple(1) and cherry(9) — a flattened AND would wrongly
+        # match doc 2
+        q = {"nested": {
+            "path": "items",
+            "query": {"bool": {"must": [
+                {"term": {"items.name": "apple"}},
+                {"range": {"items.qty": {"gte": 5}}},
+            ]}},
+        }}
+        assert ids(cluster, q) == {"1"}
+
+    def test_nested_term(self, cluster):
+        q = {"nested": {"path": "items",
+                        "query": {"term": {"items.name": "banana"}}}}
+        assert ids(cluster, q) == {"1", "3"}
+
+    def test_nested_fields_not_flattened(self, cluster):
+        # direct (non-nested) term on the nested field must NOT match:
+        # nested objects are not indexed into parent columns
+        assert ids(cluster, {"term": {"items.name": "apple"}}) == set()
+
+    def test_nested_in_bool(self, cluster):
+        q = {"bool": {
+            "must": [{"match": {"body": "quick"}}],
+            "filter": [{"nested": {
+                "path": "items",
+                "query": {"range": {"items.qty": {"gte": 7}}}}}],
+        }}
+        # doc2: cherry qty 9; doc3: banana qty 7 — both have "quick"
+        assert ids(cluster, q) == {"2", "3"}
