@@ -407,6 +407,9 @@ class AnalysisRegistry:
         "synonym_graph": lambda cfg: SynonymFilter(cfg.get("synonyms", [])),
     }
 
+    # plugin-provided ready-made analyzers (AnalysisPlugin.getAnalyzers)
+    EXTRA_ANALYZERS: Dict[str, Analyzer] = {}
+
     def __init__(self, index_settings: Optional[dict] = None):
         self._analyzers: Dict[str, Analyzer] = {}
         settings = (index_settings or {}).get("analysis", {})
@@ -419,6 +422,8 @@ class AnalysisRegistry:
             return self._analyzers[name]
         if name in self._custom:
             a = self._build_custom(name, self._custom[name])
+        elif name in self.EXTRA_ANALYZERS:
+            a = self.EXTRA_ANALYZERS[name]
         else:
             a = _builtin(name)
         self._analyzers[name] = a

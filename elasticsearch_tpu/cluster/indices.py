@@ -201,6 +201,7 @@ class IndexService:
             self._local[s] = ShardEngine(
                 self.mappings, self.analysis, path=shard_path, shard_id=s,
                 primary_term=self._primary_term(s),
+                codec=str(self.settings.get("codec", "default")),
             )
         # executor cache: shard id → (change_generation, executor)
         self._executors: Dict[int, tuple] = {}
@@ -312,6 +313,7 @@ class IndexService:
                 local[sid] = ShardEngine(
                     self.mappings, self.analysis, path=shard_path, shard_id=sid,
                     primary_term=self._primary_term(sid),
+                    codec=str(self.settings.get("codec", "default")),
                 )
             elif not self._owns(sid) and sid in local:
                 eng = local.pop(sid)
@@ -1317,6 +1319,7 @@ class IndexService:
         eng = ShardEngine(
             self.mappings, self.analysis, path=shard_path, shard_id=sid,
             primary_term=self._primary_term(sid),
+            codec=str(self.settings.get("codec", "default")),
         )
         local = dict(self._local)
         local[sid] = eng

@@ -78,6 +78,7 @@ class ShardEngine:
         shard_id: int = 0,
         durability: str = DURABILITY_REQUEST,
         primary_term: int = 1,
+        codec: str = "default",
     ):
         self.mappings = mappings
         self.analysis = analysis
@@ -85,6 +86,7 @@ class ShardEngine:
         self.path = path
         self.shard_id = shard_id
         self.primary_term = primary_term
+        self.codec = codec
         self._lock = threading.RLock()
 
         self.segments: List[Segment] = []
@@ -423,7 +425,8 @@ class ShardEngine:
                     )
                     fsync_path(os.path.join(seg_dir, "versions.npy"))
                     fsync_path(os.path.join(seg_dir, "seqnos.npy"))
-                    seg.save(seg_dir)  # fsyncs its files + dir, commits segment.json last
+                    # fsyncs its files + dir, commits segment.json last
+                    seg.save(seg_dir, codec=self.codec)
                 live = self.live_docs[si]
                 live_gen = None
                 if live is not None:

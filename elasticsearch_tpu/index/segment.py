@@ -169,12 +169,14 @@ class Segment:
 
     # ---------- persistence ----------
 
-    def save(self, path: str) -> None:
+    def save(self, path: str, codec: str = "default") -> None:
         os.makedirs(path, exist_ok=True)
+        compress = codec == "best_compression"
         manifest: dict = {
             "format_version": 1,
             "num_docs": self.num_docs,
             "generation": self.generation,
+            "codec": codec,
             "postings": {},
             "numerics": sorted(self.numerics),
             "ordinals": sorted(self.ordinals),
@@ -199,8 +201,24 @@ class Segment:
             put(f"{key}.term_total_tf", pf.term_total_tf)
             put(f"{key}.term_tile_start", pf.term_tile_start)
             put(f"{key}.term_tile_count", pf.term_tile_count)
-            put(f"{key}.doc_ids", pf.doc_ids)
-            put(f"{key}.tfs", pf.tfs)
+            if compress:
+                # best_compression: posting tiles go to disk delta+varint
+                # encoded (the native codec — ForUtil's on-disk role);
+                # decoded once at load into the dense HBM-upload form
+                from ..native import tiles_encode, vb_encode
+
+                manifest["postings"][fname]["tiles_vb"] = list(
+                    pf.doc_ids.shape
+                )
+                arrays[f"{key}.doc_ids_vb"] = np.frombuffer(
+                    tiles_encode(pf.doc_ids), np.uint8
+                )
+                arrays[f"{key}.tfs_vb"] = np.frombuffer(
+                    vb_encode(pf.tfs.ravel()), np.uint8
+                )
+            else:
+                put(f"{key}.doc_ids", pf.doc_ids)
+                put(f"{key}.tfs", pf.tfs)
             put(f"{key}.tile_max_tf", pf.tile_max_tf)
             put(f"{key}.tile_min_norm", pf.tile_min_norm)
             put(f"{key}.norms", pf.norms)
@@ -229,10 +247,25 @@ class Segment:
 
         np.savez(os.path.join(path, "arrays.npz"), **arrays)
         fsync_path(os.path.join(path, "arrays.npz"))
-        with open(os.path.join(path, "docs.json"), "w") as f:
-            json.dump({"doc_ids": self.doc_ids, "sources": self.sources}, f)
-            f.flush()
-            os.fsync(f.fileno())
+        if compress:
+            # stored fields ride DEFLATE (the reference's
+            # best_compression stored-fields codec)
+            import gzip
+
+            with gzip.open(
+                os.path.join(path, "docs.json.gz"), "wt", encoding="utf-8"
+            ) as f:
+                json.dump(
+                    {"doc_ids": self.doc_ids, "sources": self.sources}, f
+                )
+            fsync_path(os.path.join(path, "docs.json.gz"))
+        else:
+            with open(os.path.join(path, "docs.json"), "w") as f:
+                json.dump(
+                    {"doc_ids": self.doc_ids, "sources": self.sources}, f
+                )
+                f.flush()
+                os.fsync(f.fileno())
         tmp = os.path.join(path, "segment.json.tmp")
         with open(tmp, "w") as f:
             json.dump(manifest, f)
@@ -245,21 +278,43 @@ class Segment:
     def load(cls, path: str) -> "Segment":
         with open(os.path.join(path, "segment.json")) as f:
             manifest = json.load(f)
-        with open(os.path.join(path, "docs.json")) as f:
-            docs = json.load(f)
+        gz = os.path.join(path, "docs.json.gz")
+        if os.path.exists(gz):
+            import gzip
+
+            with gzip.open(gz, "rt", encoding="utf-8") as f:
+                docs = json.load(f)
+        else:
+            with open(os.path.join(path, "docs.json")) as f:
+                docs = json.load(f)
         data = np.load(os.path.join(path, "arrays.npz"), allow_pickle=False)
         postings: Dict[str, PostingsField] = {}
         for fname, meta in manifest["postings"].items():
             key = meta["key"]
             terms = _decode_terms(data[f"{key}.terms_blob"], data[f"{key}.term_offsets"])
+            if meta.get("tiles_vb"):
+                # best_compression: one-time native decode into the
+                # dense HBM-upload form (the ForUtil decode moment)
+                from ..native import tiles_decode, vb_decode
+
+                n_tiles, width = meta["tiles_vb"]
+                doc_ids = tiles_decode(
+                    data[f"{key}.doc_ids_vb"].tobytes(), n_tiles, width
+                )
+                tfs = vb_decode(
+                    data[f"{key}.tfs_vb"].tobytes(), n_tiles * width
+                ).reshape(n_tiles, width)
+            else:
+                doc_ids = data[f"{key}.doc_ids"]
+                tfs = data[f"{key}.tfs"]
             postings[fname] = PostingsField(
                 terms=terms,
                 term_df=data[f"{key}.term_df"],
                 term_total_tf=data[f"{key}.term_total_tf"],
                 term_tile_start=data[f"{key}.term_tile_start"],
                 term_tile_count=data[f"{key}.term_tile_count"],
-                doc_ids=data[f"{key}.doc_ids"],
-                tfs=data[f"{key}.tfs"],
+                doc_ids=doc_ids,
+                tfs=tfs,
                 tile_max_tf=data[f"{key}.tile_max_tf"],
                 tile_min_norm=data[f"{key}.tile_min_norm"],
                 norms=data[f"{key}.norms"],

@@ -1,0 +1,29 @@
+"""Native (C++) runtime components, loaded via ctypes.
+
+Reference analog: the reference's load-bearing native layer —
+libs/simdvec C kernels, libzstd bindings, and Lucene's ForUtil postings
+block decode (SURVEY.md §2.5). The TPU compute path is JAX/Pallas; the
+HOST-side hot loops that the reference implements natively get C++
+here: the postings varint/delta codec (on-disk form of posting tiles,
+decoded once at index load).
+
+The shared library builds on demand with g++ (cached next to the
+sources); hosts without a toolchain fall back to the NumPy/Python
+implementation with identical semantics (parity-tested).
+"""
+
+from .codec import (
+    native_available,
+    tiles_decode,
+    tiles_encode,
+    vb_decode,
+    vb_encode,
+)
+
+__all__ = [
+    "native_available",
+    "tiles_encode",
+    "tiles_decode",
+    "vb_encode",
+    "vb_decode",
+]

@@ -46,6 +46,20 @@ class RestActions:
 
     def _register(self):
         add = self.router.add
+        # plugin-provided handlers FIRST (ActionPlugin.getRestHandlers):
+        # the router dispatches in registration order and the generic
+        # /{index} patterns would otherwise shadow _-prefixed plugin
+        # paths (ES reserves _ paths ahead of index names the same way)
+        from ..plugins import plugins_service
+
+        for method, pattern, handler in plugins_service.rest_handlers:
+            add(
+                method,
+                pattern,
+                lambda body, params, qs, h=handler: h(
+                    self.cluster, body, params, qs
+                ),
+            )
         # root & cluster
         add("GET", "/", self.root)
         add("GET", "/_cluster/health", self.cluster_health)

@@ -197,6 +197,11 @@ class ElasticsearchTpuServer:
 
 
 def main(argv=None):
+    # plugins install BEFORE any registry is consumed (NodeConstruction
+    # ordering): ES_TPU_PLUGINS="module.path:ClassName,..."
+    from ..plugins import plugins_service
+
+    plugins_service.load_env()
     ap = argparse.ArgumentParser(description="elasticsearch-tpu node")
     ap.add_argument("--port", type=int, default=9200, help="HTTP port")
     ap.add_argument("--host", default="127.0.0.1")

@@ -303,15 +303,16 @@ class AggCollector:
                 num_parts.append(np.unique(v[mask & e]).view(np.int64))
         return {
             "t": "cardinality",
+            # JSON-serializable: partials ride the transport cross-node
             "terms": (
-                np.unique(np.concatenate(term_parts))
+                np.unique(np.concatenate(term_parts)).tolist()
                 if term_parts
-                else np.zeros(0, np.int64)
+                else []
             ),
             "nums": (
-                np.unique(np.concatenate(num_parts))
+                np.unique(np.concatenate(num_parts)).tolist()
                 if num_parts
-                else np.zeros(0, np.int64)
+                else []
             ),
         }
 
@@ -321,7 +322,8 @@ class AggCollector:
         v = self._metric_values(node, masks)
         return {
             "t": "percentiles",
-            "values": v,
+            # JSON-serializable: partials ride the transport cross-node
+            "values": v.tolist(),
             "percents": node.params.get(
                 "percents", [1, 5, 25, 50, 75, 95, 99]
             ),
@@ -341,9 +343,11 @@ class AggCollector:
 
     def _collect_median_absolute_deviation(self, node, masks):
         # exact MAD from retained values (the reference approximates
-        # with a t-digest; exactness beats sketching at this scale)
+        # with a t-digest; exactness beats sketching at this scale).
+        # Partials must be JSON-serializable: they ride the transport
+        # to remote coordinators.
         v = self._metric_values(node, masks)
-        return {"t": "median_absolute_deviation", "values": v}
+        return {"t": "median_absolute_deviation", "values": v.tolist()}
 
     def _collect_weighted_avg(self, node, masks):
         vspec = node.params.get("value") or {}
@@ -538,13 +542,18 @@ class AggCollector:
     def _collect_significant_terms(self, node, masks):
         """Foreground (query) vs background (whole shard) term counts;
         scoring happens at reduce with the summed stats
-        (SignificantTermsAggregatorFactory, JLH heuristic)."""
+        (SignificantTermsAggregatorFactory, JLH heuristic). Background
+        counts are mask-independent and cached per (segment, field) so
+        nesting under a 1000-bucket terms agg doesn't rescan the shard
+        1000 times."""
         f = _req(node, "field")
         mf = self.reader.mappings.get(f)
         if mf is None or mf.type != KEYWORD:
             raise AggParseError(
                 f"[significant_terms] requires a keyword field, got [{f}]"
             )
+        if not hasattr(self, "_sig_bg_cache"):
+            self._sig_bg_cache: Dict[tuple, tuple] = {}
         fg: Dict[str, int] = {}
         bg: Dict[str, int] = {}
         fg_total = 0
@@ -555,16 +564,27 @@ class AggCollector:
             live = self.reader.live_docs[si]
             full = np.ones(seg.num_docs, bool) if live is None else live
             fg_total += int(mask.sum())
-            bg_total += int(full.sum())
             if of is None:
+                bg_total += int(full.sum())
                 continue
             entry_docs = self._entry_docs(si, of)
-            for counts, m in ((fg, mask), (bg, full)):
-                sel = of.mv_ords[m[entry_docs]]
+            cached = self._sig_bg_cache.get((si, f))
+            if cached is None:
+                sel = of.mv_ords[full[entry_docs]]
                 bc = np.bincount(sel, minlength=len(of.ord_terms))
-                for o in np.nonzero(bc)[0]:
-                    key = of.ord_terms[o]
-                    counts[key] = counts.get(key, 0) + int(bc[o])
+                bg_counts = {
+                    of.ord_terms[o]: int(bc[o]) for o in np.nonzero(bc)[0]
+                }
+                cached = (bg_counts, int(full.sum()))
+                self._sig_bg_cache[(si, f)] = cached
+            for key, cnt in cached[0].items():
+                bg[key] = bg.get(key, 0) + cnt
+            bg_total += cached[1]
+            sel = of.mv_ords[mask[entry_docs]]
+            bc = np.bincount(sel, minlength=len(of.ord_terms))
+            for o in np.nonzero(bc)[0]:
+                key = of.ord_terms[o]
+                fg[key] = fg.get(key, 0) + int(bc[o])
         return {
             "t": "significant_terms",
             "fg": fg,
@@ -645,6 +665,7 @@ class AggCollector:
                 ok &= have
                 cols.append(col)
             idx = np.nonzero(ok)[0]
+            track_docs = bool(node.subs)  # per-bucket docs only feed subs
             for d in idx:
                 key = tuple(
                     c[d] if isinstance(c[d], str) else
@@ -653,10 +674,14 @@ class AggCollector:
                 )
                 cur = buckets.get(key)
                 if cur is None:
-                    buckets[key] = {"count": 1, "docs": [(si, int(d))]}
+                    buckets[key] = {
+                        "count": 1,
+                        "docs": [(si, int(d))] if track_docs else [],
+                    }
                 else:
                     cur["count"] += 1
-                    cur["docs"].append((si, int(d)))
+                    if track_docs:
+                        cur["docs"].append((si, int(d)))
         # sub-agg collection per composite bucket
         out_buckets = {}
         for key, info in buckets.items():

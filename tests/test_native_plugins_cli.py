@@ -1,0 +1,236 @@
+"""Native codec (C++ via ctypes), plugin SPI, CLI, best_compression.
+
+Reference analogs: libs/simdvec-style native components (SURVEY §2.5 —
+here the ForUtil postings codec), the L9 plugin SPI, the L10 CLI, and
+the best_compression stored-fields codec.
+"""
+
+import json
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from elasticsearch_tpu.native import (
+    native_available,
+    tiles_decode,
+    tiles_encode,
+    vb_decode,
+    vb_encode,
+)
+from elasticsearch_tpu.native import codec as codec_mod
+
+
+class TestNativeCodec:
+    def test_native_lib_builds(self):
+        # g++ is baked into this image; the native path must be live
+        assert native_available()
+
+    def test_varint_roundtrip(self):
+        rng = np.random.default_rng(1)
+        v = rng.integers(-5, 100000, size=4096).astype(np.int32)
+        assert np.array_equal(vb_decode(vb_encode(v), len(v)), v)
+
+    def test_tiles_roundtrip_and_compression(self):
+        rng = np.random.default_rng(2)
+        tiles = np.full((64, 128), -1, np.int32)
+        for t in range(64):
+            k = int(rng.integers(1, 129))
+            tiles[t, :k] = np.sort(
+                rng.choice(1_000_000, size=k, replace=False)
+            ).astype(np.int32)
+        enc = tiles_encode(tiles)
+        assert np.array_equal(tiles_decode(enc, 64, 128), tiles)
+        assert len(enc) < tiles.nbytes / 2  # delta+varint actually shrinks
+
+    def test_cpp_python_parity(self):
+        rng = np.random.default_rng(3)
+        tiles = np.full((8, 128), -1, np.int32)
+        for t in range(8):
+            k = int(rng.integers(1, 129))
+            tiles[t, :k] = np.sort(
+                rng.choice(10_000, size=k, replace=False)
+            ).astype(np.int32)
+        assert codec_mod._py_tiles_encode(tiles) == tiles_encode(tiles)
+        enc = tiles_encode(tiles)
+        assert np.array_equal(
+            codec_mod._py_tiles_decode(enc, 8, 128),
+            tiles_decode(enc, 8, 128),
+        )
+        v = rng.integers(0, 255, size=512).astype(np.int32)
+        assert codec_mod._py_vb_encode(v) == vb_encode(v)
+
+    def test_corrupt_stream_rejected(self):
+        with pytest.raises(ValueError):
+            vb_decode(b"\xff\xff", 4)
+
+
+class TestBestCompressionCodec:
+    def test_flush_load_roundtrip(self, tmp_path):
+        from elasticsearch_tpu.cluster.service import ClusterService
+
+        c = ClusterService(data_path=str(tmp_path / "d"))
+        c.create_index(
+            "z", {"settings": {"number_of_shards": 1,
+                               "codec": "best_compression"}}
+        )
+        idx = c.get_index("z")
+        for i in range(150):
+            idx.index_doc(str(i), {"body": f"squeezed doc number {i}"})
+        idx.flush()
+        c.close()
+        c2 = ClusterService(data_path=str(tmp_path / "d"))
+        r = c2.search("z", {"query": {"match": {"body": "squeezed"}}})
+        assert r["hits"]["total"]["value"] == 150
+        shard = tmp_path / "d" / "indices" / "z" / "0"
+        seg_dirs = [p for p in shard.iterdir() if p.is_dir()
+                    and p.name.startswith("seg_")]
+        assert any((sd / "docs.json.gz").exists() for sd in seg_dirs)
+        c2.close()
+
+    def test_default_codec_unchanged(self, tmp_path):
+        from elasticsearch_tpu.cluster.service import ClusterService
+
+        c = ClusterService(data_path=str(tmp_path / "d"))
+        c.create_index("plain", {"settings": {"number_of_shards": 1}})
+        idx = c.get_index("plain")
+        idx.index_doc("1", {"body": "plain doc"})
+        idx.flush()
+        shard = tmp_path / "d" / "indices" / "plain" / "0"
+        seg_dirs = [p for p in shard.iterdir() if p.is_dir()
+                    and p.name.startswith("seg_")]
+        assert any((sd / "docs.json").exists() for sd in seg_dirs)
+        c.close()
+
+
+class SamplePlugin:
+    """Defined at module scope so load_spec can import it."""
+
+
+def _make_sample_plugin():
+    from elasticsearch_tpu.ingest.service import Processor
+    from elasticsearch_tpu.plugins import Plugin
+    from elasticsearch_tpu.search import dsl
+
+    class ShoutProcessor(Processor):
+        TYPE = "shout"
+
+        def __init__(self, cfg):
+            super().__init__(cfg)
+            self.field = cfg.get("field", "msg")
+
+        def process(self, ctx):
+            v = ctx.get(self.field)
+            if isinstance(v, str):
+                ctx[self.field] = v.upper() + "!"
+
+    def parse_everything(params):
+        return dsl.MatchAllQuery(boost=float(params.get("boost", 1.0)))
+
+    class TestPlugin(Plugin):
+        name = "sample"
+
+        def get_query_parsers(self):
+            return {"everything": parse_everything}
+
+        def get_processors(self):
+            return {"shout": ShoutProcessor}
+
+        def get_rest_handlers(self):
+            return [
+                (
+                    "GET",
+                    "/_sample/ping",
+                    lambda cluster, body, params, qs: (
+                        200, {"pong": cluster.cluster_name},
+                    ),
+                )
+            ]
+
+    return TestPlugin()
+
+
+class TestPluginSpi:
+    @pytest.fixture(scope="class")
+    def installed(self):
+        from elasticsearch_tpu.plugins import plugins_service
+
+        plugin = _make_sample_plugin()
+        plugins_service.install(plugin)
+        yield plugins_service
+        # teardown: remove registrations so other tests stay clean
+        from elasticsearch_tpu.ingest.service import PROCESSOR_TYPES
+        from elasticsearch_tpu.search import dsl
+
+        dsl._PARSERS.pop("everything", None)
+        PROCESSOR_TYPES.pop("shout", None)
+        plugins_service.plugins.remove(plugin)
+        plugins_service.rest_handlers.clear()
+
+    def test_plugin_query_type(self, installed):
+        from elasticsearch_tpu.cluster.service import ClusterService
+
+        c = ClusterService()
+        try:
+            c.create_index("p", {"settings": {"number_of_shards": 1,
+                                              "search.backend": "numpy"}})
+            idx = c.get_index("p")
+            idx.index_doc("1", {"body": "x"})
+            idx.refresh()
+            r = c.search("p", {"query": {"everything": {}}})
+            assert r["hits"]["total"]["value"] == 1
+        finally:
+            c.close()
+
+    def test_plugin_processor(self, installed):
+        from elasticsearch_tpu.ingest import IngestService
+
+        svc = IngestService()
+        svc.put_pipeline("pp", {"processors": [{"shout": {"field": "m"}}]})
+        out = svc.execute("pp", {"m": "hey"}, "i", "1")
+        assert out["m"] == "HEY!"
+
+    def test_plugin_rest_handler(self, installed):
+        from elasticsearch_tpu.cluster.service import ClusterService
+        from elasticsearch_tpu.rest.actions import RestActions
+
+        c = ClusterService()
+        try:
+            actions = RestActions(c)
+            route, params, _ = actions.router.dispatch("GET", "/_sample/ping")
+            assert route is not None
+            status, body = route.handler(None, params or {}, {})
+            assert status == 200 and body["pong"] == c.cluster_name
+        finally:
+            c.close()
+
+    def test_info_shape(self, installed):
+        info = installed.info()
+        assert any(p["name"] == "sample" for p in info)
+
+
+class TestCli:
+    def run_cli(self, *args):
+        return subprocess.run(
+            [sys.executable, "-m", "elasticsearch_tpu", *args],
+            capture_output=True, text=True, timeout=120,
+            env={"JAX_PLATFORMS": "cpu", "PATH": "/usr/bin:/bin:/usr/local/bin",
+                 "PYTHONPATH": "/root/repo"},
+        )
+
+    def test_version(self):
+        out = self.run_cli("version")
+        assert out.returncode == 0
+        data = json.loads(out.stdout)
+        assert data["distribution"] == "elasticsearch-tpu"
+
+    def test_check_passes(self):
+        out = self.run_cli("check")
+        assert out.returncode == 0, out.stderr
+        data = json.loads(out.stdout)
+        assert data["checks_passed"] is True
+
+    def test_help(self):
+        out = self.run_cli("--help")
+        assert "serve" in out.stdout and "check" in out.stdout
