@@ -17,8 +17,7 @@ ES_VERSION = "8.x-tpu"
 def cmd_serve(argv) -> int:
     from .rest import server
 
-    sys.argv = ["elasticsearch-tpu"] + list(argv)
-    server.main()
+    server.main(list(argv))
     return 0
 
 
@@ -81,12 +80,18 @@ def cmd_plugin(argv) -> int:
     ap.add_argument("action", choices=["list", "load"])
     ap.add_argument("spec", nargs="?", help="module.path:ClassName for load")
     args = ap.parse_args(argv)
-    if args.action == "load":
-        if not args.spec:
-            print("plugin load requires a spec", file=sys.stderr)
-            return 2
-        plugins_service.load_spec(args.spec)
-    plugins_service.load_env()
+    try:
+        if args.action == "load":
+            if not args.spec:
+                print("plugin load requires a spec", file=sys.stderr)
+                return 2
+            plugins_service.load_spec(args.spec)
+        # load_spec/load_env are idempotent per spec, so a spec that is
+        # also in ES_TPU_PLUGINS installs once
+        plugins_service.load_env()
+    except (ValueError, TypeError, ImportError, AttributeError) as e:
+        print(f"plugin error: {e}", file=sys.stderr)
+        return 1
     print(json.dumps({"plugins": plugins_service.info()}))
     return 0
 

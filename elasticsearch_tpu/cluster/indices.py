@@ -677,25 +677,61 @@ class IndexService:
                         )
                     except RuntimeError:
                         td = None  # batcher closed mid-request → unbatched
+        agg_partial = None
         try:
+            if (
+                td is None
+                and agg_nodes is not None
+                and sort_specs is None
+                and search_after is None
+                and knn is None
+                and min_score is None
+                and not profile
+                and pinned_executor is None
+                and dfs_stats is None
+                and not isinstance(ex, NumpyExecutor)
+            ):
+                # keyword terms aggs bucket on device: scatter-add per
+                # segment, compact count download (VERDICT r3 #6)
+                got = ex.execute_with_terms_aggs(query, agg_nodes, k, tth)
+                if got is not None:
+                    td, agg_partial = got
             if td is None:
                 if sort_specs is not None:
-                    oracle = ex if isinstance(ex, NumpyExecutor) else ex._oracle
-                    td, masks, svals = oracle.execute_sorted(
-                        query,
-                        sort_specs,
-                        size=k,
-                        from_=0,
-                        knn=knn,
-                        min_score=min_score,
-                        search_after=search_after,
-                    )
+                    device_sorted = None
+                    if (
+                        not isinstance(ex, NumpyExecutor)
+                        and agg_nodes is None
+                        and knn is None
+                        and min_score is None
+                    ):
+                        # single numeric-key sorts collect on device
+                        # (rank columns; k-row download) — VERDICT r3 #6
+                        device_sorted = ex.execute_sorted_device(
+                            query, sort_specs, size=k,
+                            search_after=search_after,
+                        )
+                    if device_sorted is not None:
+                        td, svals = device_sorted
+                        masks = None  # no aggs on this path (condition)
+                    else:
+                        oracle = (
+                            ex if isinstance(ex, NumpyExecutor) else ex._oracle
+                        )
+                        td, masks, svals = oracle.execute_sorted(
+                            query,
+                            sort_specs,
+                            size=k,
+                            from_=0,
+                            knn=knn,
+                            min_score=min_score,
+                            search_after=search_after,
+                        )
                 else:
                     td, masks = ex.execute(
                         query, size=k, from_=0, knn=knn, min_score=min_score
                     )
-            agg_partial = None
-            if agg_nodes is not None:
+            if agg_nodes is not None and agg_partial is None:
                 from ..search.aggs import AggCollector
 
                 oracle = ex if isinstance(ex, NumpyExecutor) else ex._oracle

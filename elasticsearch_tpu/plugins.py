@@ -64,14 +64,22 @@ class PluginsService:
         # server starts (exactly the reference's constraint: PluginsService
         # loads during NodeConstruction, never after).
         self.rest_handlers: List[Tuple[str, str, Callable]] = []
+        self._loaded_specs: set = set()
 
     def install(self, plugin: Plugin) -> None:
         with self._lock:
-            self.plugins.append(plugin)
+            # validate EVERYTHING before mutating any registry: a plugin
+            # that fails halfway must not leave orphaned registrations
+            self._validate(plugin)
             self._apply(plugin)
+            self.plugins.append(plugin)
+            self._loaded_specs.add(getattr(plugin, "_spec", plugin.name))
 
-    def load_spec(self, spec: str) -> Plugin:
-        """Loads "module.path:ClassName" and installs it."""
+    def load_spec(self, spec: str) -> Optional[Plugin]:
+        """Loads "module.path:ClassName" and installs it (idempotent:
+        an already-loaded spec is skipped)."""
+        if spec in self._loaded_specs:
+            return None
         mod_name, _, cls_name = spec.partition(":")
         if not cls_name:
             raise ValueError(
@@ -82,26 +90,29 @@ class PluginsService:
         plugin = cls()
         if not isinstance(plugin, Plugin):
             raise TypeError(f"[{spec}] is not a Plugin subclass")
+        plugin._spec = spec
         self.install(plugin)
         return plugin
 
     def load_env(self, env: str = "ES_TPU_PLUGINS") -> List[Plugin]:
         specs = [s.strip() for s in os.environ.get(env, "").split(",") if s.strip()]
-        return [self.load_spec(s) for s in specs]
+        out = []
+        for s in specs:
+            p = self.load_spec(s)
+            if p is not None:
+                out.append(p)
+        return out
 
-    def _apply(self, plugin: Plugin) -> None:
-        # query parsers → the DSL dispatch table
+    def _validate(self, plugin: Plugin) -> None:
+        from .analysis.analyzer import AnalysisRegistry
+        from .ingest.service import PROCESSOR_TYPES, Processor
         from .search import dsl
 
-        for qname, parser in plugin.get_query_parsers().items():
+        for qname in plugin.get_query_parsers():
             if qname in dsl._PARSERS:
                 raise ValueError(
                     f"plugin [{plugin.name}] redefines query [{qname}]"
                 )
-            dsl._PARSERS[qname] = parser
-        # ingest processors
-        from .ingest.service import PROCESSOR_TYPES, Processor
-
         for ptype, cls in plugin.get_processors().items():
             if not (isinstance(cls, type) and issubclass(cls, Processor)):
                 raise TypeError(
@@ -111,23 +122,28 @@ class PluginsService:
                 raise ValueError(
                     f"plugin [{plugin.name}] redefines processor [{ptype}]"
                 )
-            PROCESSOR_TYPES[ptype] = cls
-        # analysis components
-        from .analysis.analyzer import AnalysisRegistry
-
-        for fname, factory in plugin.get_token_filters().items():
+        for fname in plugin.get_token_filters():
             if fname in AnalysisRegistry._FILTERS:
                 raise ValueError(
                     f"plugin [{plugin.name}] redefines token filter [{fname}]"
                 )
-            AnalysisRegistry._FILTERS[fname] = factory
-        for aname, analyzer in plugin.get_analyzers().items():
+        for aname in plugin.get_analyzers():
             if aname in AnalysisRegistry.EXTRA_ANALYZERS:
                 raise ValueError(
                     f"plugin [{plugin.name}] redefines analyzer [{aname}]"
                 )
-            AnalysisRegistry.EXTRA_ANALYZERS[aname] = analyzer
-        # REST handlers (consumed by RestActions)
+
+    def _apply(self, plugin: Plugin) -> None:
+        """Registers everything; callers must have run _validate first."""
+        from .analysis.analyzer import AnalysisRegistry
+        from .ingest.service import PROCESSOR_TYPES
+        from .search import dsl
+
+        dsl._PARSERS.update(plugin.get_query_parsers())
+        PROCESSOR_TYPES.update(plugin.get_processors())
+        AnalysisRegistry._FILTERS.update(plugin.get_token_filters())
+        AnalysisRegistry.EXTRA_ANALYZERS.update(plugin.get_analyzers())
+        # REST handlers (consumed by RestActions at construction)
         self.rest_handlers.extend(plugin.get_rest_handlers())
         # script bindings
         if plugin.get_script_contexts():

@@ -121,10 +121,8 @@ int64_t tiles_decode(const uint8_t* in, int64_t len, int32_t* out,
                 shift += 7;
             }
             int32_t v = zz_dec(u);
-            if (v == -1 && !first) {
-                row[i] = -1;
-            } else if (v == -1 && first) {
-                row[i] = -1;
+            if (v == -1) {
+                row[i] = -1;  // padding sentinel: first/prev untouched
             } else if (first) {
                 row[i] = v;
                 prev = v;
