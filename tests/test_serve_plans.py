@@ -126,6 +126,16 @@ class TestExtraction:
                 q, service.mappings, service.analysis
             ) is None, c
 
+    def test_bare_term_on_text_plan(self, service):
+        q = dsl.parse_query({"term": {"body": "alpha"}})
+        plan = extract_serve_plan(q, service.mappings, service.analysis)
+        assert plan is not None and plan.msm == 1
+        assert plan.groups[0].terms == (("alpha", 1.0, True),)
+
+    def test_bare_term_parity(self, service):
+        check_parity(service, {"query": {"term": {"body": "alpha"}},
+                               "size": 10})
+
     def test_multi_match_plan(self, service):
         q = dsl.parse_query({"multi_match": {
             "query": "alpha beta", "fields": ["title^2", "body"],
