@@ -58,6 +58,124 @@ ACTION_CTX_CLOSE = "indices:data/read/ctx_close"
 ACTION_SHARD_REPLICA_OPS = "indices:data/write/replica_ops"
 ACTION_SNAPSHOT_SHARD = "internal:snapshot/shard"
 ACTION_SHARD_DFS = "indices:data/read/dfs"
+ACTION_SHARD_CAN_MATCH = "indices:data/read/can_match"
+
+
+def _tree_has_range(q) -> bool:
+    if isinstance(q, dsl.RangeQuery):
+        return True
+    if isinstance(q, dsl.BoolQuery):
+        return any(
+            _tree_has_range(c)
+            for c in list(q.must) + list(q.filter) + list(q.should)
+        )
+    if isinstance(q, dsl.ConstantScoreQuery):
+        return _tree_has_range(q.filter_query)
+    if isinstance(q, (dsl.FunctionScoreQuery, dsl.ScriptScoreQuery)):
+        return _tree_has_range(q.query)
+    return False
+
+
+def _shard_field_bounds(eng, field: str):
+    """(min, max) over a shard's doc values for `field`, None when the
+    field is absent; cached per engine change generation."""
+    cache = getattr(eng, "_field_bounds_cache", None)
+    if cache is None or cache[0] != eng.change_generation:
+        cache = (eng.change_generation, {})
+        eng._field_bounds_cache = cache
+    bounds = cache[1].get(field, "?")
+    if bounds != "?":
+        return bounds
+    lo = None
+    hi = None
+    for seg in eng.segments:
+        nf = seg.numerics.get(field)
+        if nf is None or not nf.exists.any():
+            continue
+        vals = nf.values[nf.exists]
+        lo = float(vals.min()) if lo is None else min(lo, float(vals.min()))
+        hi = float(vals.max()) if hi is None else max(hi, float(vals.max()))
+    bounds = None if lo is None else (lo, hi)
+    cache[1][field] = bounds
+    return bounds
+
+
+def _can_match(q, eng, mappings, analysis) -> bool:
+    """Conservative per-shard matchability (MatchNoneQuery rewrite of
+    CanMatchPreFilterSearchPhase): False ONLY when the shard provably
+    has no matching doc."""
+    from ..index.mapping import TEXT
+    from ..search.executor import _coerce_numeric, search_field_terms
+
+    if isinstance(q, dsl.RangeQuery):
+        mf = mappings.get(q.field)
+        if mf is None or not mf.is_numeric():
+            return True
+        bounds = _shard_field_bounds(eng, q.field)
+        if bounds is None:
+            return False  # no doc has the field at all
+        lo, hi = bounds
+        try:
+            if q.gte is not None and hi < _coerce_numeric(mf.type, q.gte):
+                return False
+            if q.gt is not None and hi <= _coerce_numeric(mf.type, q.gt):
+                return False
+            if q.lte is not None and lo > _coerce_numeric(mf.type, q.lte):
+                return False
+            if q.lt is not None and lo >= _coerce_numeric(mf.type, q.lt):
+                return False
+        except (TypeError, ValueError):
+            return True
+        return True
+    if isinstance(q, (dsl.TermQuery, dsl.MatchQuery)):
+        mf = mappings.get(q.field)
+        if mf is None:
+            return True
+        if mf.type == TEXT:
+            if isinstance(q, dsl.MatchQuery):
+                terms = search_field_terms(
+                    mappings, analysis, q.field, q.query,
+                    getattr(q, "analyzer", None),
+                )
+                # OR needs any term present; AND needs all
+                need_all = q.operator == "and"
+            else:
+                terms = [dsl.term_token(q.value)]
+                need_all = True
+            checks = [
+                any(
+                    (pf := seg.postings.get(q.field)) is not None
+                    and pf.term_id(t) >= 0
+                    for seg in eng.segments
+                )
+                for t in terms
+            ]
+            if not checks:
+                return False
+            return all(checks) if need_all else any(checks)
+        return True
+    if isinstance(q, dsl.BoolQuery):
+        for c in list(q.must) + list(q.filter):
+            if not _can_match(c, eng, mappings, analysis):
+                return False
+        if q.should and not (q.must or q.filter):
+            if q.minimum_should_match is not None:
+                msm = dsl.parse_minimum_should_match(
+                    q.minimum_should_match, len(q.should)
+                )
+                if msm <= 0:
+                    return True  # msm 0: every doc matches
+            return any(
+                _can_match(c, eng, mappings, analysis) for c in q.should
+            )
+        return True
+    if isinstance(q, dsl.ConstantScoreQuery):
+        return _can_match(q.filter_query, eng, mappings, analysis)
+    if isinstance(q, (dsl.FunctionScoreQuery, dsl.ScriptScoreQuery)):
+        return _can_match(q.query, eng, mappings, analysis)
+    if isinstance(q, dsl.MatchNoneQuery):
+        return False
+    return True  # anything else: conservatively matchable
 
 
 def _dfs_terms(query, mappings, analysis) -> Dict[str, set]:
@@ -889,6 +1007,71 @@ class IndexService:
             }
         return out
 
+    # ---- can_match prefilter (CanMatchPreFilterSearchPhase) ----
+
+    def shard_can_match_local(self, sid: int, body: Optional[dict]) -> bool:
+        """Cheap per-shard match possibility check: range queries test
+        the shard's doc-value min/max, term/match queries test term-
+        dictionary presence; unknown nodes are conservatively matchable.
+        Deleted docs are ignored (over-inclusion is safe)."""
+        body = body or {}
+        if "query" not in body:
+            return True
+        try:
+            q = dsl.parse_query(body["query"])
+        except dsl.QueryParseError:
+            return True
+        eng = self._local.get(sid)
+        if eng is None:
+            return True
+        return _can_match(q, eng, self.mappings, self.analysis)
+
+    def _can_match_round(self, body: dict) -> set:
+        """Shard ids provably unable to match (skipped by the fan-out).
+        Engaged like the reference: many shards (pre_filter_shard_size,
+        default 128) or a range query in the tree; never when aggs/knn
+        need every shard's contribution."""
+        if (
+            self.num_shards <= 1
+            or "query" not in body
+            or body.get("aggs")
+            or body.get("aggregations")
+            or body.get("knn")
+        ):
+            return set()
+        try:
+            q = dsl.parse_query(body["query"])
+        except dsl.QueryParseError:
+            return set()
+        threshold = int(body.get("pre_filter_shard_size", 128))
+        if self.num_shards < threshold and not _tree_has_range(q):
+            return set()
+        skipped = set()
+
+        def one(sid: int) -> bool:
+            owner = self._search_node(sid)
+            if owner is None or owner == self.local_node:
+                return self.shard_can_match_local(sid, body)
+            try:
+                return bool(
+                    self.remote_call(
+                        owner,
+                        ACTION_SHARD_CAN_MATCH,
+                        {"index": self.name, "shard": sid, "body": body},
+                    )["can_match"]
+                )
+            except Exception:
+                return True  # a failed prefilter never skips a shard
+
+        # num_shards >= 2 here (guarded above)
+        futs = [
+            _FANOUT_POOL.submit(one, sid) for sid in range(self.num_shards)
+        ]
+        for sid, f in enumerate(futs):
+            if not f.result():
+                skipped.add(sid)
+        return skipped
+
     # ---- DFS phase (search_type=dfs_query_then_fetch) ----
 
     def shard_dfs_local(self, sid: int, spec: Dict[str, List[str]]) -> dict:
@@ -959,13 +1142,26 @@ class IndexService:
 
     # ---- search: coordinator fan-out + reduce ----
 
-    def _fan_out(self, body: dict, pinned: Optional[List] = None) -> List[dict]:
+    def _fan_out(
+        self,
+        body: dict,
+        pinned: Optional[List] = None,
+        skipped: Optional[set] = None,
+    ) -> List[dict]:
         """Scatter the per-shard request to every shard (local direct
         call or transport hop), gather wire-shaped results in shard
         order. `pinned[sid]` is a local executor or a {"node","ctx"}
-        token from pin_executors()."""
+        token from pin_executors(). Shards in `skipped` (can_match
+        prefilter) contribute empty results without dispatch."""
 
         def run(sid: int) -> dict:
+            if skipped and sid in skipped:
+                return {
+                    "total": 0,
+                    "relation": "eq",
+                    "max_score": None,
+                    "hits": [],
+                }
             pin = pinned[sid] if pinned is not None else None
             if isinstance(pin, dict):
                 # remote (or registry-held) pinned context
@@ -1085,7 +1281,13 @@ class IndexService:
             dfs = self._dfs_round(body)
             if dfs is not None:
                 sub["_dfs"] = dfs
-        shard_results = self._fan_out(sub, pinned_executors)
+        # can_match prefilter: provably-unmatchable shards are skipped
+        # before the scatter (pinned contexts pin every shard, so the
+        # prefilter only runs on unpinned searches)
+        skipped_shards = (
+            self._can_match_round(body) if pinned_executors is None else set()
+        )
+        shard_results = self._fan_out(sub, pinned_executors, skipped_shards)
 
         # ---- coordinator reduce (SearchPhaseController.reducedQueryPhase:
         # merge-sort per-shard pages by score/sort key, shard asc, rank
@@ -1137,7 +1339,7 @@ class IndexService:
             "_shards": {
                 "total": n,
                 "successful": n,
-                "skipped": 0,
+                "skipped": len(skipped_shards),
                 "failed": 0,
             },
             "hits": hits_obj,

@@ -42,6 +42,7 @@ from .indices import (
     ACTION_CTX_CLOSE,
     ACTION_CTX_OPEN,
     ACTION_SHARD_COUNT,
+    ACTION_SHARD_CAN_MATCH,
     ACTION_SHARD_DFS,
     ACTION_SHARD_FLUSH,
     ACTION_SHARD_GET,
@@ -509,6 +510,7 @@ class TpuNode:
         t.register_handler(ACTION_SHARD_SEARCH, self._handle_search_shard)
         t.register_handler(ACTION_SHARD_COUNT, self._handle_count_shard)
         t.register_handler(ACTION_SHARD_DFS, self._handle_dfs_shard)
+        t.register_handler(ACTION_SHARD_CAN_MATCH, self._handle_can_match)
         t.register_handler(ACTION_CTX_OPEN, self._handle_ctx_open)
         t.register_handler(ACTION_CTX_CLOSE, self._handle_ctx_close)
         t.register_handler(ACTION_SHARD_REPLICA_OPS, self._handle_replica_ops)
@@ -1397,6 +1399,14 @@ class TpuNode:
     def _handle_dfs_shard(self, p: dict) -> dict:
         idx = self._index_service(p["index"])
         return idx.shard_dfs_local(int(p["shard"]), p.get("spec") or {})
+
+    def _handle_can_match(self, p: dict) -> dict:
+        idx = self._index_service(p["index"])
+        return {
+            "can_match": idx.shard_can_match_local(
+                int(p["shard"]), p.get("body")
+            )
+        }
 
     # ---- pinned reader contexts (scroll/PIT across nodes) ----
 

@@ -69,6 +69,7 @@ class RestActions:
         add("GET", "/_nodes/stats", self.nodes_stats)
         add("GET", "/_stats", self.all_stats)
         add("GET", "/_cat/indices", self.cat_indices)
+        add("GET", "/_cat/shards", self.cat_shards)
         add("GET", "/_cat/health", self.cat_health)
         add("POST", "/_bulk", self.bulk)
         add("POST", "/_refresh", self.refresh_all)
@@ -500,6 +501,59 @@ class RestActions:
                 f"{r['health']} {r['status']} {r['index']} {r['uuid']} "
                 f"{r['pri']} {r['rep']} {r['docs.count']} {r['docs.deleted']} "
                 f"{r['store.size']} {r['pri.store.size']}"
+            )
+        return 200, "\n".join(lines) + "\n"
+
+    def cat_shards(self, body, params, qs):
+        """_cat/shards: one row per shard COPY with primary/replica
+        role, state, and owning node (replication made this real)."""
+        rows = []
+        node_name = self.cluster.node_name
+        for name, idx in sorted(self.cluster.indices.items()):
+            for sid in range(idx.num_shards):
+                entry = idx._entry(sid)
+                if entry is None:
+                    eng = idx.local_shards.get(sid)
+                    rows.append({
+                        "index": name, "shard": str(sid), "prirep": "p",
+                        "state": "STARTED",
+                        "docs": str(eng.num_docs if eng else 0),
+                        "node": node_name,
+                    })
+                    continue
+                copies = (
+                    [(entry["primary"], "p")]
+                    if entry["primary"] is not None
+                    else []
+                ) + [(r, "r") for r in entry["replicas"]]
+                if not copies:
+                    rows.append({
+                        "index": name, "shard": str(sid), "prirep": "p",
+                        "state": "UNASSIGNED", "docs": "", "node": "",
+                    })
+                for node, role in copies:
+                    in_sync = node in entry["in_sync"]
+                    eng = (
+                        idx.local_shards.get(sid)
+                        if node == idx.local_node
+                        else None
+                    )
+                    rows.append({
+                        "index": name,
+                        "shard": str(sid),
+                        "prirep": role,
+                        "state": "STARTED" if in_sync else "INITIALIZING",
+                        "docs": str(eng.num_docs) if eng is not None else "",
+                        "node": node,
+                    })
+        if qs.get("format") == ["json"]:
+            return 200, rows
+        header = "index shard prirep state docs node"
+        lines = [header] if "v" in qs else []
+        for r in rows:
+            lines.append(
+                f"{r['index']} {r['shard']} {r['prirep']} {r['state']} "
+                f"{r['docs']} {r['node']}"
             )
         return 200, "\n".join(lines) + "\n"
 

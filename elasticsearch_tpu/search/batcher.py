@@ -175,7 +175,7 @@ def _clause_terms(q, mappings, analysis) -> Optional[Tuple[str, List[str], float
         mf = mappings.get(q.field)
         if mf is None or mf.type != TEXT:
             return None
-        return q.field, [str(q.value)], q.boost
+        return q.field, [dsl.term_token(q.value)], q.boost
     return None
 
 

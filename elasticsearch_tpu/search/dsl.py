@@ -802,6 +802,16 @@ _PARSERS = {
 }
 
 
+def term_token(value: Any) -> str:
+    """Normalizes a term-query value to its index token: JSON booleans
+    index as "true"/"false" (shared by executors, the serve-plan
+    extractor, and the can_match prefilter — str(True) would probe the
+    nonexistent token "True")."""
+    if isinstance(value, bool):
+        return "true" if value else "false"
+    return str(value)
+
+
 def parse_minimum_should_match(msm: Any, num_clauses: int) -> int:
     """Lucene Queries.calculateMinShouldMatch subset: integers, negatives,
     and percentages (incl. negative percentages)."""

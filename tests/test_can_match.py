@@ -1,0 +1,158 @@
+"""can_match prefilter (CanMatchPreFilterSearchPhase): provably
+unmatchable shards are skipped before the scatter and reported in
+_shards.skipped; results stay identical.
+"""
+
+import pytest
+
+from elasticsearch_tpu.cluster.indices import IndexService
+
+
+@pytest.fixture(scope="module")
+def svc():
+    # route docs so the year ranges differ per shard: doc ids chosen to
+    # land on distinct shards isn't controllable, so give every shard a
+    # mix and use per-shard bounds via the range check.
+    svc = IndexService(
+        "cm",
+        settings={"number_of_shards": 4, "search.backend": "numpy"},
+        mappings_json={"properties": {
+            "body": {"type": "text"},
+            "year": {"type": "integer"},
+        }},
+    )
+    for i in range(200):
+        svc.index_doc(str(i), {"body": f"event alpha {i}", "year": 1900 + (i % 50)})
+    svc.refresh()
+    yield svc
+    svc.close()
+
+
+class TestShardCanMatch:
+    def test_range_outside_bounds(self, svc):
+        body = {"query": {"range": {"year": {"gte": 3000}}}}
+        assert not svc.shard_can_match_local(0, body)
+
+    def test_range_inside_bounds(self, svc):
+        body = {"query": {"range": {"year": {"gte": 1900, "lte": 1950}}}}
+        assert svc.shard_can_match_local(0, body)
+
+    def test_missing_term(self, svc):
+        assert not svc.shard_can_match_local(
+            0, {"query": {"match": {"body": "zzzznope"}}}
+        )
+        assert svc.shard_can_match_local(
+            0, {"query": {"match": {"body": "alpha"}}}
+        )
+
+    def test_bool_must_composes(self, svc):
+        body = {"query": {"bool": {"must": [
+            {"match": {"body": "alpha"}},
+            {"range": {"year": {"gt": 2500}}},
+        ]}}}
+        assert not svc.shard_can_match_local(0, body)
+
+    def test_unknown_nodes_conservative(self, svc):
+        assert svc.shard_can_match_local(
+            0, {"query": {"prefix": {"body": "zz"}}}
+        )
+
+    def test_msm_zero_matches_all(self, svc):
+        # minimum_should_match: 0 means every doc matches — the
+        # prefilter must never skip (review regression)
+        body = {"query": {"bool": {
+            "should": [{"range": {"year": {"gte": 3000}}}],
+            "minimum_should_match": 0,
+        }}}
+        assert svc.shard_can_match_local(0, body)
+        r = svc.search(body)
+        assert r["_shards"]["skipped"] == 0
+        assert r["hits"]["total"]["value"] == 200
+
+    def test_boolean_term_token(self):
+        svc2 = IndexService(
+            "cmb",
+            settings={"number_of_shards": 2, "search.backend": "numpy"},
+            mappings_json={"properties": {
+                "body": {"type": "text"},
+                "n": {"type": "integer"},
+            }},
+        )
+        try:
+            for i in range(10):
+                svc2.index_doc(str(i), {"body": "true story", "n": i})
+            svc2.refresh()
+            # boolean term value normalizes to the "true" token
+            r = svc2.search({"query": {"bool": {
+                "must": [{"term": {"body": True}}],
+                "filter": [{"range": {"n": {"gte": 0}}}],
+            }}, "size": 20})
+            assert r["hits"]["total"]["value"] == 10
+            assert r["_shards"]["skipped"] == 0
+        finally:
+            svc2.close()
+
+
+class TestPrefilterInSearch:
+    def test_range_query_skips_shards_and_keeps_results(self, svc):
+        # impossible range engages the prefilter (range in tree) and
+        # skips every shard
+        r = svc.search({"query": {"range": {"year": {"gte": 3000}}}})
+        assert r["hits"]["total"]["value"] == 0
+        assert r["_shards"]["skipped"] == 4
+        # satisfiable range: no skips, same results as ever
+        r2 = svc.search({
+            "query": {"range": {"year": {"gte": 1900, "lte": 1905}}},
+            "size": 100,
+        })
+        assert r2["_shards"]["skipped"] == 0
+        assert r2["hits"]["total"]["value"] == sum(
+            1 for i in range(200) if 1900 <= 1900 + (i % 50) <= 1905
+        )
+
+    def test_plain_match_does_not_engage_below_threshold(self, svc):
+        # no range in the tree and 4 < pre_filter_shard_size default
+        r = svc.search({"query": {"match": {"body": "zzzznope"}}})
+        assert r["_shards"]["skipped"] == 0
+
+    def test_explicit_threshold_engages(self, svc):
+        r = svc.search({
+            "query": {"match": {"body": "zzzznope"}},
+            "pre_filter_shard_size": 2,
+        })
+        assert r["_shards"]["skipped"] == 4
+        assert r["hits"]["total"]["value"] == 0
+
+    def test_aggs_disable_prefilter(self, svc):
+        r = svc.search({
+            "query": {"range": {"year": {"gte": 3000}}},
+            "aggs": {"g": {"global": {}, "aggs": {
+                "c": {"value_count": {"field": "year"}}}}},
+        })
+        assert r["_shards"]["skipped"] == 0
+        assert r["aggregations"]["g"]["doc_count"] == 200
+
+
+class TestCrossNodeCanMatch:
+    def test_skip_over_transport(self):
+        from elasticsearch_tpu.cluster.node import TpuNode
+
+        a = TpuNode("node-0").start()
+        b = TpuNode("node-1", seeds=[a.address]).start()
+        try:
+            a.create_index("cmx", {
+                "settings": {"number_of_shards": 4,
+                             "number_of_replicas": 0},
+                "mappings": {"properties": {"year": {"type": "integer"}}},
+            })
+            for i in range(40):
+                a.index_doc("cmx", str(i), {"year": 2000 + i})
+            a.refresh("cmx")
+            r = b.search("cmx", {
+                "query": {"range": {"year": {"gte": 9999}}},
+            })
+            assert r["_shards"]["skipped"] == 4
+            assert r["hits"]["total"]["value"] == 0
+        finally:
+            b.close()
+            a.close()
