@@ -1026,11 +1026,14 @@ class IndexService:
             return True
         return _can_match(q, eng, self.mappings, self.analysis)
 
-    def _can_match_round(self, body: dict) -> set:
-        """Shard ids provably unable to match (skipped by the fan-out).
-        Engaged like the reference: many shards (pre_filter_shard_size,
-        default 128) or a range query in the tree; never when aggs/knn
-        need every shard's contribution."""
+    def _can_match_round(self, body: dict):
+        """(skipped shard ids, pinned shard→copy owners). Engaged like
+        the reference: many shards (pre_filter_shard_size, default 128)
+        or a range query in the tree; never when aggs/knn need every
+        shard's contribution. When engaged, the SAME copy the prefilter
+        consulted serves the search (owners map pins it), so refresh-
+        visibility differences between copies can't skip a shard one
+        copy would have matched."""
         if (
             self.num_shards <= 1
             or "query" not in body
@@ -1038,18 +1041,21 @@ class IndexService:
             or body.get("aggregations")
             or body.get("knn")
         ):
-            return set()
+            return set(), None
         try:
             q = dsl.parse_query(body["query"])
         except dsl.QueryParseError:
-            return set()
+            return set(), None
         threshold = int(body.get("pre_filter_shard_size", 128))
         if self.num_shards < threshold and not _tree_has_range(q):
-            return set()
+            return set(), None
+        owners = {
+            sid: self._search_node(sid) for sid in range(self.num_shards)
+        }
         skipped = set()
 
         def one(sid: int) -> bool:
-            owner = self._search_node(sid)
+            owner = owners[sid]
             if owner is None or owner == self.local_node:
                 return self.shard_can_match_local(sid, body)
             try:
@@ -1070,7 +1076,7 @@ class IndexService:
         for sid, f in enumerate(futs):
             if not f.result():
                 skipped.add(sid)
-        return skipped
+        return skipped, owners
 
     # ---- DFS phase (search_type=dfs_query_then_fetch) ----
 
@@ -1087,7 +1093,9 @@ class IndexService:
             terms[f] = {t: reader.term_stats(f, t)[0] for t in ts}
         return {"fields": fields, "terms": terms}
 
-    def _dfs_round(self, body: dict) -> Optional[dict]:
+    def _dfs_round(
+        self, body: dict, skipped: Optional[set] = None
+    ) -> Optional[dict]:
         """Aggregates df/doc_count/sum_ttf across every shard for the
         query's terms (SearchPhaseController.aggregateDfs); the result
         rides the per-shard request as `_dfs` and overrides shard-local
@@ -1117,12 +1125,14 @@ class IndexService:
         agg_terms: Dict[str, Dict[str, int]] = {
             f: {t: 0 for t in ts} for f, ts in spec.items()
         }
-        if self.num_shards == 1:
-            results = [one(0)]
+        sids = [
+            sid for sid in range(self.num_shards)
+            if not (skipped and sid in skipped)
+        ]
+        if len(sids) <= 1:
+            results = [one(s) for s in sids]
         else:
-            futs = [
-                _FANOUT_POOL.submit(one, sid) for sid in range(self.num_shards)
-            ]
+            futs = [_FANOUT_POOL.submit(one, sid) for sid in sids]
             results = [f.result() for f in futs]
         for r in results:
             for f, (dc, ttf) in r["fields"].items():
@@ -1147,12 +1157,14 @@ class IndexService:
         body: dict,
         pinned: Optional[List] = None,
         skipped: Optional[set] = None,
+        owners: Optional[Dict[int, Optional[str]]] = None,
     ) -> List[dict]:
         """Scatter the per-shard request to every shard (local direct
         call or transport hop), gather wire-shaped results in shard
         order. `pinned[sid]` is a local executor or a {"node","ctx"}
         token from pin_executors(). Shards in `skipped` (can_match
-        prefilter) contribute empty results without dispatch."""
+        prefilter) contribute empty results without dispatch; `owners`
+        pins copy selection to the copies the prefilter consulted."""
 
         def run(sid: int) -> dict:
             if skipped and sid in skipped:
@@ -1175,7 +1187,9 @@ class IndexService:
                         "ctx": pin["ctx"],
                     },
                 )
-            owner = self._search_node(sid)
+            owner = (
+                owners[sid] if owners is not None else self._search_node(sid)
+            )
             if owner is None or owner == self.local_node:
                 return self.shard_search_local(sid, body, pinned_executor=pin)
             return self.remote_call(
@@ -1277,17 +1291,20 @@ class IndexService:
 
         # every shard returns the full global page's worth of hits
         sub = {**body, "from": 0, "size": from_ + size}
+        # can_match prefilter FIRST (the reference's phase order), so a
+        # DFS round never fans out to shards about to be skipped; pinned
+        # contexts pin every shard, so the prefilter only runs unpinned
+        if pinned_executors is None:
+            skipped_shards, fixed_owners = self._can_match_round(body)
+        else:
+            skipped_shards, fixed_owners = set(), None
         if body.get("search_type") == "dfs_query_then_fetch":
-            dfs = self._dfs_round(body)
+            dfs = self._dfs_round(body, skipped_shards)
             if dfs is not None:
                 sub["_dfs"] = dfs
-        # can_match prefilter: provably-unmatchable shards are skipped
-        # before the scatter (pinned contexts pin every shard, so the
-        # prefilter only runs on unpinned searches)
-        skipped_shards = (
-            self._can_match_round(body) if pinned_executors is None else set()
+        shard_results = self._fan_out(
+            sub, pinned_executors, skipped_shards, fixed_owners
         )
-        shard_results = self._fan_out(sub, pinned_executors, skipped_shards)
 
         # ---- coordinator reduce (SearchPhaseController.reducedQueryPhase:
         # merge-sort per-shard pages by score/sort key, shard asc, rank

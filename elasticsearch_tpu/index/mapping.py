@@ -56,6 +56,8 @@ class MappedField:
     format: Optional[str] = None
     # keyword ignore_above
     ignore_above: Optional[int] = None
+    # copy_to targets (values also indexed into these fields)
+    copy_to: tuple = ()
 
     def is_numeric(self) -> bool:
         return self.type in NUMERIC_TYPES or self.type in (DATE, BOOLEAN)
@@ -82,6 +84,11 @@ class Mappings:
             self.strict = mapping_json["dynamic"] == "strict"
         else:
             self.strict = False
+        # dynamic_templates: [{name: {match/path_match/
+        # match_mapping_type, mapping}}] applied by dynamic_map
+        self.dynamic_templates: List[dict] = list(
+            mapping_json.get("dynamic_templates", [])
+        )
         self._parse_properties(mapping_json.get("properties", {}), prefix="")
 
     def _parse_properties(self, props: dict, prefix: str):
@@ -127,6 +134,11 @@ class Mappings:
             similarity=cfg.get("similarity", "cosine"),
             format=cfg.get("format"),
             ignore_above=cfg.get("ignore_above"),
+            copy_to=tuple(
+                [cfg["copy_to"]]
+                if isinstance(cfg.get("copy_to"), str)
+                else cfg.get("copy_to", ())
+            ),
         )
         if ftype == DENSE_VECTOR and f.dims <= 0:
             # ES infers dims from the first vector if unset; we allow that too
@@ -144,6 +156,15 @@ class Mappings:
                     f"mapping set to strict, dynamic introduction of [{name}] is not allowed"
                 )
             return None
+        tpl = self._match_dynamic_template(name, value)
+        if tpl is not None:
+            cfg = dict(tpl)
+            dynamic_type = _json_type_name(value)
+            ftype = cfg.pop("type", None)
+            if ftype in (None, "{dynamic_type}"):
+                ftype = _DYNAMIC_TYPE_MAP.get(dynamic_type, TEXT)
+            self._add_field(name, ftype, cfg)
+            return self.fields[name]
         if isinstance(value, bool):
             ftype = BOOLEAN
         elif isinstance(value, int):
@@ -160,6 +181,39 @@ class Mappings:
             return None
         self._add_field(name, ftype, {})
         return self.fields[name]
+
+    def _match_dynamic_template(self, name: str, value) -> Optional[dict]:
+        """First dynamic template whose match/path_match/
+        match_mapping_type conditions all hold (DynamicTemplate)."""
+        import fnmatch
+
+        def fn_any(patterns, target: str) -> bool:
+            # ES accepts a single pattern or an array for match/unmatch/
+            # path_match
+            pats = patterns if isinstance(patterns, list) else [patterns]
+            return any(fnmatch.fnmatch(target, str(p)) for p in pats)
+
+        vtype = _json_type_name(value)
+        leaf = name.rsplit(".", 1)[-1]
+        for entry in self.dynamic_templates:
+            if not isinstance(entry, dict) or len(entry) != 1:
+                continue
+            tpl = next(iter(entry.values()))
+            if not isinstance(tpl, dict) or "mapping" not in tpl:
+                continue
+            if "match" in tpl and not fn_any(tpl["match"], leaf):
+                continue
+            if "unmatch" in tpl and fn_any(tpl["unmatch"], leaf):
+                continue
+            if "path_match" in tpl and not fn_any(tpl["path_match"], name):
+                continue
+            if (
+                "match_mapping_type" in tpl
+                and tpl["match_mapping_type"] not in ("*", vtype)
+            ):
+                continue
+            return tpl["mapping"]
+        return None
 
     def merge(self, mapping_json: dict):
         """MapperService.merge subset: add new fields; reject type changes
@@ -194,8 +248,17 @@ class Mappings:
             for s in subs:
                 if s not in mine_subs:
                     mine_subs.append(s)
+        if "dynamic_templates" in mapping_json:
+            # ES replaces the template list wholesale on merge
+            self.dynamic_templates = list(other.dynamic_templates)
 
     def to_json(self) -> dict:
+        out = self._to_json_props()
+        if self.dynamic_templates:
+            out["dynamic_templates"] = self.dynamic_templates
+        return out
+
+    def _to_json_props(self) -> dict:
         props: dict = {}
         mf_children = {
             f"{p}.{s}" for p, subs in self.multi_fields.items() for s in subs
@@ -226,7 +289,32 @@ class Mappings:
             entry["similarity"] = f.similarity
         if f.ignore_above is not None:
             entry["ignore_above"] = f.ignore_above
+        if f.copy_to:
+            entry["copy_to"] = list(f.copy_to)
         return entry
+
+
+_DYNAMIC_TYPE_MAP = {
+    "string": TEXT,
+    "long": LONG,
+    "double": FLOAT,
+    "boolean": BOOLEAN,
+}
+
+
+def _json_type_name(value) -> str:
+    """ES match_mapping_type vocabulary for a JSON value."""
+    if isinstance(value, bool):
+        return "boolean"
+    if isinstance(value, int):
+        return "long"
+    if isinstance(value, float):
+        return "double"
+    if isinstance(value, str):
+        return "string"
+    if isinstance(value, dict):
+        return "object"
+    return "*"
 
 
 @dataclass
@@ -330,13 +418,27 @@ class DocumentParser:
                     continue
             if f is None:
                 continue
-            self._index_values(f, path, values, out)
-            # multi-fields explicitly declared via "fields" (or dynamic
-            # .keyword) — never object children that merely share a prefix
-            for sub in self.mappings.multi_fields.get(path, ()):
-                sub_field = self.mappings.get(f"{path}.{sub}")
-                if sub_field is not None:
-                    self._index_values(sub_field, f"{path}.{sub}", values, out)
+            self._index_with_multifields(f, path, values, out)
+            # copy_to: values also index into the target fields (one
+            # level — the reference rejects copy_to chains), including
+            # the targets' own multi-fields (e.g. a dynamic .keyword)
+            for target in f.copy_to:
+                tf = self.mappings.get(target)
+                if tf is None:
+                    tf = self.mappings.dynamic_map(target, values[0])
+                if tf is not None:
+                    self._index_with_multifields(tf, target, values, out)
+
+    def _index_with_multifields(
+        self, f: MappedField, path: str, values: List[Any], out: ParsedDocument
+    ):
+        self._index_values(f, path, values, out)
+        # multi-fields explicitly declared via "fields" (or dynamic
+        # .keyword) — never object children that merely share a prefix
+        for sub in self.mappings.multi_fields.get(path, ()):
+            sub_field = self.mappings.get(f"{path}.{sub}")
+            if sub_field is not None:
+                self._index_values(sub_field, f"{path}.{sub}", values, out)
 
     def _index_values(self, f: MappedField, path: str, values: List[Any], out: ParsedDocument):
         if f.type == TEXT:

@@ -1150,10 +1150,9 @@ class NumpyExecutor:
         if mf is None:
             return np.zeros(n, bool), np.zeros(n, np.float32)
         if mf.type in (TEXT, KEYWORD):
-            value = q.value
-            if isinstance(value, bool):
-                value = "true" if value else "false"
-            return self._score_term_dense(seg, q.field, str(value), q.boost)
+            return self._score_term_dense(
+                seg, q.field, dsl.term_token(q.value), q.boost
+            )
         # numeric/date/boolean: doc-values equality, constant score
         nf = seg.numerics.get(q.field)
         if nf is None:

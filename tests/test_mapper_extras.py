@@ -1,0 +1,42 @@
+from elasticsearch_tpu.cluster.service import ClusterService
+
+def test_copy_to():
+    c = ClusterService()
+    try:
+        c.create_index("ct", {"mappings": {"properties": {
+            "first": {"type": "text", "copy_to": "full"},
+            "last": {"type": "text", "copy_to": ["full"]},
+            "full": {"type": "text"},
+        }}})
+        idx = c.get_index("ct")
+        idx.index_doc("1", {"first": "ada", "last": "lovelace"})
+        idx.refresh()
+        r = c.search("ct", {"query": {"match": {"full": {"query": "ada lovelace", "operator": "and"}}}})
+        assert r["hits"]["total"]["value"] == 1
+    finally:
+        c.close()
+
+def test_dynamic_templates():
+    c = ClusterService()
+    try:
+        c.create_index("dt", {"mappings": {
+            "dynamic_templates": [
+                {"ids_as_keywords": {"match": "*_id",
+                                     "mapping": {"type": "keyword"}}},
+                {"strings_text": {"match_mapping_type": "string",
+                                  "mapping": {"type": "text",
+                                              "analyzer": "whitespace"}}},
+            ],
+        }})
+        idx = c.get_index("dt")
+        idx.index_doc("1", {"user_id": "ABC-1", "note": "Hello World"})
+        idx.refresh()
+        assert idx.mappings.get("user_id").type == "keyword"
+        assert idx.mappings.get("note").type == "text"
+        assert idx.mappings.get("note").analyzer == "whitespace"
+        r = c.search("dt", {"query": {"term": {"user_id": "ABC-1"}}})
+        assert r["hits"]["total"]["value"] == 1
+        # round-trips through to_json (persisted mappings)
+        assert idx.mappings.to_json()["dynamic_templates"]
+    finally:
+        c.close()
