@@ -954,6 +954,8 @@ class IndexService:
         }
         if agg_partial is not None:
             out["aggs"] = agg_partial
+        if "suggest" in body:
+            out["suggest"] = self._shard_suggest(ex, body["suggest"])
         if profile:
             # per-shard query-phase breakdown ("profile": true —
             # Profilers/QueryProfiler response shape). The breakdown
@@ -1040,7 +1042,9 @@ class IndexService:
             or body.get("aggs")
             or body.get("aggregations")
             or body.get("knn")
+            or body.get("suggest")
         ):
+            # suggest/aggs/knn need every shard's contribution
             return set(), None
         try:
             q = dsl.parse_query(body["query"])
@@ -1077,6 +1081,67 @@ class IndexService:
             if not f.result():
                 skipped.add(sid)
         return skipped, owners
+
+    # ---- suggest phase (SuggestPhase: term suggester) ----
+
+    def _shard_suggest(self, ex, suggest_body: dict) -> dict:
+        """Per-shard term-suggester candidates: for each analyzed token,
+        dictionary terms within max_edits with their doc freq, plus the
+        token's own df (for suggest_mode=missing at reduce)."""
+        from ..search.executor import _levenshtein_at_most
+
+        reader = ex.reader
+        out: Dict[str, list] = {}
+        for name, spec in (suggest_body or {}).items():
+            if not isinstance(spec, dict) or "term" not in spec:
+                continue
+            term_spec = spec["term"] or {}
+            field = term_spec.get("field")
+            text = spec.get("text", "")
+            if not field:
+                raise dsl.QueryParseError(
+                    f"suggester [{name}] requires [term.field]"
+                )
+            max_edits = int(term_spec.get("max_edits", 2))
+            mf = self.mappings.get(field)
+            analyzer_name = (
+                (mf.search_analyzer or mf.analyzer)
+                if mf is not None
+                else "standard"
+            )
+            try:
+                toks = self.analysis.get(analyzer_name).analyze(str(text))
+            except ValueError:
+                toks = []
+            entries = []
+            for t_obj in toks:
+                tok = t_obj.text
+                own_df, _ = reader.term_stats(field, tok)
+                cands: Dict[str, int] = {}
+                for seg in reader.segments:
+                    pf = seg.postings.get(field)
+                    if pf is None:
+                        continue
+                    for t in pf.terms:
+                        if t == tok or abs(len(t) - len(tok)) > max_edits:
+                            continue
+                        if _levenshtein_at_most(tok, t, max_edits):
+                            df, _ = reader.term_stats(field, t)
+                            cands[t] = df
+                entries.append(
+                    {
+                        "text": tok,
+                        # analyzer offsets point at the SURFACE text, so
+                        # corrections splice into the right span even
+                        # when the token differs by case/stemming
+                        "offset": t_obj.start_offset,
+                        "length": t_obj.end_offset - t_obj.start_offset,
+                        "own_df": int(own_df),
+                        "options": cands,
+                    }
+                )
+            out[name] = entries
+        return out
 
     # ---- DFS phase (search_type=dfs_query_then_fetch) ----
 
@@ -1367,6 +1432,11 @@ class IndexService:
                     r["profile"] for r in shard_results if r.get("profile")
                 ]
             }
+        if "suggest" in body:
+            resp["suggest"] = _reduce_suggest(
+                body["suggest"],
+                [r["suggest"] for r in shard_results if r.get("suggest")],
+            )
         agg_partials = [
             r["aggs"] for r in shard_results if r.get("aggs") is not None
         ]
@@ -1750,6 +1820,56 @@ class IndexService:
             "settings": {"index": index_settings},
             "mappings": self.mappings.to_json(),
         }
+
+
+def _reduce_suggest(suggest_body: dict, shard_parts: List[dict]) -> dict:
+    """Coordinator suggest reduce (TermSuggester reduce): sum candidate
+    and own doc freqs across shards, honor suggest_mode, score by
+    normalized edit similarity (desc), then freq (desc)."""
+    out: Dict[str, list] = {}
+    for name, spec in (suggest_body or {}).items():
+        if not isinstance(spec, dict) or "term" not in spec:
+            continue
+        term_spec = spec["term"] or {}
+        size = int(term_spec.get("size", 5))
+        mode = str(term_spec.get("suggest_mode", "missing"))
+        parts = [p.get(name, []) for p in shard_parts]
+        if not parts or not parts[0]:
+            out[name] = []
+            continue
+        entries = []
+        for ti, skeleton in enumerate(parts[0]):
+            own_df = 0
+            freqs: Dict[str, int] = {}
+            for p in parts:
+                if ti >= len(p):
+                    continue
+                own_df += int(p[ti].get("own_df", 0))
+                for t, f in p[ti].get("options", {}).items():
+                    freqs[t] = freqs.get(t, 0) + int(f)
+            tok = skeleton["text"]
+            options = []
+            if not (mode == "missing" and own_df > 0):
+                from ..search.executor import levenshtein_distance
+
+                for t, f in freqs.items():
+                    if mode == "popular" and f <= own_df:
+                        continue
+                    dist = levenshtein_distance(tok, t)
+                    score = 1.0 - dist / max(len(tok), len(t), 1)
+                    options.append({"text": t, "score": round(score, 6),
+                                    "freq": f})
+                options.sort(key=lambda o: (-o["score"], -o["freq"], o["text"]))
+            entries.append(
+                {
+                    "text": tok,
+                    "offset": skeleton["offset"],
+                    "length": skeleton["length"],
+                    "options": options[:size],
+                }
+            )
+        out[name] = entries
+    return out
 
 
 def dump_engine_docs(eng: ShardEngine) -> List[dict]:

@@ -164,6 +164,13 @@ class Mappings:
             if ftype in (None, "{dynamic_type}"):
                 ftype = _DYNAMIC_TYPE_MAP.get(dynamic_type, TEXT)
             self._add_field(name, ftype, cfg)
+            # template "fields" blocks declare multi-fields exactly as
+            # explicit mappings do (the canonical text+.keyword shape)
+            for sub, subcfg in cfg.get("fields", {}).items():
+                self._add_field(
+                    f"{name}.{sub}", subcfg.get("type", KEYWORD), subcfg
+                )
+                self.multi_fields.setdefault(name, []).append(sub)
             return self.fields[name]
         if isinstance(value, bool):
             ftype = BOOLEAN

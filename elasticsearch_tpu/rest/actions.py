@@ -137,6 +137,8 @@ class RestActions:
         add("GET", "/{index}/_search", self.search)
         add("POST", "/{index}/_count", self.count)
         add("GET", "/{index}/_count", self.count)
+        add("POST", "/{index}/_validate/query", self.validate_query)
+        add("GET", "/{index}/_validate/query", self.validate_query)
         add("POST", "/{index}/_msearch", self.msearch)
         add("POST", "/{index}/_bulk", self.bulk)
         add("POST", "/{index}/_pit", self.open_pit)
@@ -742,8 +744,9 @@ class RestActions:
         return status, self._doc_response(params["index"], r, idx.num_shards)
 
     def update_doc(self, body, params, qs):
-        """_update: partial doc merge / doc_as_upsert / scripted noop
-        detection (TransportUpdateAction subset: doc merge only)."""
+        """_update: partial doc merge, doc_as_upsert, SCRIPTED updates
+        (ctx._source/ctx.op contract), noop detection
+        (TransportUpdateAction + UpdateHelper)."""
         idx, index_name = self.cluster.resolve_write_index(
             params["index"], allow_auto_create=False
         )
@@ -751,7 +754,8 @@ class RestActions:
         routing = qs.get("routing", [None])[0]
         body = body or {}
         doc_part = body.get("doc")
-        if doc_part is None:
+        script = body.get("script")
+        if doc_part is None and script is None:
             return 400, error_body(
                 400,
                 "action_request_validation_exception",
@@ -760,8 +764,24 @@ class RestActions:
         existing = idx.get_doc(params["id"], routing=routing)
         if existing is None:
             if body.get("doc_as_upsert") or "upsert" in body:
-                base = body.get("upsert", doc_part if body.get("doc_as_upsert") else {})
-                merged = deep_merge(base, doc_part)
+                base = body.get(
+                    "upsert",
+                    doc_part if body.get("doc_as_upsert") else {},
+                )
+                merged = (
+                    deep_merge(base, doc_part)
+                    if doc_part is not None
+                    else base
+                )
+                if script is not None and body.get("scripted_upsert"):
+                    merged, op = self._run_update_script(script, merged, params["id"])
+                    if op == "none":
+                        return 200, {
+                            "_index": params["index"], "_id": params["id"],
+                            "result": "noop",
+                            "_shards": {"total": 0, "successful": 0,
+                                        "failed": 0},
+                        }
                 r = idx.index_doc(params["id"], merged, routing=routing)
                 self._maybe_refresh(idx, qs)
                 return 201, self._doc_response(params["index"], r, idx.num_shards)
@@ -770,6 +790,29 @@ class RestActions:
                 "document_missing_exception",
                 f"[{params['id']}]: document missing",
             )
+        if script is not None:
+            merged, op = self._run_update_script(
+                script, dict(existing["_source"]), params["id"]
+            )
+            if op == "none":
+                return 200, {
+                    "_index": params["index"],
+                    "_id": params["id"],
+                    "_version": existing["_version"],
+                    "result": "noop",
+                    "_shards": {"total": 0, "successful": 0, "failed": 0},
+                    "_seq_no": existing["_seq_no"],
+                    "_primary_term": existing["_primary_term"],
+                }
+            if op == "delete":
+                r = idx.delete_doc(params["id"], routing=routing)
+                self._maybe_refresh(idx, qs)
+                return 200, self._doc_response(
+                    params["index"], r, idx.num_shards
+                )
+            r = idx.index_doc(params["id"], merged, routing=routing)
+            self._maybe_refresh(idx, qs)
+            return 200, self._doc_response(params["index"], r, idx.num_shards)
         merged = deep_merge(existing["_source"], doc_part)
         if merged == existing["_source"] and body.get("detect_noop", True):
             return 200, {
@@ -784,6 +827,39 @@ class RestActions:
         r = idx.index_doc(params["id"], merged, routing=routing)
         self._maybe_refresh(idx, qs)
         return 200, self._doc_response(params["index"], r, idx.num_shards)
+
+    @staticmethod
+    def _run_update_script(script, source: dict, doc_id: str):
+        """(new_source, op) for an update script: ctx._source mutations
+        + ctx.op in {index (default), none/noop, delete}. The source is
+        DEEP-copied first — the engine's get() hands back the live
+        stored object, and a script must never mutate it in place
+        (especially on the noop path)."""
+        import copy
+
+        from ..script import ScriptError, script_service
+
+        ctx = {
+            "_source": copy.deepcopy(source),
+            "_id": doc_id,
+            "op": "index",
+        }
+        try:
+            script_service.run_ingest(script, ctx)
+        except ScriptError as e:
+            raise ClusterError(400, str(e), "script_exception")
+        op = str(ctx.get("op", "index"))
+        if op in ("noop", "none"):
+            op = "none"
+        elif op not in ("index", "delete"):
+            # UpdateHelper rejects unknown ops instead of masking typos
+            raise ClusterError(
+                400,
+                f"Operation type [{op}] not allowed, only [noop, index, "
+                "delete] are allowed",
+                "illegal_argument_exception",
+            )
+        return ctx.get("_source", source), op
 
     def mget(self, body, params, qs):
         body = body or {}
@@ -931,6 +1007,34 @@ class RestActions:
             if toks:
                 pos_offset += toks[-1].position + 100  # position_increment_gap
         return 200, {"tokens": tokens}
+
+    def validate_query(self, body, params, qs):
+        """_validate/query (ValidateQueryAction): parse-checks the query
+        without executing it; explain=true carries the error."""
+        from ..search import dsl as _dsl
+
+        targets = self.cluster.resolve(params["index"])
+        n = len(targets)
+        resp = {
+            "valid": True,
+            "_shards": {"total": n, "successful": n, "failed": 0},
+        }
+        explain = qs.get("explain", ["false"])[0] in ("true", "")
+        try:
+            q = (body or {}).get("query")
+            if q is not None:
+                _dsl.parse_query(q)
+            if explain:
+                resp["explanations"] = [
+                    {"index": name, "valid": True,
+                     "explanation": "query parsed"}
+                    for name, _ in targets
+                ]
+        except _dsl.QueryParseError as e:
+            resp["valid"] = False
+            if explain:
+                resp["error"] = str(e)
+        return 200, resp
 
     def count(self, body, params, qs):
         return 200, self.cluster.count(params["index"], body)

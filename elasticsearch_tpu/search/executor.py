@@ -1562,6 +1562,22 @@ def _levenshtein_at_most(a: str, b: str, k: int) -> bool:
     return prev[-1] <= k
 
 
+def levenshtein_distance(a: str, b: str) -> int:
+    """Exact edit distance (unbounded variant of _levenshtein_at_most
+    above — keep the two in sync)."""
+    if a == b:
+        return 0
+    if not a or not b:
+        return max(len(a), len(b))
+    prev = list(range(len(b) + 1))
+    for i, ca in enumerate(a, 1):
+        cur = [i]
+        for j, cb in enumerate(b, 1):
+            cur.append(min(prev[j] + 1, cur[-1] + 1, prev[j - 1] + (ca != cb)))
+        prev = cur
+    return prev[-1]
+
+
 def search_field_terms(
     mappings, analysis, field: str, text: str, override: Optional[str] = None
 ) -> List[str]:

@@ -1,0 +1,186 @@
+"""Term suggester, scripted updates, _validate/query.
+
+Reference analogs: SuggestPhase/TermSuggester, TransportUpdateAction's
+script path (UpdateHelper + ctx.op), ValidateQueryAction.
+"""
+
+import pytest
+
+from elasticsearch_tpu.cluster.service import ClusterService
+from elasticsearch_tpu.rest.actions import RestActions
+
+
+@pytest.fixture
+def cluster():
+    c = ClusterService()
+    c.create_index(
+        "s",
+        {
+            "settings": {"number_of_shards": 2, "search.backend": "numpy"},
+            "mappings": {"properties": {"body": {"type": "text"},
+                                        "n": {"type": "integer"}}},
+        },
+    )
+    idx = c.get_index("s")
+    texts = ["design of systems", "designs that last", "resign yourself",
+             "design patterns", "the sign of four"]
+    for i, t in enumerate(texts):
+        idx.index_doc(str(i), {"body": t, "n": i})
+    idx.refresh()
+    yield c
+    c.close()
+
+
+class TestTermSuggester:
+    def test_misspelling_suggests_corrections(self, cluster):
+        r = cluster.search("s", {
+            "size": 0,
+            "suggest": {"fix": {"text": "desing",
+                                "term": {"field": "body"}}},
+        })
+        entry = r["suggest"]["fix"][0]
+        assert entry["text"] == "desing"
+        opts = [o["text"] for o in entry["options"]]
+        assert "design" in opts
+        by = {o["text"]: o for o in entry["options"]}
+        assert by["design"]["freq"] == 2  # docs 0 and 3
+        assert by["design"]["score"] > 0.5
+
+    def test_suggest_mode_missing_skips_known_terms(self, cluster):
+        r = cluster.search("s", {
+            "size": 0,
+            "suggest": {"fix": {"text": "design",
+                                "term": {"field": "body"}}},
+        })
+        assert r["suggest"]["fix"][0]["options"] == []
+        # always mode returns neighbors even for an indexed term
+        r2 = cluster.search("s", {
+            "size": 0,
+            "suggest": {"fix": {"text": "design",
+                                "term": {"field": "body",
+                                         "suggest_mode": "always"}}},
+        })
+        opts = [o["text"] for o in r2["suggest"]["fix"][0]["options"]]
+        assert "designs" in opts or "resign" in opts
+
+    def test_multi_token_offsets(self, cluster):
+        r = cluster.search("s", {
+            "size": 0,
+            "suggest": {"fix": {"text": "desing paterns",
+                                "term": {"field": "body"}}},
+        })
+        entries = r["suggest"]["fix"]
+        assert [e["text"] for e in entries] == ["desing", "paterns"]
+        assert entries[0]["offset"] == 0
+        assert entries[1]["offset"] == 7
+
+    def test_offsets_survive_case_normalization(self, cluster):
+        # surface "Desing" lowercases to token "desing": offsets must
+        # point at the SURFACE span (review regression)
+        r = cluster.search("s", {
+            "size": 0,
+            "suggest": {"fix": {"text": "THE Desing",
+                                "term": {"field": "body"}}},
+        })
+        entries = r["suggest"]["fix"]
+        by_text = {e["text"]: e for e in entries}
+        assert by_text["desing"]["offset"] == 4
+        assert by_text["desing"]["length"] == 6
+
+    def test_suggest_disables_can_match_skips(self, cluster):
+        # an impossible range would engage the prefilter; suggest must
+        # keep every shard contributing (review regression)
+        r = cluster.search("s", {
+            "size": 0,
+            "query": {"range": {"n": {"gte": 9999}}},
+            "suggest": {"fix": {"text": "desing",
+                                "term": {"field": "body"}}},
+        })
+        assert r["_shards"]["skipped"] == 0
+        opts = [o["text"] for o in r["suggest"]["fix"][0]["options"]]
+        assert "design" in opts
+
+
+class TestScriptedUpdate:
+    def test_script_mutates_source(self, cluster):
+        a = RestActions(cluster)
+        st, resp = a.update_doc(
+            {"script": {"source": "ctx['_source']['n'] += params.d",
+                        "params": {"d": 10}}},
+            {"index": "s", "id": "1"}, {},
+        )
+        assert st == 200 and resp["result"] == "updated"
+        assert cluster.get_index("s").get_doc("1")["_source"]["n"] == 11
+
+    def test_script_op_none_is_noop(self, cluster):
+        a = RestActions(cluster)
+        st, resp = a.update_doc(
+            {"script": {"source": "ctx['op'] = 'none'"}},
+            {"index": "s", "id": "1"}, {},
+        )
+        assert st == 200 and resp["result"] == "noop"
+
+    def test_script_op_delete(self, cluster):
+        a = RestActions(cluster)
+        st, resp = a.update_doc(
+            {"script": {"source": "ctx['op'] = 'delete'"}},
+            {"index": "s", "id": "2"}, {},
+        )
+        assert st == 200 and resp["result"] == "deleted"
+        assert cluster.get_index("s").get_doc("2") is None
+
+    def test_noop_script_never_mutates_stored_source(self, cluster):
+        """The engine's get() hands back the live stored object; a
+        script mutating ctx._source then declaring op=none must leave
+        the stored document untouched (review regression)."""
+        a = RestActions(cluster)
+        before = cluster.get_index("s").get_doc("3")["_source"]["n"]
+        st, resp = a.update_doc(
+            {"script": {"source":
+                        "ctx['_source']['n'] += 100\nctx['op'] = 'none'"}},
+            {"index": "s", "id": "3"}, {},
+        )
+        assert st == 200 and resp["result"] == "noop"
+        assert cluster.get_index("s").get_doc("3")["_source"]["n"] == before
+
+    def test_unknown_ctx_op_rejected(self, cluster):
+        from elasticsearch_tpu.cluster.service import ClusterError
+
+        a = RestActions(cluster)
+        with pytest.raises(ClusterError) as ei:
+            a.update_doc(
+                {"script": {"source": "ctx['op'] = 'create'"}},
+                {"index": "s", "id": "3"}, {},
+            )
+        assert ei.value.status == 400
+
+    def test_scripted_upsert(self, cluster):
+        a = RestActions(cluster)
+        st, resp = a.update_doc(
+            {
+                "scripted_upsert": True,
+                "upsert": {"n": 0},
+                "script": {"source": "ctx['_source']['n'] += 5"},
+            },
+            {"index": "s", "id": "fresh"}, {},
+        )
+        assert st == 201
+        assert cluster.get_index("s").get_doc("fresh")["_source"]["n"] == 5
+
+
+class TestValidateQuery:
+    def test_valid(self, cluster):
+        a = RestActions(cluster)
+        st, resp = a.validate_query(
+            {"query": {"match": {"body": "x"}}}, {"index": "s"}, {},
+        )
+        assert st == 200 and resp["valid"] is True
+
+    def test_invalid_with_explain(self, cluster):
+        a = RestActions(cluster)
+        st, resp = a.validate_query(
+            {"query": {"nope": {}}}, {"index": "s"},
+            {"explain": ["true"]},
+        )
+        assert st == 200 and resp["valid"] is False
+        assert "unknown query" in resp["error"]
