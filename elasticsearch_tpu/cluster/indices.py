@@ -1113,21 +1113,27 @@ class IndexService:
                 toks = self.analysis.get(analyzer_name).analyze(str(text))
             except ValueError:
                 toks = []
+            # one vocabulary scan per UNIQUE token; distance checked per
+            # unique candidate term, df resolved once per candidate
+            vocab: set = set()
+            for seg in reader.segments:
+                pf = seg.postings.get(field)
+                if pf is not None:
+                    vocab.update(pf.terms)
+            cand_cache: Dict[str, Dict[str, int]] = {}
             entries = []
             for t_obj in toks:
                 tok = t_obj.text
                 own_df, _ = reader.term_stats(field, tok)
-                cands: Dict[str, int] = {}
-                for seg in reader.segments:
-                    pf = seg.postings.get(field)
-                    if pf is None:
-                        continue
-                    for t in pf.terms:
+                cands = cand_cache.get(tok)
+                if cands is None:
+                    cands = {}
+                    for t in vocab:
                         if t == tok or abs(len(t) - len(tok)) > max_edits:
                             continue
                         if _levenshtein_at_most(tok, t, max_edits):
-                            df, _ = reader.term_stats(field, t)
-                            cands[t] = df
+                            cands[t] = reader.term_stats(field, t)[0]
+                    cand_cache[tok] = cands
                 entries.append(
                     {
                         "text": tok,

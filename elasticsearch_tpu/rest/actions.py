@@ -761,6 +761,18 @@ class RestActions:
                 "action_request_validation_exception",
                 "script or doc is missing",
             )
+        if doc_part is not None and script is not None:
+            return 400, error_body(
+                400,
+                "action_request_validation_exception",
+                "can't provide both script and doc",
+            )
+        if body.get("doc_as_upsert") and doc_part is None:
+            return 400, error_body(
+                400,
+                "action_request_validation_exception",
+                "doc must be specified if doc_as_upsert is enabled",
+            )
         existing = idx.get_doc(params["id"], routing=routing)
         if existing is None:
             if body.get("doc_as_upsert") or "upsert" in body:

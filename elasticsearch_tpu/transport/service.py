@@ -32,7 +32,8 @@ import threading
 from concurrent.futures import ThreadPoolExecutor
 from typing import Any, Callable, Dict, Optional, Tuple
 
-TRANSPORT_VERSION = 1
+# v2: frames carry a leading flag byte (raw | DEFLATE)
+TRANSPORT_VERSION = 2
 _LEN = struct.Struct(">I")
 MAX_FRAME = 256 * 1024 * 1024
 
@@ -72,18 +73,50 @@ class RemoteTransportError(TransportError):
         self.err_type = err_type
 
 
+# frames at or above this size are DEFLATE-compressed on the wire
+# (TransportSettings.TRANSPORT_COMPRESS / Lucene's transport LZ4 —
+# recovery file chunks and bulk doc batches shrink several-fold)
+COMPRESS_MIN = 8 * 1024
+_FLAG_RAW = 0
+_FLAG_DEFLATE = 1
+
+
 async def _read_frame(reader: asyncio.StreamReader) -> dict:
     head = await reader.readexactly(_LEN.size)
     (n,) = _LEN.unpack(head)
     if n > MAX_FRAME:
         raise TransportError(f"frame of {n} bytes exceeds limit")
+    if n < 1:
+        raise TransportError("empty frame")
     body = await reader.readexactly(n)
-    return json.loads(body)
+    flag, payload = body[0], body[1:]
+    if flag == _FLAG_DEFLATE:
+        import zlib
+
+        # bounded inflate: the MAX_FRAME limit must hold for the
+        # DECOMPRESSED size too (decompression-bomb guard)
+        d = zlib.decompressobj()
+        payload = d.decompress(payload, MAX_FRAME)
+        if d.unconsumed_tail:
+            raise TransportError(
+                f"inflated frame exceeds the {MAX_FRAME} byte limit"
+            )
+    elif flag != _FLAG_RAW:
+        raise TransportError(f"unknown frame flag [{flag}]")
+    return json.loads(payload)
 
 
 def _frame(msg: dict) -> bytes:
     body = json.dumps(msg, separators=(",", ":")).encode()
-    return _LEN.pack(len(body)) + body
+    flag = _FLAG_RAW
+    if len(body) >= COMPRESS_MIN:
+        import zlib
+
+        comp = zlib.compress(body, 6)
+        if len(comp) < len(body):
+            body = comp
+            flag = _FLAG_DEFLATE
+    return _LEN.pack(len(body) + 1) + bytes([flag]) + body
 
 
 class _Connection:

@@ -184,3 +184,22 @@ class TestValidateQuery:
         )
         assert st == 200 and resp["valid"] is False
         assert "unknown query" in resp["error"]
+
+
+class TestUpdateValidation:
+    def test_doc_and_script_rejected(self, cluster):
+        a = RestActions(cluster)
+        st, resp = a.update_doc(
+            {"doc": {"n": 1}, "script": {"source": "ctx['op']='none'"}},
+            {"index": "s", "id": "1"}, {},
+        )
+        assert st == 400
+        assert "both script and doc" in resp["error"]["reason"]
+
+    def test_doc_as_upsert_requires_doc(self, cluster):
+        a = RestActions(cluster)
+        st, resp = a.update_doc(
+            {"script": {"source": "ctx['op']='none'"}, "doc_as_upsert": True},
+            {"index": "s", "id": "missing-one"}, {},
+        )
+        assert st == 400

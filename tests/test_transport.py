@@ -103,3 +103,70 @@ class TestTransport:
         b.register_handler("internal:ping", lambda p: {"node": "node-b"})
         assert a.ping(b.address) == "node-b"
         assert a.ping(("127.0.0.1", 1)) is None
+
+
+class TestFrameCompression:
+    def test_large_frames_deflate_and_roundtrip(self):
+        """Frames >= COMPRESS_MIN ride DEFLATE on the wire
+        (TRANSPORT_COMPRESS analog); payloads round-trip exactly."""
+        from elasticsearch_tpu.transport.service import (
+            _FLAG_DEFLATE,
+            _FLAG_RAW,
+            _LEN,
+            COMPRESS_MIN,
+            TransportService,
+            _frame,
+        )
+
+        small = {"a": "x"}
+        raw = _frame(small)
+        assert raw[_LEN.size] == _FLAG_RAW
+        big = {"blob": "word " * (COMPRESS_MIN // 4)}
+        comp = _frame(big)
+        assert comp[_LEN.size] == _FLAG_DEFLATE
+        assert len(comp) < COMPRESS_MIN  # actually shrank
+        # end-to-end over a real socket
+        a = TransportService("ca").start()
+        b = TransportService("cb").start()
+        try:
+            b.register_handler("echo", lambda p: p)
+            out = a.send(b.address, "echo", big)
+            assert out == big
+        finally:
+            a.close()
+            b.close()
+
+    def test_decompression_bomb_rejected(self):
+        import asyncio
+        import json
+        import zlib
+
+        from elasticsearch_tpu.transport.service import (
+            MAX_FRAME,
+            TransportError,
+            _FLAG_DEFLATE,
+            _LEN,
+            _read_frame,
+        )
+
+        # a tiny compressed frame inflating past MAX_FRAME must be
+        # rejected before full inflation
+        huge = json.dumps({"z": "a" * (MAX_FRAME + 1024)}).encode()
+        comp = zlib.compress(huge, 9)
+        frame = _LEN.pack(len(comp) + 1) + bytes([_FLAG_DEFLATE]) + comp
+
+        class FakeReader:
+            def __init__(self, data):
+                self.data = data
+                self.pos = 0
+
+            async def readexactly(self, n):
+                out = self.data[self.pos:self.pos + n]
+                self.pos += n
+                return out
+
+        async def run():
+            with pytest.raises(TransportError):
+                await _read_frame(FakeReader(frame))
+
+        asyncio.run(run())
