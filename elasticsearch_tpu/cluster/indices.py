@@ -880,6 +880,8 @@ class IndexService:
 
         script_fields = body.get("script_fields")
         fields_spec = body.get("fields")
+        # nested queries requesting inner_hits (InnerHitsPhase)
+        nested_inner = _nested_with_inner_hits(query) if query else []
         field_names: List[str] = []
         if fields_spec:
             # expand once, from a snapshot (concurrent dynamic mapping
@@ -928,6 +930,50 @@ class IndexService:
                         got[fname] = list(vals)
                 if got:
                     entry.setdefault("fields", {}).update(got)
+            if nested_inner and src is not None:
+                from ..search.executor import _nested_objects
+
+                oracle = ex if isinstance(ex, NumpyExecutor) else ex._oracle
+                ih: Dict[str, dict] = {}
+                for nq in nested_inner:
+                    spec = nq.inner_hits or {}
+                    ih_name = spec.get("name", nq.path)
+                    if ih_name in ih:
+                        raise dsl.QueryParseError(
+                            f"[inner_hits] already contains an entry for "
+                            f"key [{ih_name}]"
+                        )
+                    ih_size = int(spec.get("size", 3))
+                    ih_source = spec.get("_source", True)
+                    objs = _nested_objects(src, nq.path)
+                    matched = [
+                        (oi, obj)
+                        for oi, obj in enumerate(objs)
+                        if oracle._nested_obj_match(obj, nq.query, nq.path)
+                    ]
+                    inner_hits_list = []
+                    for oi, obj in matched[:ih_size]:
+                        ihit: dict = {
+                            "_index": self.name,
+                            "_id": h.doc_id,
+                            "_nested": {"field": nq.path, "offset": oi},
+                            "_score": None,
+                        }
+                        if ih_source is not False:
+                            filtered_obj = filter_source(obj, ih_source)
+                            if filtered_obj is not None:
+                                ihit["_source"] = filtered_obj
+                        inner_hits_list.append(ihit)
+                    ih[ih_name] = {
+                        "hits": {
+                            "total": {"value": len(matched),
+                                      "relation": "eq"},
+                            "max_score": None,
+                            "hits": inner_hits_list,
+                        }
+                    }
+                if ih:
+                    entry["inner_hits"] = ih
             if script_fields:
                 from ..script import ScriptError, script_service
                 from ..search.executor import _source_field_lookup
@@ -1826,6 +1872,28 @@ class IndexService:
             "settings": {"index": index_settings},
             "mappings": self.mappings.to_json(),
         }
+
+
+def _nested_with_inner_hits(q) -> list:
+    """Nested query nodes carrying inner_hits, anywhere in the tree."""
+    out = []
+    if isinstance(q, dsl.NestedQuery):
+        if q.inner_hits is not None:
+            out.append(q)
+        return out
+    if isinstance(q, dsl.BoolQuery):
+        for c in (
+            list(q.must) + list(q.should) + list(q.filter) + list(q.must_not)
+        ):
+            out.extend(_nested_with_inner_hits(c))
+    elif isinstance(q, dsl.ConstantScoreQuery):
+        out.extend(_nested_with_inner_hits(q.filter_query))
+    elif isinstance(q, (dsl.FunctionScoreQuery, dsl.ScriptScoreQuery)):
+        out.extend(_nested_with_inner_hits(q.query))
+    elif isinstance(q, dsl.DisMaxQuery):
+        for c in q.queries:
+            out.extend(_nested_with_inner_hits(c))
+    return out
 
 
 def _reduce_suggest(suggest_body: dict, shard_parts: List[dict]) -> dict:

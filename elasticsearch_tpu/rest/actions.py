@@ -139,6 +139,10 @@ class RestActions:
         add("GET", "/{index}/_count", self.count)
         add("POST", "/{index}/_validate/query", self.validate_query)
         add("GET", "/{index}/_validate/query", self.validate_query)
+        add("POST", "/{index}/_explain/{id}", self.explain_doc)
+        add("GET", "/{index}/_explain/{id}", self.explain_doc)
+        add("POST", "/{index}/_rollover", self.rollover)
+        add("POST", "/{index}/_rollover/{new_index}", self.rollover)
         add("POST", "/{index}/_msearch", self.msearch)
         add("POST", "/{index}/_bulk", self.bulk)
         add("POST", "/{index}/_pit", self.open_pit)
@@ -1046,6 +1050,122 @@ class RestActions:
             resp["valid"] = False
             if explain:
                 resp["error"] = str(e)
+        return 200, resp
+
+    def explain_doc(self, body, params, qs):
+        """_explain (TransportExplainAction): scores ONE document
+        against the query on its owning shard."""
+        from ..search import dsl as _dsl
+        from ..utils.murmur3 import shard_id as route_shard_id
+
+        idx, index_name = self._single_target(params["index"])
+        doc_id = params["id"]
+        routing = qs.get("routing", [None])[0]
+        q_body = (body or {}).get("query")
+        if q_body is None:
+            return 400, error_body(
+                400, "action_request_validation_exception",
+                "query is missing",
+            )
+        base = {
+            "_index": index_name,  # the concrete index, not the alias
+            "_id": doc_id,
+        }
+        doc = idx.get_doc(doc_id, routing=routing)
+        if doc is None:
+            return 404, {**base, "matched": False}
+        sid = route_shard_id(
+            routing if routing is not None else doc_id, idx.num_shards
+        )
+        # score through an ids-filtered search (the filter adds no
+        # score, so the value equals the plain query's score for this
+        # doc); identical for local and remote shard owners, O(1) docs.
+        # QueryParseError from the search maps to 400 in the dispatcher.
+        resp = idx.search({
+            "query": {"bool": {"must": [q_body],
+                               "filter": [{"ids": {"values": [doc_id]}}]}},
+            "size": 1,
+            "_source": False,
+        })
+        hits = resp["hits"]["hits"]
+        matched = bool(hits)
+        score = hits[0]["_score"] if hits else 0.0
+        out = {**base, "matched": matched}
+        if matched:
+            out["explanation"] = {
+                "value": score,
+                "description": f"score for [{doc_id}] on shard [{sid}] "
+                "(TPU-native scorer; per-term breakdown not emitted)",
+                "details": [],
+            }
+        return 200, out
+
+    def rollover(self, body, params, qs):
+        """_rollover (RolloverAction subset): the write alias moves to a
+        freshly created index named by incrementing the -NNNNNN suffix;
+        conditions (max_docs, max_age ignored-if-absent) gate the roll."""
+        import re as _re
+
+        alias = params["index"]
+        targets = self.cluster.aliases.get(alias)
+        if not targets:
+            return 400, error_body(
+                400,
+                "illegal_argument_exception",
+                f"rollover target [{alias}] is not an alias",
+            )
+        # current write index (is_write_index, else sole target)
+        write = [n for n, meta in targets.items() if meta.get("is_write_index")]
+        old_index = write[0] if write else sorted(targets)[-1]
+        m = _re.match(r"^(.*?)-(\d+)$", old_index)
+        new_index = params.get("new_index")
+        if new_index is None:
+            if not m:
+                return 400, error_body(
+                    400,
+                    "illegal_argument_exception",
+                    f"index name [{old_index}] does not match pattern "
+                    "'^.*-\\d+$'",
+                )
+            new_index = f"{m.group(1)}-{int(m.group(2)) + 1:0{len(m.group(2))}d}"
+        conditions = (body or {}).get("conditions") or {}
+        idx = self.cluster.get_index(old_index)
+        met = {}
+        if "max_docs" in conditions:
+            max_docs = int(conditions["max_docs"])  # ES accepts strings
+            met[f"[max_docs: {max_docs}]"] = idx.num_docs >= max_docs
+        dry_run = qs.get("dry_run", ["false"])[0] in ("true", "")
+        rolled = not conditions or any(met.values())
+        resp = {
+            "acknowledged": rolled and not dry_run,
+            "shards_acknowledged": rolled and not dry_run,
+            "old_index": old_index,
+            "new_index": new_index,
+            # ES reports rolled_over false on dry run regardless of
+            # whether the conditions were met
+            "rolled_over": rolled and not dry_run,
+            "dry_run": dry_run,
+            "conditions": {k: v for k, v in met.items()},
+        }
+        if dry_run or not rolled:
+            return 200, resp
+        create_body = {k: v for k, v in (body or {}).items()
+                       if k in ("settings", "mappings", "aliases")}
+        self.cluster.create_index(new_index, create_body)
+        actions = [
+            {"add": {"index": new_index, "alias": alias,
+                     "is_write_index": True}},
+        ]
+        if old_index in targets:
+            old_meta = targets.get(old_index) or {}
+            re_add = {"index": old_index, "alias": alias,
+                      "is_write_index": False}
+            if old_meta.get("filter") is not None:
+                # the add action replaces the whole alias entry — the
+                # old index's filter must survive the rollover
+                re_add["filter"] = old_meta["filter"]
+            actions.append({"add": re_add})
+        self.cluster.update_aliases({"actions": actions})
         return 200, resp
 
     def count(self, body, params, qs):

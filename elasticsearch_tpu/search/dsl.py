@@ -193,6 +193,7 @@ class NestedQuery(Query):
     path: str = ""
     query: dict = dc_field(default_factory=dict)  # raw DSL, per-object eval
     score_mode: str = "avg"
+    inner_hits: Optional[dict] = None  # {name?, size?, _source?}
 
 
 @dataclass
@@ -726,6 +727,7 @@ def _parse_nested(params):
         path=str(params["path"]),
         query=params["query"],
         score_mode=str(params.get("score_mode", "avg")),
+        inner_hits=params.get("inner_hits"),
         boost=float(params.get("boost", 1.0)),
     )
 

@@ -95,8 +95,11 @@ async def _read_frame(reader: asyncio.StreamReader) -> dict:
 
         # bounded inflate: the MAX_FRAME limit must hold for the
         # DECOMPRESSED size too (decompression-bomb guard)
-        d = zlib.decompressobj()
-        payload = d.decompress(payload, MAX_FRAME)
+        try:
+            d = zlib.decompressobj()
+            payload = d.decompress(payload, MAX_FRAME)
+        except zlib.error as e:
+            raise TransportError(f"corrupt compressed frame: {e}")
         if d.unconsumed_tail:
             raise TransportError(
                 f"inflated frame exceeds the {MAX_FRAME} byte limit"

@@ -1,0 +1,165 @@
+"""_explain, _rollover, nested inner_hits.
+
+Reference analogs: TransportExplainAction, RolloverAction,
+InnerHitsPhase (FetchSubPhase).
+"""
+
+import pytest
+
+from elasticsearch_tpu.cluster.service import ClusterService
+from elasticsearch_tpu.rest.actions import RestActions
+
+
+@pytest.fixture
+def cluster():
+    c = ClusterService()
+    yield c
+    c.close()
+
+
+class TestExplain:
+    def test_matched_with_score(self, cluster):
+        cluster.create_index("e", {"settings": {"number_of_shards": 2}})
+        idx = cluster.get_index("e")
+        idx.index_doc("1", {"body": "quick brown fox"})
+        idx.index_doc("2", {"body": "slow turtle"})
+        idx.refresh()
+        a = RestActions(cluster)
+        st, out = a.explain_doc(
+            {"query": {"match": {"body": "quick"}}},
+            {"index": "e", "id": "1"}, {},
+        )
+        assert st == 200 and out["matched"] is True
+        assert out["explanation"]["value"] > 0
+        # the explain score equals the search score for the same doc
+        search_score = cluster.search(
+            "e", {"query": {"match": {"body": "quick"}}}
+        )["hits"]["hits"][0]["_score"]
+        assert out["explanation"]["value"] == pytest.approx(search_score)
+
+    def test_not_matched(self, cluster):
+        cluster.create_index("e", {})
+        idx = cluster.get_index("e")
+        idx.index_doc("1", {"body": "quick"})
+        idx.refresh()
+        a = RestActions(cluster)
+        st, out = a.explain_doc(
+            {"query": {"match": {"body": "zebra"}}},
+            {"index": "e", "id": "1"}, {},
+        )
+        assert st == 200 and out["matched"] is False
+
+    def test_missing_doc_404(self, cluster):
+        cluster.create_index("e", {})
+        a = RestActions(cluster)
+        st, out = a.explain_doc(
+            {"query": {"match_all": {}}}, {"index": "e", "id": "nope"}, {},
+        )
+        assert st == 404 and out["matched"] is False
+
+
+class TestRollover:
+    def test_rollover_moves_write_alias(self, cluster):
+        cluster.create_index("logs-000001", {})
+        cluster.update_aliases({"actions": [
+            {"add": {"index": "logs-000001", "alias": "logs",
+                     "is_write_index": True}}]})
+        idx = cluster.get_index("logs-000001")
+        for i in range(5):
+            idx.index_doc(str(i), {"n": i})
+        idx.refresh()  # max_docs counts searchable docs (index stats)
+        a = RestActions(cluster)
+        st, out = a.rollover(
+            {"conditions": {"max_docs": 3}}, {"index": "logs"}, {},
+        )
+        assert st == 200 and out["rolled_over"] is True
+        assert out["new_index"] == "logs-000002"
+        assert "logs-000002" in cluster.indices
+        # the write alias moved
+        targets = cluster.aliases["logs"]
+        assert targets["logs-000002"]["is_write_index"] is True
+        assert targets["logs-000001"]["is_write_index"] is False
+
+    def test_conditions_not_met(self, cluster):
+        cluster.create_index("logs-000001", {})
+        cluster.update_aliases({"actions": [
+            {"add": {"index": "logs-000001", "alias": "logs",
+                     "is_write_index": True}}]})
+        a = RestActions(cluster)
+        st, out = a.rollover(
+            {"conditions": {"max_docs": 100}}, {"index": "logs"}, {},
+        )
+        assert st == 200 and out["rolled_over"] is False
+        assert "logs-000002" not in cluster.indices
+
+    def test_non_alias_rejected(self, cluster):
+        cluster.create_index("plain", {})
+        a = RestActions(cluster)
+        st, out = a.rollover({}, {"index": "plain"}, {})
+        assert st == 400
+
+
+class TestInnerHits:
+    def test_matching_objects_returned(self, cluster):
+        cluster.create_index("ih", {"mappings": {"properties": {
+            "items": {"type": "nested", "properties": {
+                "name": {"type": "keyword"},
+                "qty": {"type": "integer"},
+            }},
+        }}})
+        idx = cluster.get_index("ih")
+        idx.index_doc("1", {"items": [
+            {"name": "apple", "qty": 5},
+            {"name": "banana", "qty": 1},
+            {"name": "apple", "qty": 9},
+        ]})
+        idx.index_doc("2", {"items": [{"name": "cherry", "qty": 7}]})
+        idx.refresh()
+        r = cluster.search("ih", {
+            "query": {"nested": {
+                "path": "items",
+                "query": {"term": {"items.name": "apple"}},
+                "inner_hits": {},
+            }},
+        })
+        hits = r["hits"]["hits"]
+        assert [h["_id"] for h in hits] == ["1"]
+        inner = hits[0]["inner_hits"]["items"]["hits"]
+        assert inner["total"]["value"] == 2
+        offsets = [h["_nested"]["offset"] for h in inner["hits"]]
+        assert offsets == [0, 2]
+        assert inner["hits"][0]["_source"]["name"] == "apple"
+
+    def test_named_and_sized(self, cluster):
+        cluster.create_index("ih", {"mappings": {"properties": {
+            "items": {"type": "nested", "properties": {
+                "qty": {"type": "integer"}}},
+        }}})
+        idx = cluster.get_index("ih")
+        idx.index_doc("1", {"items": [{"qty": i} for i in range(6)]})
+        idx.refresh()
+        r = cluster.search("ih", {
+            "query": {"nested": {
+                "path": "items",
+                "query": {"range": {"items.qty": {"gte": 1}}},
+                "inner_hits": {"name": "big", "size": 2},
+            }},
+        })
+        inner = r["hits"]["hits"][0]["inner_hits"]["big"]["hits"]
+        assert inner["total"]["value"] == 5
+        assert len(inner["hits"]) == 2
+
+    def test_no_inner_hits_key_without_request(self, cluster):
+        cluster.create_index("ih", {"mappings": {"properties": {
+            "items": {"type": "nested", "properties": {
+                "qty": {"type": "integer"}}},
+        }}})
+        idx = cluster.get_index("ih")
+        idx.index_doc("1", {"items": [{"qty": 1}]})
+        idx.refresh()
+        r = cluster.search("ih", {
+            "query": {"nested": {"path": "items",
+                                 "query": {"range": {"items.qty":
+                                                     {"gte": 0}}}}},
+        })
+        assert "inner_hits" not in r["hits"]["hits"][0]
