@@ -83,6 +83,10 @@ class RestActions:
         add("DELETE", "/_pit", self.close_pit)
         add("POST", "/_analyze", self.analyze)
         add("GET", "/_analyze", self.analyze)
+        # async search (x-pack async-search: submit/get/delete)
+        add("POST", "/{index}/_async_search", self.submit_async_search)
+        add("GET", "/_async_search/{id}", self.get_async_search)
+        add("DELETE", "/_async_search/{id}", self.delete_async_search)
         # tasks + by-scroll actions
         add("GET", "/_tasks", self.list_tasks)
         add("GET", "/_tasks/{task_id}", self.get_task)
@@ -244,6 +248,106 @@ class RestActions:
     def put_cluster_settings(self, body, params, qs):
         return 200, self.cluster.update_cluster_settings(body or {})
 
+    # ---- async search (SubmitAsyncSearchAction and friends) ----
+
+    def _async_response(self, task, status: int = 200):
+        out = {
+            "id": task.id,
+            "is_partial": not task.completed,
+            "is_running": not task.completed,
+            "start_time_in_millis": task.start_time_in_millis,
+            "expiration_time_in_millis": task.start_time_in_millis
+            + 5 * 24 * 3600 * 1000,
+        }
+        if task.response is not None:
+            out["response"] = task.response
+        if task.error is not None:
+            out["error"] = task.error
+            out["is_partial"] = False
+            out["is_running"] = False
+        return status, out
+
+    ASYNC_SEARCH_ACTION = "indices:data/read/async_search"
+
+    def _run_task_background(self, task, fn, done=None):
+        """Shared background-task runner: error capture + keep-for-
+        pickup unregister (used by async search and the by-scroll
+        actions)."""
+        import threading
+
+        from ..tasks import TaskCancelledException
+
+        def run():
+            try:
+                out = fn(task)
+                if task.is_cancelled():
+                    task.error = {
+                        "type": "task_cancelled_exception",
+                        "reason": "task cancelled [deleted]",
+                    }
+                else:
+                    task.response = out
+            except TaskCancelledException as e:
+                task.error = {"type": e.err_type, "reason": str(e)}
+            except ClusterError as e:
+                task.error = {"type": e.err_type, "reason": str(e)}
+            except Exception as e:  # keep the task record, not the stack
+                task.error = {"type": "exception", "reason": str(e)}
+            finally:
+                self.cluster.tasks.unregister(task, keep=True)
+                if done is not None:
+                    done.set()
+
+        threading.Thread(
+            target=run, name=f"task-{task.id}", daemon=True
+        ).start()
+
+    def submit_async_search(self, body, params, qs):
+        import threading
+
+        index = params["index"]
+        task = self.cluster.tasks.register(
+            self.ASYNC_SEARCH_ACTION, f"async search [{index}]"
+        )
+        done = threading.Event()
+        self._run_task_background(
+            task, lambda t: self.cluster.search(index, body or {}), done
+        )
+        # wait_for_completion_timeout (default 1s): a fast search
+        # returns inline, exactly the reference's behavior; malformed
+        # values surface as 400 (ClusterError from _parse_keep_alive)
+        from ..cluster.service import _parse_keep_alive
+
+        wait = qs.get("wait_for_completion_timeout", ["1s"])[0]
+        done.wait(_parse_keep_alive(wait))
+        return self._async_response(task)
+
+    def _async_task(self, task_id):
+        task = self.cluster.tasks.get(task_id)
+        if task is None or task.action != self.ASYNC_SEARCH_ACTION:
+            # only async-search tasks are addressable here — a reindex
+            # task id must not be readable/deletable through this API
+            return None
+        return task
+
+    def get_async_search(self, body, params, qs):
+        task = self._async_task(params["id"])
+        if task is None:
+            return 404, error_body(
+                404, "resource_not_found_exception",
+                f"async search [{params['id']}] not found",
+            )
+        return self._async_response(task)
+
+    def delete_async_search(self, body, params, qs):
+        if self._async_task(params["id"]) is None:
+            return 404, error_body(
+                404, "resource_not_found_exception",
+                f"async search [{params['id']}] not found",
+            )
+        self.cluster.tasks.remove(params["id"])
+        return 200, {"acknowledged": True}
+
     # ---- tasks + by-scroll actions (reindex module) ----
 
     def list_tasks(self, body, params, qs):
@@ -287,8 +391,6 @@ class RestActions:
     def _by_scroll(self, action: str, description: str, qs, fn):
         """Shared driver: foreground, or background with
         wait_for_completion=false (the task keeps the response)."""
-        from ..tasks import TaskCancelledException
-
         task = self.cluster.tasks.register(action, description)
         wait = qs.get("wait_for_completion", ["true"])[0] != "false"
         if wait:
@@ -296,24 +398,7 @@ class RestActions:
                 return 200, fn(task)
             finally:
                 self.cluster.tasks.unregister(task)
-
-        import threading
-
-        def run():
-            try:
-                task.response = fn(task)
-            except TaskCancelledException as e:
-                task.error = {
-                    "type": e.err_type, "reason": str(e),
-                }
-            except ClusterError as e:
-                task.error = {"type": e.err_type, "reason": str(e)}
-            except Exception as e:  # keep the task record, not the stack
-                task.error = {"type": "exception", "reason": str(e)}
-            finally:
-                self.cluster.tasks.unregister(task, keep=True)
-
-        threading.Thread(target=run, name=f"task-{task.id}", daemon=True).start()
+        self._run_task_background(task, fn)
         return 200, {"task": task.id}
 
     def reindex(self, body, params, qs):

@@ -89,6 +89,9 @@ class TaskManager:
         # finished background (wait_for_completion=false) tasks kept for
         # GET _tasks/<id> result pickup (the .tasks-index analog)
         self._completed: Dict[str, Task] = {}
+        # explicitly removed ids: a late unregister(keep=True) from the
+        # worker thread must NOT resurrect a deleted task
+        self._deleted: set = set()
         self._lock = threading.Lock()
 
     def register(
@@ -110,12 +113,26 @@ class TaskManager:
     def unregister(self, task: Task, keep: bool = False) -> None:
         with self._lock:
             self._tasks.pop(task.id, None)
-            if keep:
+            if keep and task.id not in self._deleted:
                 task.completed = True
                 self._completed[task.id] = task
                 # bound the completed-task retention
                 while len(self._completed) > 256:
                     self._completed.pop(next(iter(self._completed)))
+
+    def remove(self, task_id: str) -> Optional[Task]:
+        """Cancels + forgets a task (DELETE semantics): it will never be
+        listed or resurrected by a late worker unregister."""
+        with self._lock:
+            task = self._tasks.pop(task_id, None) or self._completed.pop(
+                task_id, None
+            )
+            self._deleted.add(task_id)
+            while len(self._deleted) > 4096:
+                self._deleted.pop()
+        if task is not None and task.cancellable:
+            task.cancel("deleted")
+        return task
 
     def get(self, task_id: str) -> Optional[Task]:
         with self._lock:

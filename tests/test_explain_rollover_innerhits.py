@@ -163,3 +163,86 @@ class TestInnerHits:
                                                      {"gte": 0}}}}},
         })
         assert "inner_hits" not in r["hits"]["hits"][0]
+
+
+class TestAsyncSearch:
+    def test_fast_search_completes_inline(self, cluster):
+        cluster.create_index("a", {})
+        idx = cluster.get_index("a")
+        for i in range(5):
+            idx.index_doc(str(i), {"body": f"async doc {i}"})
+        idx.refresh()
+        a = RestActions(cluster)
+        st, out = a.submit_async_search(
+            {"query": {"match": {"body": "async"}}}, {"index": "a"}, {},
+        )
+        assert st == 200
+        assert out["is_running"] is False
+        assert out["response"]["hits"]["total"]["value"] == 5
+        # the id stays retrievable afterwards
+        st2, out2 = a.get_async_search(None, {"id": out["id"]}, {})
+        assert st2 == 200 and out2["response"]["hits"]["total"]["value"] == 5
+        # delete removes it
+        st3, _ = a.delete_async_search(None, {"id": out["id"]}, {})
+        assert st3 == 200
+        st4, _ = a.get_async_search(None, {"id": out["id"]}, {})
+        assert st4 == 404
+
+    def test_unknown_id_404(self, cluster):
+        a = RestActions(cluster)
+        st, _ = a.get_async_search(None, {"id": "node-0:999"}, {})
+        assert st == 404
+
+    def test_error_carried(self, cluster):
+        cluster.create_index("a", {})
+        a = RestActions(cluster)
+        st, out = a.submit_async_search(
+            {"query": {"nope": {}}}, {"index": "a"}, {},
+        )
+        assert st == 200
+        assert "error" in out
+
+    def test_delete_running_task_never_resurrects(self, cluster):
+        """A DELETE while the search is still running must stick even
+        after the worker finishes (review regression)."""
+        import threading
+        import time
+
+        cluster.create_index("a", {})
+        idx = cluster.get_index("a")
+        idx.index_doc("1", {"body": "x"})
+        idx.refresh()
+        a = RestActions(cluster)
+        gate = threading.Event()
+        orig = cluster.search
+
+        def slow_search(index, body=None):
+            gate.wait(5)
+            return orig(index, body)
+
+        cluster.search = slow_search
+        try:
+            st, out = a.submit_async_search(
+                {"query": {"match_all": {}}}, {"index": "a"},
+                {"wait_for_completion_timeout": ["10ms"]},
+            )
+            assert out["is_running"] is True
+            st2, _ = a.delete_async_search(None, {"id": out["id"]}, {})
+            assert st2 == 200
+            gate.set()
+            time.sleep(0.3)  # let the worker finish + unregister
+            st3, _ = a.get_async_search(None, {"id": out["id"]}, {})
+            assert st3 == 404
+        finally:
+            cluster.search = orig
+            gate.set()
+
+    def test_async_ids_are_scoped(self, cluster):
+        """A reindex task id must not be readable through _async_search
+        (review regression)."""
+        cluster.create_index("a", {})
+        t = cluster.tasks.register("indices:data/write/reindex", "x")
+        a = RestActions(cluster)
+        st, _ = a.get_async_search(None, {"id": t.id}, {})
+        assert st == 404
+        cluster.tasks.unregister(t)
