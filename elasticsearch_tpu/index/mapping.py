@@ -35,6 +35,7 @@ DATE = "date"
 DENSE_VECTOR = "dense_vector"
 GEO_POINT = "geo_point"
 NESTED = "nested"
+PERCOLATOR = "percolator"
 
 NUMERIC_TYPES = (LONG, INTEGER, SHORT, BYTE, DOUBLE, FLOAT, HALF_FLOAT)
 _INT_TYPES = (LONG, INTEGER, SHORT, BYTE)
@@ -119,6 +120,7 @@ class Mappings:
     def _add_field(self, path: str, ftype: str, cfg: dict):
         known = (
             TEXT, KEYWORD, BOOLEAN, DATE, DENSE_VECTOR, GEO_POINT, NESTED,
+            PERCOLATOR,
         ) + NUMERIC_TYPES
         if ftype not in known:
             raise MappingParseError(f"No handler for type [{ftype}] declared on field [{path}]")
@@ -382,6 +384,19 @@ class DocumentParser:
                     if f.type == GEO_POINT:
                         self._index_values(f, path, [value], out)
                         continue
+                    if f.type == PERCOLATOR:
+                        # stored queries live in _source; validate NOW so
+                        # a malformed query is rejected at index time
+                        # (PercolatorFieldMapper parses at index time)
+                        from ..search import dsl as _dsl
+
+                        try:
+                            _dsl.parse_query(value)
+                        except _dsl.QueryParseError as e:
+                            raise MappingParseError(
+                                f"percolator field [{path}]: {e}"
+                            )
+                        continue
                     if f.type == NESTED:
                         # nested objects stay whole in _source: they are
                         # NOT flattened into parent columns, which is
@@ -545,6 +560,12 @@ class DocumentParser:
                 lons.append(lon_f)
         elif f.type == NESTED:
             pass  # nested objects live in _source only (see _walk)
+        elif f.type == PERCOLATOR:
+            # a non-dict value reached here (dicts are intercepted in
+            # _walk): the reference rejects such docs at index time
+            raise MappingParseError(
+                f"percolator field [{path}] must hold a query object"
+            )
         elif f.type == DENSE_VECTOR:
             vec = [float(x) for x in values]
             if f.dims and len(vec) != f.dims:

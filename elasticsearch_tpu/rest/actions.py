@@ -141,6 +141,8 @@ class RestActions:
         add("GET", "/{index}/_search", self.search)
         add("POST", "/{index}/_count", self.count)
         add("GET", "/{index}/_count", self.count)
+        add("POST", "/{index}/_rank_eval", self.rank_eval)
+        add("GET", "/{index}/_rank_eval", self.rank_eval)
         add("POST", "/{index}/_validate/query", self.validate_query)
         add("GET", "/{index}/_validate/query", self.validate_query)
         add("POST", "/{index}/_explain/{id}", self.explain_doc)
@@ -279,14 +281,10 @@ class RestActions:
 
         def run():
             try:
-                out = fn(task)
-                if task.is_cancelled():
-                    task.error = {
-                        "type": "task_cancelled_exception",
-                        "reason": "task cancelled [deleted]",
-                    }
-                else:
-                    task.response = out
+                # a cancel landing after the last cooperative check but
+                # before fn returns keeps the completed response — the
+                # work genuinely finished
+                task.response = fn(task)
             except TaskCancelledException as e:
                 task.error = {"type": e.err_type, "reason": str(e)}
             except ClusterError as e:
@@ -305,6 +303,12 @@ class RestActions:
     def submit_async_search(self, body, params, qs):
         import threading
 
+        from ..cluster.service import _parse_keep_alive
+
+        # parse the timeout BEFORE registering/starting anything: a
+        # malformed value must 400 without leaking an orphan task
+        wait = qs.get("wait_for_completion_timeout", ["1s"])[0]
+        timeout_s = _parse_keep_alive(wait)
         index = params["index"]
         task = self.cluster.tasks.register(
             self.ASYNC_SEARCH_ACTION, f"async search [{index}]"
@@ -313,13 +317,8 @@ class RestActions:
         self._run_task_background(
             task, lambda t: self.cluster.search(index, body or {}), done
         )
-        # wait_for_completion_timeout (default 1s): a fast search
-        # returns inline, exactly the reference's behavior; malformed
-        # values surface as 400 (ClusterError from _parse_keep_alive)
-        from ..cluster.service import _parse_keep_alive
-
-        wait = qs.get("wait_for_completion_timeout", ["1s"])[0]
-        done.wait(_parse_keep_alive(wait))
+        # default 1s: a fast search returns inline (reference behavior)
+        done.wait(timeout_s)
         return self._async_response(task)
 
     def _async_task(self, task_id):
@@ -1108,6 +1107,106 @@ class RestActions:
             if toks:
                 pos_offset += toks[-1].position + 100  # position_increment_gap
         return 200, {"tokens": tokens}
+
+    def rank_eval(self, body, params, qs):
+        """_rank_eval (modules/rank-eval): run rated requests, score
+        with precision@k / recall@k / MRR / DCG."""
+        import math as _math
+
+        body = body or {}
+        requests = body.get("requests") or []
+        metric_spec = body.get("metric") or {"precision": {"k": 10}}
+        if len(metric_spec) != 1:
+            return 400, error_body(
+                400, "parsing_exception", "[metric] must have one entry"
+            )
+        metric_name, mparams = next(iter(metric_spec.items()))
+        mparams = mparams or {}
+        k = int(mparams.get("k", 10))
+        threshold = int(mparams.get("relevant_rating_threshold", 1))
+        details = {}
+        scores = []
+        for req in requests:
+            rid = req.get("id")
+            try:
+                ratings = {
+                    r["_id"]: int(r.get("rating", 0))
+                    for r in req.get("ratings", [])
+                }
+            except (KeyError, TypeError, ValueError) as e:
+                return 400, error_body(
+                    400, "parsing_exception",
+                    f"malformed ratings in request [{rid}]: {e}",
+                )
+            search_body = dict(req.get("request") or {})
+            search_body["size"] = max(k, int(search_body.get("size", k)))
+            search_body.setdefault("_source", False)
+            resp = self.cluster.search(params["index"], search_body)
+            hit_ids = [h["_id"] for h in resp["hits"]["hits"]][:k]
+            hit_ratings = [ratings.get(h, 0) for h in hit_ids]
+            relevant_in_k = sum(1 for r in hit_ratings if r >= threshold)
+            total_relevant = sum(
+                1 for r in ratings.values() if r >= threshold
+            )
+            if metric_name == "precision":
+                # PrecisionAtK divides by RETRIEVED docs, not k: a
+                # 3-hit all-relevant result at k=10 scores 1.0
+                score = (
+                    relevant_in_k / len(hit_ratings) if hit_ratings else 0.0
+                )
+            elif metric_name == "recall":
+                score = (
+                    relevant_in_k / total_relevant if total_relevant else 0.0
+                )
+            elif metric_name == "mean_reciprocal_rank":
+                score = 0.0
+                for rank, r in enumerate(hit_ratings, 1):
+                    if r >= threshold:
+                        score = 1.0 / rank
+                        break
+            elif metric_name == "dcg":
+                normalize = bool(mparams.get("normalize", False))
+                dcg = sum(
+                    (2**r - 1) / _math.log2(rank + 1)
+                    for rank, r in enumerate(hit_ratings, 1)
+                )
+                if normalize:
+                    ideal = sorted(ratings.values(), reverse=True)[:k]
+                    idcg = sum(
+                        (2**r - 1) / _math.log2(rank + 1)
+                        for rank, r in enumerate(ideal, 1)
+                    )
+                    score = dcg / idcg if idcg else 0.0
+                else:
+                    score = dcg
+            else:
+                return 400, error_body(
+                    400, "parsing_exception",
+                    f"unknown metric [{metric_name}]",
+                )
+            scores.append(score)
+            details[rid] = {
+                "metric_score": round(score, 6),
+                "unrated_docs": [
+                    {"_index": params["index"], "_id": h}
+                    for h in hit_ids
+                    if h not in ratings
+                ],
+                "hits": [
+                    {
+                        "hit": {"_index": params["index"], "_id": h},
+                        "rating": ratings.get(h),
+                    }
+                    for h in hit_ids
+                ],
+            }
+        return 200, {
+            "metric_score": (
+                round(sum(scores) / len(scores), 6) if scores else 0.0
+            ),
+            "details": details,
+            "failures": {},
+        }
 
     def validate_query(self, body, params, qs):
         """_validate/query (ValidateQueryAction): parse-checks the query

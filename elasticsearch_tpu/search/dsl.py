@@ -197,6 +197,15 @@ class NestedQuery(Query):
 
 
 @dataclass
+class PercolateQuery(Query):
+    """percolate query: match STORED queries against provided docs
+    (modules/percolator — PercolateQueryBuilder)."""
+
+    field: str = "query"
+    documents: List[dict] = dc_field(default_factory=list)
+
+
+@dataclass
 class ScriptScoreQuery(Query):
     """script_score query: base query matches, the script replaces the
     score (ScriptScoreQueryBuilder — the reference's brute-force kNN
@@ -732,6 +741,25 @@ def _parse_nested(params):
     )
 
 
+def _parse_percolate(params):
+    field = params.get("field")
+    if not field:
+        raise QueryParseError("[percolate] requires [field]")
+    docs = params.get("documents")
+    if docs is None:
+        doc = params.get("document")
+        if doc is None:
+            raise QueryParseError(
+                "[percolate] requires [document] or [documents]"
+            )
+        docs = [doc]
+    return PercolateQuery(
+        field=str(field),
+        documents=list(docs),
+        boost=float(params.get("boost", 1.0)),
+    )
+
+
 def _parse_script_score(params):
     if "query" not in params or "script" not in params:
         raise QueryParseError("[script_score] requires [query] and [script]")
@@ -797,6 +825,7 @@ _PARSERS = {
     "geo_distance": _parse_geo_distance,
     "geo_bounding_box": _parse_geo_bounding_box,
     "nested": _parse_nested,
+    "percolate": _parse_percolate,
     "script_score": _parse_script_score,
     "script": _parse_script_query,
     "query_string": _parse_query_string,

@@ -482,6 +482,8 @@ class NumpyExecutor:
             return self._exec_geo_bbox(q, seg)
         if isinstance(q, dsl.NestedQuery):
             return self._exec_nested(q, seg)
+        if isinstance(q, dsl.PercolateQuery):
+            return self._exec_percolate(q, seg)
         if isinstance(q, dsl.ScriptScoreQuery):
             return self._exec_script_score(q, seg)
         if isinstance(q, dsl.ScriptQuery):
@@ -896,6 +898,58 @@ class NumpyExecutor:
             f"[nested] unsupported inner query [{kind}] (this build "
             "supports bool/term/match/terms/range/exists)"
         )
+
+    def _exec_percolate(
+        self, q: "dsl.PercolateQuery", seg: Segment
+    ) -> Tuple[np.ndarray, np.ndarray]:
+        """percolate: a stored-query doc matches when its query matches
+        ANY of the provided documents. The candidate documents are
+        indexed once into a scratch single-doc-per-entry reader (the
+        percolator's MemoryIndex analog) and every stored query executes
+        against it."""
+        n = seg.num_docs
+        mask = np.zeros(n, bool)
+        doc_ex = getattr(q, "_doc_executor", None)
+        if doc_ex is None:
+            from ..index.engine import ShardEngine
+            from ..index.mapping import Mappings
+
+            # a COPY of the mappings: dynamic-mapping the candidate
+            # doc's fields must never mutate the live index mapping
+            scratch_mappings = Mappings(self.reader.mappings.to_json())
+            scratch = ShardEngine(scratch_mappings, self.reader.analysis)
+            for i, doc in enumerate(q.documents):
+                scratch.index(f"_percolate_{i}", doc)
+            scratch.refresh()
+            doc_ex = NumpyExecutor(scratch.reader(), self.k1, self.b)
+            # memoized on the (per-request) query node: every segment of
+            # every shard reuses the one scratch index
+            q._doc_executor = doc_ex
+        parsed_cache = getattr(q, "_parsed_cache", None)
+        if parsed_cache is None:
+            parsed_cache = {}
+            q._parsed_cache = parsed_cache
+        for d in range(n):
+            src = seg.sources[d]
+            if src is None:
+                continue
+            stored_vals = [
+                v for v in _extract_field(src, q.field) if isinstance(v, dict)
+            ]
+            if not stored_vals:
+                continue
+            stored = stored_vals[0]
+            key = id(src)
+            node = parsed_cache.get(key)
+            if node is None:
+                try:
+                    node = dsl.parse_query(stored)
+                except dsl.QueryParseError:
+                    continue  # index-time validation makes this rare
+                parsed_cache[key] = node
+            td = doc_ex.search(node, size=1)
+            mask[d] = td.total > 0
+        return mask, np.where(mask, np.float32(q.boost), 0).astype(np.float32)
 
     def _exec_script_score(
         self, q: "dsl.ScriptScoreQuery", seg: Segment

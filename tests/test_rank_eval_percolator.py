@@ -1,0 +1,173 @@
+"""_rank_eval metrics + percolator (modules/rank-eval, modules/percolator)."""
+
+import pytest
+
+from elasticsearch_tpu.cluster.service import ClusterService
+from elasticsearch_tpu.rest.actions import RestActions
+
+
+@pytest.fixture
+def cluster():
+    c = ClusterService()
+    yield c
+    c.close()
+
+
+class TestRankEval:
+    @pytest.fixture
+    def seeded(self, cluster):
+        cluster.create_index("r", {})
+        idx = cluster.get_index("r")
+        docs = ["quick brown fox", "quick dog", "brown bear",
+                "lazy fox", "quick quick quick"]
+        for i, t in enumerate(docs):
+            idx.index_doc(str(i), {"body": t})
+        idx.refresh()
+        return RestActions(cluster)
+
+    def test_precision_at_k(self, seeded):
+        st, out = seeded.rank_eval(
+            {
+                "requests": [{
+                    "id": "q1",
+                    "request": {"query": {"match": {"body": "quick"}}},
+                    "ratings": [{"_id": "0", "rating": 1},
+                                {"_id": "4", "rating": 1}],
+                }],
+                "metric": {"precision": {"k": 3}},
+            },
+            {"index": "r"}, {},
+        )
+        assert st == 200
+        assert out["metric_score"] == pytest.approx(2 / 3)
+        d = out["details"]["q1"]
+        assert {u["_id"] for u in d["unrated_docs"]} == {"1"}
+
+    def test_mrr(self, seeded):
+        st, out = seeded.rank_eval(
+            {
+                "requests": [{
+                    "id": "q",
+                    "request": {"query": {"match": {"body": "fox"}}},
+                    "ratings": [{"_id": "3", "rating": 1}],
+                }],
+                "metric": {"mean_reciprocal_rank": {"k": 5}},
+            },
+            {"index": "r"}, {},
+        )
+        score = out["metric_score"]
+        assert 0 < score <= 1
+
+    def test_recall(self, seeded):
+        st, out = seeded.rank_eval(
+            {
+                "requests": [{
+                    "id": "q",
+                    "request": {"query": {"match": {"body": "quick"}}},
+                    "ratings": [{"_id": "0", "rating": 1},
+                                {"_id": "1", "rating": 1},
+                                {"_id": "3", "rating": 1}],
+                }],
+                "metric": {"recall": {"k": 5}},
+            },
+            {"index": "r"}, {},
+        )
+        assert out["metric_score"] == pytest.approx(2 / 3)
+
+
+class TestPercolator:
+    def test_store_and_percolate(self, cluster):
+        cluster.create_index("alerts", {"mappings": {"properties": {
+            "query": {"type": "percolator"},
+            "body": {"type": "text"},
+            "level": {"type": "keyword"},
+        }}})
+        idx = cluster.get_index("alerts")
+        idx.index_doc("q1", {"query": {"match": {"body": "error"}}})
+        idx.index_doc("q2", {"query": {"bool": {"must": [
+            {"match": {"body": "disk"}},
+            {"term": {"level": "critical"}}]}}})
+        idx.index_doc("q3", {"query": {"match": {"body": "timeout"}}})
+        idx.refresh()
+        r = cluster.search("alerts", {"query": {"percolate": {
+            "field": "query",
+            "document": {"body": "disk error on host",
+                         "level": "critical"},
+        }}})
+        ids = {h["_id"] for h in r["hits"]["hits"]}
+        assert ids == {"q1", "q2"}
+
+    def test_multiple_documents_any_match(self, cluster):
+        cluster.create_index("alerts", {"mappings": {"properties": {
+            "query": {"type": "percolator"},
+            "body": {"type": "text"},
+        }}})
+        idx = cluster.get_index("alerts")
+        idx.index_doc("q1", {"query": {"match": {"body": "alpha"}}})
+        idx.refresh()
+        r = cluster.search("alerts", {"query": {"percolate": {
+            "field": "query",
+            "documents": [{"body": "beta"}, {"body": "alpha beta"}],
+        }}})
+        assert [h["_id"] for h in r["hits"]["hits"]] == ["q1"]
+
+    def test_invalid_stored_query_rejected_at_index_time(self, cluster):
+        from elasticsearch_tpu.index.mapping import MappingParseError
+
+        cluster.create_index("alerts", {"mappings": {"properties": {
+            "query": {"type": "percolator"}}}})
+        idx = cluster.get_index("alerts")
+        with pytest.raises(MappingParseError):
+            idx.index_doc("bad", {"query": {"nope": {}}})
+
+    def test_percolate_never_mutates_live_mappings(self, cluster):
+        """Dynamic-mapping the candidate doc must stay in the scratch
+        index (review regression)."""
+        cluster.create_index("alerts", {"mappings": {"properties": {
+            "query": {"type": "percolator"},
+            "body": {"type": "text"}}}})
+        idx = cluster.get_index("alerts")
+        idx.index_doc("q1", {"query": {"match": {"body": "x"}}})
+        idx.refresh()
+        cluster.search("alerts", {"query": {"percolate": {
+            "field": "query",
+            "document": {"body": "x", "brand_new_field": "oops"},
+        }}})
+        assert idx.mappings.get("brand_new_field") is None
+
+    def test_non_dict_percolator_value_rejected(self, cluster):
+        from elasticsearch_tpu.index.mapping import MappingParseError
+
+        cluster.create_index("alerts", {"mappings": {"properties": {
+            "query": {"type": "percolator"}}}})
+        idx = cluster.get_index("alerts")
+        with pytest.raises(MappingParseError):
+            idx.index_doc("bad", {"query": "match_all"})
+
+    def test_precision_divides_by_retrieved(self, cluster):
+        cluster.create_index("r2", {})
+        idx = cluster.get_index("r2")
+        idx.index_doc("0", {"body": "unique marker"})
+        idx.refresh()
+        a = RestActions(cluster)
+        st, out = a.rank_eval(
+            {"requests": [{
+                "id": "q",
+                "request": {"query": {"match": {"body": "marker"}}},
+                "ratings": [{"_id": "0", "rating": 1}],
+            }],
+             "metric": {"precision": {"k": 10}}},
+            {"index": "r2"}, {},
+        )
+        assert out["metric_score"] == 1.0  # 1 hit, 1 relevant, k=10
+
+    def test_malformed_ratings_400(self, cluster):
+        cluster.create_index("r3", {})
+        a = RestActions(cluster)
+        st, out = a.rank_eval(
+            {"requests": [{"id": "q", "request": {},
+                           "ratings": [{"rating": 1}]}],
+             "metric": {"precision": {}}},
+            {"index": "r3"}, {},
+        )
+        assert st == 400
