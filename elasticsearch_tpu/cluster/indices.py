@@ -269,6 +269,7 @@ class IndexService:
         routing: Optional[Dict[Any, str]] = None,
         local_node: Optional[str] = None,
         remote_call=None,
+        response_times: Optional[Dict[str, float]] = None,
     ):
         self.name = name
         self.settings = dict(DEFAULT_SETTINGS)
@@ -302,6 +303,10 @@ class IndexService:
         )
         self.local_node = local_node
         self.remote_call = remote_call
+        # per-node EWMA response seconds (ARS); shared with the node
+        self.response_times: Dict[str, float] = (
+            response_times if response_times is not None else {}
+        )
         # primary-side replication tracking: shard → extra targets added
         # during peer recovery, before they enter the in-sync set
         # (ReplicationTracker.initiateTracking)
@@ -369,8 +374,9 @@ class IndexService:
 
     def _search_node(self, sid: int) -> Optional[str]:
         """Copy selection for reads: any in-sync copy, preferring the
-        local one (OperationRouting.searchShards + ARS, simplified to
-        local-first round-robin). None = execute locally."""
+        local one, then the copy with the lowest EWMA response time
+        (adaptive replica selection — ResponseCollectorService); round-
+        robin among never-measured copies. None = execute locally."""
         e = self._entry(sid)
         if e is None:
             return None
@@ -380,6 +386,15 @@ class IndexService:
         if self.local_node in in_sync:
             return self.local_node
         self._ars_cursor += 1
+        times = self.response_times
+        if times:
+            # every ~8th selection probes round-robin so copies that
+            # measured slow once keep getting fresh samples (no herding)
+            if self._ars_cursor % 8 != 0:
+                unmeasured = [n for n in in_sync if n not in times]
+                if unmeasured:
+                    return unmeasured[self._ars_cursor % len(unmeasured)]
+                return min(in_sync, key=lambda n: times[n])
         return in_sync[self._ars_cursor % len(in_sync)]
 
     def replica_targets(self, sid: int) -> List[str]:

@@ -74,6 +74,10 @@ class NotMasterError(NodeError):
 # DEFAULT_KEEPALIVE is 5 minutes).
 DEFAULT_CTX_KEEPALIVE = 300.0
 
+# actions whose response times feed adaptive replica selection
+# (ResponseCollectorService records search-phase responses only)
+_ARS_ACTIONS = {ACTION_SHARD_SEARCH, ACTION_SHARD_COUNT}
+
 
 class DistributedClusterService(ClusterService):
     """`ClusterService` whose metadata mutations ride through the master
@@ -194,6 +198,7 @@ class DistributedClusterService(ClusterService):
                     routing=routing,
                     local_node=self.node.name,
                     remote_call=self.node.remote_call,
+                    response_times=self.node.response_ewma,
                 )
                 idx.uuid = meta.get("uuid", idx.uuid)
                 idx.creation_date = meta.get("creation_date", idx.creation_date)
@@ -367,6 +372,9 @@ class TpuNode:
         # state applications must not start duplicate recoveries
         self._recovering: set = set()
         self._recovery_lock = threading.Lock()
+        # adaptive replica selection: EWMA response seconds per node
+        # (ResponseCollectorService) fed by remote_call timings
+        self.response_ewma: Dict[str, float] = {}
         self._closed = False
         self._register_handlers()
 
@@ -452,13 +460,35 @@ class TpuNode:
     def remote_call(self, node_id: str, action: str, payload, timeout: float = 30.0):
         """Dispatch to a node by id: local shortcut or transport hop
         (the `NodeClient` pattern). This is the `remote_call` seam the
-        distributed IndexService rides."""
+        distributed IndexService rides. Response times feed the ARS
+        EWMA (ResponseCollectorService)."""
         if node_id == self.name:
             return self.transport._handlers[action](payload)
         info = self.state["nodes"].get(node_id)
         if info is None:
             raise NodeError(f"unknown node [{node_id}]")
-        return self._send(tuple(info["address"]), action, payload, timeout)
+        if action not in _ARS_ACTIONS:
+            # only search-phase responses feed the routing signal —
+            # recovery chunks / replication would pollute it
+            return self._send(tuple(info["address"]), action, payload, timeout)
+        t0 = time.perf_counter()
+        try:
+            out = self._send(tuple(info["address"]), action, payload, timeout)
+        except BaseException:
+            # a fast failure must NOT look like a fast response: blend
+            # in the full timeout as a penalty so dead/misbehaving
+            # copies deprioritize instead of attracting traffic
+            prev = self.response_ewma.get(node_id)
+            self.response_ewma[node_id] = (
+                timeout if prev is None else 0.7 * prev + 0.3 * timeout
+            )
+            raise
+        dt = time.perf_counter() - t0
+        prev = self.response_ewma.get(node_id)
+        self.response_ewma[node_id] = (
+            dt if prev is None else 0.7 * prev + 0.3 * dt
+        )
+        return out
 
     def master_request(self, action: str, payload, timeout: float = 30.0):
         """Route a metadata mutation to the master

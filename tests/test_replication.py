@@ -266,3 +266,61 @@ class TestPeerRecovery:
             assert entry["primary"] == "node-0"
         finally:
             a.close()
+
+
+class TestAdaptiveReplicaSelection:
+    def test_remote_hops_feed_ewma(self):
+        """Cross-node calls record per-node EWMA response times."""
+        nodes = make_cluster(3, fd_interval=5.0)
+        a, b, c = nodes
+        try:
+            # replicas=0: some shards are NOT on b, so b's searches hop
+            a.create_index("ars", {"settings": {"number_of_shards": 6,
+                                                "number_of_replicas": 0}})
+            for i in range(12):
+                a.index_doc("ars", str(i), {"body": f"doc {i}"})
+            a.refresh("ars")
+            for _ in range(3):
+                b.search("ars", {"query": {"match": {"body": "doc"}}})
+            assert b.response_ewma, "remote search hops were not measured"
+            assert all(v > 0 for v in b.response_ewma.values())
+        finally:
+            for n in nodes:
+                n.close()
+
+    def test_selection_prefers_fastest_measured_copy(self):
+        """_search_node: local first, then lowest EWMA, exploring
+        unmeasured copies before committing to measurements."""
+        from elasticsearch_tpu.cluster.indices import IndexService
+
+        def no_call(*a, **k):  # pragma: no cover
+            raise AssertionError("not dispatched in this test")
+
+        times = {}
+        idx = IndexService(
+            "ars-unit",
+            settings={"number_of_shards": 1, "number_of_replicas": 2},
+            routing={0: {"primary": "n1", "replicas": ["n2", "n3"],
+                         "in_sync": ["n1", "n2", "n3"],
+                         "primary_term": 1}},
+            local_node="n0",  # holds no copy: always remote
+            remote_call=no_call,
+            response_times=times,
+        )
+        try:
+            # no measurements: explores copies round-robin
+            first = {idx._search_node(0) for _ in range(6)}
+            assert first <= {"n1", "n2", "n3"} and len(first) >= 2
+            # partial measurements: unmeasured copies explored first
+            times["n1"] = 0.5
+            picks = [idx._search_node(0) for _ in range(6)]
+            assert set(picks) <= {"n1", "n2", "n3"}
+            assert any(p in ("n2", "n3") for p in picks)
+            # full measurements: fastest dominates, with periodic
+            # round-robin probes keeping the others sampled
+            times.update({"n2": 0.001, "n3": 2.0})
+            picks = [idx._search_node(0) for _ in range(16)]
+            assert picks.count("n2") >= 10  # fastest dominates
+            assert len(set(picks)) >= 2  # probes still sample others
+        finally:
+            idx.close()
