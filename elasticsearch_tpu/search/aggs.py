@@ -80,6 +80,8 @@ BUCKET_TYPES = {
     "missing",
     "composite",
     "global",
+    "geo_distance",
+    "sampler",
 }
 
 
@@ -707,6 +709,86 @@ class AggCollector:
             "after": node.params.get("after"),
         }
 
+    def _collect_geo_distance(self, node, masks):
+        """geo_distance rings around an origin (GeoDistanceAggregator):
+        haversine over the geo_point's lat/lon doc-value columns. The
+        bucket/key/keyed machinery is the range agg's (same partial
+        shape, same reduce branch, same '50.0-100.0' key format)."""
+        from ..search.dsl import _geo_point, parse_distance_meters
+        from ..search.executor import _haversine_m
+
+        f = _req(node, "field")
+        origin_lat, origin_lon = _geo_point(_req(node, "origin"))
+        unit = str(node.params.get("unit", "m"))
+        unit_m = parse_distance_meters(f"1{unit}")
+        ranges = node.params.get("ranges")
+        if not isinstance(ranges, list) or not ranges:
+            raise AggParseError("[geo_distance] requires [ranges]")
+        # per-segment distances (and field presence), computed once
+        seg_dist = []
+        seg_base = []
+        for si, mask in enumerate(masks):
+            lat, le = self._numeric_values(si, f"{f}.lat")
+            lon, loe = self._numeric_values(si, f"{f}.lon")
+            seg_dist.append(
+                _haversine_m(origin_lat, origin_lon, lat, lon) / unit_m
+            )
+            seg_base.append(mask & le & loe)
+        out = []
+        for r in ranges:
+            frm = float(r["from"]) if r.get("from") is not None else None
+            to = float(r["to"]) if r.get("to") is not None else None
+            bucket_masks = []
+            cnt = 0
+            for si in range(len(masks)):
+                m = seg_base[si]
+                if frm is not None:
+                    m = m & (seg_dist[si] >= frm)
+                if to is not None:
+                    m = m & (seg_dist[si] < to)
+                bucket_masks.append(m)
+                cnt += int(m.sum())
+            key = r.get("key")
+            if key is None:
+                fs = _range_key_part(r.get("from"), False, frm)
+                ts = _range_key_part(r.get("to"), False, to)
+                key = f"{fs}-{ts}"
+            entry = {
+                "key": key,
+                "doc_count": cnt,
+                "subs": self._sub_collect(node, bucket_masks),
+            }
+            if frm is not None:
+                entry["from"] = frm
+            if to is not None:
+                entry["to"] = to
+            out.append(entry)
+        return {
+            "t": "geo_distance",
+            "buckets": out,
+            "keyed": node.params.get("keyed", False),
+        }
+
+    def _collect_sampler(self, node, masks):
+        """sampler: sub-aggs see only the first shard_size matching docs
+        per shard (SamplerAggregator's best-docs simplification: our
+        masks carry no scores, so document order stands in for rank)."""
+        shard_size = _int_param(node, "shard_size", 100)
+        remaining = shard_size
+        sampled = []
+        for mask in masks:
+            m = np.zeros_like(mask)
+            if remaining > 0:
+                idx = np.nonzero(mask)[0][:remaining]
+                m[idx] = True
+                remaining -= len(idx)
+            sampled.append(m)
+        return {
+            "t": "sampler",
+            "doc_count": int(sum(int(m.sum()) for m in sampled)),
+            "subs": self._sub_collect(node, sampled),
+        }
+
     # ---- histogram family ----
 
     def _collect_histogram(self, node, masks):
@@ -1150,7 +1232,7 @@ def _reduce_node(node: AggNode, parts: List[dict]) -> dict:
             entry.update(_reduce_subs(node, b["subs"]))
             buckets.append(entry)
         return {"buckets": buckets}
-    if t in ("range", "date_range"):
+    if t in ("range", "date_range", "geo_distance"):
         keyed = parts[0]["keyed"] if parts else False
         by_key: Dict[str, dict] = {}
         order: List[str] = []
@@ -1185,7 +1267,7 @@ def _reduce_node(node: AggNode, parts: List[dict]) -> dict:
                 }
             }
         return {"buckets": buckets}
-    if t == "filter" or t == "missing":
+    if t in ("filter", "missing", "sampler"):
         return {
             "doc_count": sum(p["doc_count"] for p in parts),
             **_reduce_subs(node, [p["subs"] for p in parts]),

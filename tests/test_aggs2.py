@@ -256,3 +256,67 @@ class TestPipelines:
 
         with pytest.raises(Exception):
             search(cluster, {"bad": {"derivative": {"buckets_path": "x"}}})
+
+
+class TestGeoDistanceAndSampler:
+    @pytest.fixture(scope="class")
+    def geo_cluster(self):
+        c = ClusterService()
+        c.create_index("geo", {
+            "settings": {"number_of_shards": 2,
+                         "search.backend": "numpy"},
+            "mappings": {"properties": {
+                "loc": {"type": "geo_point"},
+                "pop": {"type": "integer"},
+            }},
+        })
+        idx = c.get_index("geo")
+        cities = [
+            ("paris", 48.8566, 2.3522, 100),
+            ("versailles", 48.8049, 2.1204, 10),   # ~17 km
+            ("orleans", 47.9030, 1.9093, 20),      # ~110 km
+            ("lyon", 45.7640, 4.8357, 50),         # ~390 km
+            ("nyc", 40.7128, -74.0060, 80),        # ~5800 km
+        ]
+        for name, lat, lon, pop in cities:
+            idx.index_doc(name, {"loc": {"lat": lat, "lon": lon},
+                                 "pop": pop})
+        idx.refresh()
+        yield c
+        c.close()
+
+    def test_geo_distance_rings(self, geo_cluster):
+        r = geo_cluster.search("geo", {"size": 0, "aggs": {"rings": {
+            "geo_distance": {
+                "field": "loc",
+                "origin": {"lat": 48.8566, "lon": 2.3522},
+                "unit": "km",
+                "ranges": [{"to": 50}, {"from": 50, "to": 500},
+                           {"from": 500}],
+            },
+            "aggs": {"pop": {"sum": {"field": "pop"}}},
+        }}})
+        b = r["aggregations"]["rings"]["buckets"]
+        assert [x["doc_count"] for x in b] == [2, 2, 1]
+        assert b[0]["pop"]["value"] == 110.0  # paris + versailles
+        assert b[2]["key"] == "500.0-*"  # range-agg key format
+        # keyed form returns a key→bucket object
+        rk = geo_cluster.search("geo", {"size": 0, "aggs": {"rings": {
+            "geo_distance": {
+                "field": "loc",
+                "origin": {"lat": 48.8566, "lon": 2.3522},
+                "unit": "km", "keyed": True,
+                "ranges": [{"to": 50}],
+            }}}})["aggregations"]["rings"]
+        assert isinstance(rk["buckets"], dict)
+        assert rk["buckets"]["*-50.0"]["doc_count"] == 2
+
+    def test_sampler_limits_sub_agg_scope(self, geo_cluster):
+        r = geo_cluster.search("geo", {"size": 0, "aggs": {"sample": {
+            "sampler": {"shard_size": 1},
+            "aggs": {"pop": {"value_count": {"field": "pop"}}},
+        }}})
+        s = r["aggregations"]["sample"]
+        # at most one doc per shard feeds the sub-agg
+        assert 1 <= s["doc_count"] <= 2
+        assert s["pop"]["value"] == s["doc_count"]
