@@ -423,7 +423,14 @@ def main():
         for b in blist[:6]:
             svc_jax.search(b)
         log(f"[{name}] warm ({time.perf_counter()-tw:.1f}s)")
+        if name == "hybrid_rrf":
+            # per-leg breakdown over the measured window only (warmup
+            # included compile time)
+            with svc_jax._rrf_lock:
+                for key in svc_jax.rrf_stats:
+                    svc_jax.rrf_stats[key] = 0
         qps, p50, p99 = run_load(svc_jax, blist)
+        rrf_snapshot = dict(svc_jax.rrf_stats) if name == "hybrid_rrf" else None
         log(f"[{name}] jax: {qps:.1f} QPS, p50={p50:.2f}ms p99={p99:.2f}ms")
         o_qps, o_p50, _ = run_load(
             svc_np, blist[: oracle_n[name]], threads=ORACLE_THREADS
@@ -443,6 +450,29 @@ def main():
             "recall": round(recall, 4),
             "max_score_rel_delta": float(f"{max_rel:.3e}"),
         }
+        if name == "hybrid_rrf":
+            # hybrid execution breakdown: per-leg wall time measured
+            # from leg fan-out start (overlapped legs therefore SUM to
+            # more than the request wall time — that overlap is the
+            # point) + device-vs-host fusion counts
+            st = rrf_snapshot
+            n_rrf = max(1, st["searches"])
+            configs[name].update(
+                {
+                    "bm25_leg_ms": round(st["bm25_leg_ms"] / n_rrf, 2),
+                    "knn_leg_ms": round(st["knn_leg_ms"] / n_rrf, 2),
+                    "fuse_ms": round(st["fuse_ms"] / n_rrf, 2),
+                    "device_fused": st["device_fused"],
+                    "host_fused": st["host_fused"],
+                }
+            )
+            log(
+                f"[hybrid_rrf] legs: bm25={configs[name]['bm25_leg_ms']}ms "
+                f"knn={configs[name]['knn_leg_ms']}ms "
+                f"fuse={configs[name]['fuse_ms']}ms "
+                f"(device_fused={st['device_fused']}, "
+                f"host_fused={st['host_fused']})"
+            )
 
     # WAND variant of the match config (track_total_hits: false)
     wand_bodies = [

@@ -46,6 +46,14 @@ DEFAULT_SETTINGS = {k: s.default for k, s in INDEX_SETTINGS.items()}
 # saturated pool queues requests rather than deadlocking)
 _FANOUT_POOL = ThreadPoolExecutor(max_workers=32, thread_name_prefix="search-fanout")
 
+# hybrid retriever legs get their OWN pool: a leg task is NOT a leaf (a
+# standard leg runs a whole coordinator search, which submits shard
+# tasks to _FANOUT_POOL and blocks) — sharing one pool would let
+# saturated leg tasks starve the shard tasks they wait on. Legs nested
+# inside a leg thread run inline instead (same cycle, one pool deeper).
+_LEG_POOL_PREFIX = "rrf-leg"
+_LEG_POOL = ThreadPoolExecutor(max_workers=32, thread_name_prefix=_LEG_POOL_PREFIX)
+
 ACTION_SHARD_SEARCH = "indices:data/read/search_shard"
 ACTION_SHARD_COUNT = "indices:data/read/count_shard"
 ACTION_SHARD_OPS = "indices:data/write/shard_ops"
@@ -339,6 +347,19 @@ class IndexService:
             "query_total": 0,
             "query_time_in_millis": 0,
             "fetch_total": 0,
+        }
+        # hybrid (RRF) execution breakdown: cumulative per-leg wall
+        # times measured from leg fan-out start, so overlapped legs sum
+        # to MORE than the request wall time — bench.py reports the
+        # averages (bm25_leg_ms / knn_leg_ms / fuse_ms)
+        self._rrf_lock = threading.Lock()
+        self.rrf_stats = {
+            "searches": 0,
+            "bm25_leg_ms": 0.0,
+            "knn_leg_ms": 0.0,
+            "fuse_ms": 0.0,
+            "device_fused": 0,
+            "host_fused": 0,
         }
 
     # ---- routing ----
@@ -1392,6 +1413,23 @@ class IndexService:
         body = body or {}
         if "retriever" in body:
             return self._retriever_search(body, extra_filter), None, []
+        rank = body.get("rank")
+        if (
+            isinstance(rank, dict)
+            and "rrf" in rank
+            and "query" in body
+            and "knn" in body
+        ):
+            # top-level query + knn + rank.rrf (the 8.8 hybrid search
+            # API) rides the SAME concurrent-leg + device-fusion path
+            # as the rrf retriever tree
+            return (
+                self._retriever_search(
+                    _rank_to_retriever(body), extra_filter
+                ),
+                None,
+                [],
+            )
         if extra_filter is not None:
             inner = body.get("query", {"match_all": {}})
             body = {
@@ -1556,69 +1594,27 @@ class IndexService:
     ) -> dict:
         """`retriever` tree: standard / knn / rrf (x-pack rank-rrf:
         RRFRetrieverBuilder — score = Σ 1/(rank_constant + rank) over
-        child retrievers, exact-doc dedup, rank_window_size candidates)."""
+        child retrievers, exact-doc dedup, rank_window_size candidates).
+
+        Hybrid execution pipeline: all children of an `rrf` node run
+        CONCURRENTLY — plannable legs (flat match / multi_match / bool
+        text plans and bare knn sections on a single-shard jax backend)
+        are submitted through the QueryBatcher's async future API so the
+        BM25 and kNN device kernels overlap; everything else fans out on
+        the shared thread pool. Both legs share one rank_window_size
+        candidate budget, and when every leg came back with integer
+        (segment, doc) identity from one executor the fusion itself runs
+        on device (ops/fusion.rrf_fuse_device) with the host dict fuse
+        kept as fallback + oracle."""
         t0 = time.perf_counter()
         size = int(body.get("size", 10))
         from_ = int(body.get("from", 0))
         source_spec = body.get("_source", True)
 
-        def run(ret: dict, window: int) -> List[tuple]:
-            """ranked [(doc_id, score)] for one retriever node."""
-            if not isinstance(ret, dict) or len(ret) != 1:
-                raise dsl.QueryParseError("[retriever] malformed")
-            kind, params = next(iter(ret.items()))
-            if kind == "standard":
-                sub = {"size": window, "_source": False}
-                if "query" in params:
-                    sub["query"] = params["query"]
-                filters = [
-                    f
-                    for f in (params.get("filter"), extra_filter)
-                    if f is not None
-                ]
-                if filters:
-                    sub["query"] = {
-                        "bool": {
-                            "must": [sub.get("query", {"match_all": {}})],
-                            "filter": filters,
-                        }
-                    }
-                resp = self.search(sub)
-                return [
-                    (h["_id"], h["_score"]) for h in resp["hits"]["hits"]
-                ]
-            if kind == "knn":
-                knn_params = dict(params)
-                if extra_filter is not None:
-                    # alias filter constrains the knn candidate set too
-                    existing = knn_params.get("filter")
-                    knn_params["filter"] = (
-                        {"bool": {"filter": [existing, extra_filter]}}
-                        if existing is not None
-                        else extra_filter
-                    )
-                resp = self.search(
-                    {"knn": knn_params, "size": window, "_source": False}
-                )
-                return [
-                    (h["_id"], h["_score"]) for h in resp["hits"]["hits"]
-                ]
-            if kind == "rrf":
-                rank_constant = int(params.get("rank_constant", 60))
-                window2 = int(params.get("rank_window_size", max(window, size)))
-                fused: Dict[str, float] = {}
-                for child in params.get("retrievers", []):
-                    ranked = run(child, window2)
-                    for rank, (doc_id, _) in enumerate(ranked, 1):
-                        fused[doc_id] = fused.get(doc_id, 0.0) + 1.0 / (
-                            rank_constant + rank
-                        )
-                ordered = sorted(fused.items(), key=lambda kv: (-kv[1], kv[0]))
-                return ordered[:window2]
-            raise dsl.QueryParseError(f"unknown retriever [{kind}]")
-
         window = max(from_ + size, 10)
-        ranked = run(body["retriever"], window)
+        ranked = self._run_retriever(
+            body["retriever"], window, size, extra_filter
+        )
         page = ranked[from_ : from_ + size]
         from ..search.executor import filter_source
 
@@ -1647,6 +1643,267 @@ class IndexService:
                 "hits": out_hits,
             },
         }
+
+    # ---- hybrid retrieval: concurrent legs + RRF fusion ----
+
+    def _run_retriever(
+        self, ret: dict, window: int, size: int,
+        extra_filter: Optional[dict],
+    ) -> List[tuple]:
+        """ranked [(doc_id, score)] for one retriever node (sync)."""
+        if not isinstance(ret, dict) or len(ret) != 1:
+            raise dsl.QueryParseError("[retriever] malformed")
+        kind, params = next(iter(ret.items()))
+        if kind == "standard":
+            sub = {"size": window, "_source": False}
+            if "query" in params:
+                sub["query"] = params["query"]
+            filters = [
+                f
+                for f in (params.get("filter"), extra_filter)
+                if f is not None
+            ]
+            if filters:
+                sub["query"] = {
+                    "bool": {
+                        "must": [sub.get("query", {"match_all": {}})],
+                        "filter": filters,
+                    }
+                }
+            resp = self.search(sub)
+            return [(h["_id"], h["_score"]) for h in resp["hits"]["hits"]]
+        if kind == "knn":
+            knn_params = dict(params)
+            if extra_filter is not None:
+                # alias filter constrains the knn candidate set too
+                existing = knn_params.get("filter")
+                knn_params["filter"] = (
+                    {"bool": {"filter": [existing, extra_filter]}}
+                    if existing is not None
+                    else extra_filter
+                )
+            resp = self.search(
+                {"knn": knn_params, "size": window, "_source": False}
+            )
+            return [(h["_id"], h["_score"]) for h in resp["hits"]["hits"]]
+        if kind == "rrf":
+            return self._run_rrf(params, window, size, extra_filter)
+        raise dsl.QueryParseError(f"unknown retriever [{kind}]")
+
+    def _run_rrf(
+        self, params: dict, window: int, size: int,
+        extra_filter: Optional[dict],
+    ) -> List[tuple]:
+        """Concurrent child legs + fusion. All legs share ONE
+        rank_window_size candidate budget."""
+        rank_constant = int(params.get("rank_constant", 60))
+        window2 = int(params.get("rank_window_size", max(window, size)))
+        children = params.get("retrievers", [])
+        t_start = time.perf_counter()
+        # submit every leg before collecting any: plannable legs enter
+        # the batcher (device overlap), the rest ride the thread pool
+        handles = [
+            self._submit_leg(child, window2, extra_filter)
+            for child in children
+        ]
+        legs = [self._wait_leg(h, window2, extra_filter, t_start)
+                for h in handles]
+        t_fuse = time.perf_counter()
+        fused: Optional[List[tuple]] = None
+        device = False
+        executors = {id(l["ex"]) for l in legs if l["ex"] is not None}
+        if (
+            len(legs) >= 2
+            and all(l["td"] is not None for l in legs)
+            and len(executors) == 1
+        ):
+            fused = self._fuse_legs_device(legs, window2, rank_constant)
+            device = fused is not None
+        if fused is None:
+            # host fallback/oracle: dict accumulation, tie-break on
+            # ascending doc id string (pre-concurrency semantics)
+            acc: Dict[str, float] = {}
+            for leg in legs:
+                for rank, (doc_id, _) in enumerate(leg["ranked"], 1):
+                    acc[doc_id] = acc.get(doc_id, 0.0) + 1.0 / (
+                        rank_constant + rank
+                    )
+            fused = sorted(acc.items(), key=lambda kv: (-kv[1], kv[0]))[
+                :window2
+            ]
+        t_end = time.perf_counter()
+        with self._rrf_lock:
+            st = self.rrf_stats
+            st["searches"] += 1
+            st["fuse_ms"] += (t_end - t_fuse) * 1000.0
+            st["device_fused" if device else "host_fused"] += 1
+            for leg in legs:
+                if leg["label"] in ("bm25", "knn"):
+                    st[f"{leg['label']}_leg_ms"] += leg["ms"]
+        return fused
+
+    def _submit_leg(
+        self, child: dict, window: int, extra_filter: Optional[dict]
+    ) -> dict:
+        """Async leg submission: a batcher future when the child reduces
+        to a device plan, else a thread-pool future running the sync
+        path. EsRejectedExecutionError propagates (HTTP 429) — the async
+        path keeps the dispatcher's backpressure."""
+        if not isinstance(child, dict) or len(child) != 1:
+            raise dsl.QueryParseError("[retriever] malformed")
+        kind, params = next(iter(child.items()))
+        label = {"standard": "bm25", "knn": "knn"}.get(kind, "other")
+        planned = self._plan_leg(kind, params, window, extra_filter)
+        if planned is not None:
+            ex, plan, pkind, query = planned
+            try:
+                job = self._batcher.submit_nowait(
+                    ex, plan, window, kind=pkind, query=query
+                )
+                return {
+                    "mode": "batcher", "job": job, "ex": ex,
+                    "label": label, "child": child,
+                }
+            except RuntimeError:
+                pass  # batcher closed → sync fallback below
+        if threading.current_thread().name.startswith(_LEG_POOL_PREFIX):
+            # nested rrf: already on a leg thread — run inline rather
+            # than wait on a pool slot a sibling may be starving
+            return {
+                "mode": "done",
+                "ranked": self._run_retriever(
+                    child, window, window, extra_filter
+                ),
+                "label": label, "child": child,
+            }
+        fut = _LEG_POOL.submit(
+            self._run_retriever, child, window, window, extra_filter
+        )
+        return {"mode": "pool", "fut": fut, "label": label, "child": child}
+
+    def _plan_leg(
+        self, kind: str, params: dict, window: int,
+        extra_filter: Optional[dict],
+    ):
+        """(executor, plan, plan_kind, query) when this child can ride
+        the batcher directly: single locally-held shard, jax backend,
+        no filters. None → thread-pool path."""
+        if (
+            self.routing is not None
+            or self.num_shards != 1
+            or extra_filter is not None
+            or str(self.settings.get("search.backend")) != "jax"
+        ):
+            return None
+        from ..search.batcher import (
+            extract_knn_plan,
+            extract_match_plan,
+            extract_serve_plan,
+        )
+        from ..search.executor_jax import JaxExecutor
+
+        try:
+            ex = self._executor(self.local_shard(0))
+        except KeyError:
+            return None
+        if not isinstance(ex, JaxExecutor):
+            return None
+        if kind == "standard":
+            if params.get("filter") is not None or "query" not in params:
+                return None
+            query = dsl.parse_query(params["query"])
+            plan = extract_match_plan(
+                query, self.mappings, self.analysis, 10_000
+            )
+            if plan is not None:
+                return ex, plan, "match", query
+            plan = extract_serve_plan(query, self.mappings, self.analysis)
+            if plan is not None:
+                return ex, plan, "serve", query
+            return None
+        if kind == "knn":
+            try:
+                sec = dsl.parse_knn(params)
+            except (dsl.QueryParseError, KeyError, TypeError, ValueError):
+                return None  # malformed → sync path raises the real error
+            plan = extract_knn_plan([sec], self.mappings)
+            if plan is None:
+                return None
+            return ex, plan, "knn", None
+        return None
+
+    def _wait_leg(
+        self, handle: dict, window: int, extra_filter: Optional[dict],
+        t_start: float,
+    ) -> dict:
+        """Collects one leg: {"ranked", "td", "ex", "label", "ms"}."""
+        td = None
+        ex = None
+        if handle["mode"] == "batcher":
+            from ..search.batcher import QueryBatcher
+
+            try:
+                td = QueryBatcher.wait(handle["job"])
+                ex = handle["ex"]
+                ranked = [(h.doc_id, h.score) for h in td.hits]
+            except RuntimeError:
+                # batcher closed mid-flight → sync fallback
+                ranked = self._run_retriever(
+                    handle["child"], window, window, extra_filter
+                )
+        elif handle["mode"] == "done":
+            ranked = handle["ranked"]
+        else:
+            ranked = handle["fut"].result()
+        return {
+            "ranked": ranked,
+            "td": td,
+            "ex": ex,
+            "label": handle["label"],
+            "ms": (time.perf_counter() - t_start) * 1000.0,
+        }
+
+    def _fuse_legs_device(
+        self, legs: List[dict], k: int, rank_constant: int
+    ) -> Optional[List[tuple]]:
+        """Device-side RRF over the legs' top-window (segment, doc)
+        arrays: global int doc ids (segment-base + local doc) keep
+        exact-doc identity, fusion + dedup + top-k run as one jitted
+        program (ops/fusion), and winners map back to _id strings on the
+        host. Tie-break is ascending global doc — the same (segment,
+        doc) asc order every other merge in the engine uses. Legs pad to
+        a fixed [1, window] shape so the kernel compiles once per
+        (n_legs, window, k)."""
+        from ..ops.fusion import rrf_fuse_device
+
+        import numpy as np
+
+        ex = next(l["ex"] for l in legs if l["ex"] is not None)
+        reader = ex.reader
+        bases = np.zeros(len(reader.segments) + 1, np.int64)
+        np.cumsum(
+            [seg.num_docs for seg in reader.segments], out=bases[1:]
+        )
+        id_map: Dict[int, str] = {}
+        arrays = []
+        width = max(int(k), 1)
+        for leg in legs:
+            hits = leg["td"].hits[:width]
+            arr = np.full((1, width), -1, np.int32)
+            for r, h in enumerate(hits):
+                g = int(bases[h.segment] + h.local_doc)
+                arr[0, r] = g
+                id_map[g] = h.doc_id
+            arrays.append(arr)
+        s, d = rrf_fuse_device(arrays, k, rank_constant)
+        s = np.asarray(s)[0]
+        d = np.asarray(d)[0]
+        out: List[tuple] = []
+        for sc, doc in zip(s, d):
+            if doc < 0 or not np.isfinite(sc):
+                break  # padding sorts last
+            out.append((id_map[int(doc)], float(sc)))
+        return out
 
     def count(
         self, body: Optional[dict] = None, extra_filter: Optional[dict] = None
@@ -1887,6 +2144,23 @@ class IndexService:
             "settings": {"index": index_settings},
             "mappings": self.mappings.to_json(),
         }
+
+
+def _rank_to_retriever(body: dict) -> dict:
+    """Rewrites a top-level {query, knn, rank: {rrf}} hybrid search to
+    the equivalent rrf retriever tree so both APIs share one execution
+    path (the reference's RRFRankBuilder does the same collapse)."""
+    rrf = dict(body["rank"].get("rrf") or {})
+    knn_body = body["knn"]
+    knn_list = knn_body if isinstance(knn_body, list) else [knn_body]
+    rrf["retrievers"] = [{"standard": {"query": body["query"]}}] + [
+        {"knn": kb} for kb in knn_list
+    ]
+    out = {
+        k: v for k, v in body.items() if k not in ("rank", "knn", "query")
+    }
+    out["retriever"] = {"rrf": rrf}
+    return out
 
 
 def _nested_with_inner_hits(q) -> list:

@@ -74,6 +74,11 @@ class NotMasterError(NodeError):
 # DEFAULT_KEEPALIVE is 5 minutes).
 DEFAULT_CTX_KEEPALIVE = 300.0
 
+# Marker a replica puts in its rejection when the SENDER's primary term
+# is stale; the sender recognizes it (possibly re-hydrated into another
+# error type by the transport) and does not report the replica failed.
+STALE_PRIMARY_MARKER = "stale_primary_term"
+
 # actions whose response times feed adaptive replica selection
 # (ResponseCollectorService records search-phase responses only)
 _ARS_ACTIONS = {ACTION_SHARD_SEARCH, ACTION_SHARD_COUNT}
@@ -1348,9 +1353,20 @@ class TpuNode:
                     self.remote_call(
                         target,
                         ACTION_SHARD_REPLICA_OPS,
-                        {"index": p["index"], "shard": sid, "ops": rops},
+                        {"index": p["index"], "shard": sid, "ops": rops,
+                         # primary-term fencing (ReplicationTracker /
+                         # IndexShard term checks): replicas reject ops
+                         # from a demoted primary that has not yet seen
+                         # the promotion's cluster state
+                         "primary_term": eng.primary_term},
                     )
-                except (TransportError, NodeError, ClusterError):
+                except (TransportError, NodeError, ClusterError) as e:
+                    if STALE_PRIMARY_MARKER in str(e):
+                        # the REPLICA fenced US as stale: the failure is
+                        # ours, not the (likely promoted) target's —
+                        # reporting it shard-failed would knock the
+                        # healthy new primary out of the in-sync set
+                        continue
                     # ClusterError covers re-hydrated remote failures
                     # (e.g. the replica missed the index-creation publish)
                     self._report_shard_failed(p["index"], sid, target)
@@ -1373,13 +1389,24 @@ class TpuNode:
 
     def _handle_replica_ops(self, p: dict) -> dict:
         """Replica side of the write fan-out: apply with the primary's
-        version+seqno, no CAS (IndexShard.applyIndexOperationOnReplica)."""
+        version+seqno, no CAS (IndexShard.applyIndexOperationOnReplica).
+        Ops are primary-term-FENCED first: a term lower than this
+        engine's means the sender was demoted and must not diverge the
+        copies — the whole batch is rejected (shard-failed back to the
+        stale sender), exactly the reference's term check."""
         idx = self._index_service(p["index"])
         sid = int(p["shard"])
         eng = idx._local.get(sid)
         if eng is None:
             raise NodeError(
                 f"replica shard [{p['index']}][{sid}] not on [{self.name}]"
+            )
+        term = int(p.get("primary_term", 0))
+        if term and term < eng.primary_term:
+            raise NodeError(
+                f"{STALE_PRIMARY_MARKER}: operation primary term [{term}] "
+                f"is too old (current [{eng.primary_term}]) for shard "
+                f"[{p['index']}][{sid}]"
             )
         for op in p["ops"]:
             if op["op"] == "index":

@@ -157,6 +157,13 @@ def _py_tiles_encode(tiles: np.ndarray) -> bytes:
                 prev = v
                 first = False
             else:
+                if v < prev:
+                    # an unsorted row must fail LOUDLY: its negative
+                    # delta would alias the -1 padding sentinel and
+                    # round-trip silently corrupted
+                    raise ValueError(
+                        "tiles_encode: doc ids not ascending within row"
+                    )
                 enc = v - prev
                 prev = v
             u = ((enc << 1) ^ (enc >> 31)) & 0xFFFFFFFF
@@ -209,6 +216,8 @@ def tiles_encode(tiles: np.ndarray) -> bytes:
     n = lib.tiles_encode(
         tiles.ctypes.data, n_tiles, width, out.ctypes.data
     )
+    if n < 0:
+        raise ValueError("tiles_encode: doc ids not ascending within row")
     return out[:n].tobytes()
 
 

@@ -1,3 +1,3 @@
-from . import scoring
+from . import fusion, scoring
 
-__all__ = ["scoring"]
+__all__ = ["fusion", "scoring"]

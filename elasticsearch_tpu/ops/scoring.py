@@ -367,29 +367,43 @@ class FusedScorer:
             out[j, 2 * T + 2 * H] = msm
         return out
 
-    def search(self, plans, k: int, with_cnt: bool):
-        """One device round trip for up to BPAD jobs. Returns
-        (scores f32[B,k], docs i32[B,k], totals i64[B])."""
+    def search_async(self, plans, k: int, with_cnt: bool):
+        """Launches the fused kernel WITHOUT waiting for the result:
+        returns (device_out, k) for decode_result(). Device dispatch is
+        async in jax, so a caller can launch several groups (e.g. the
+        BM25 and kNN legs of a hybrid search) back-to-back and only
+        block when it collects."""
         k = min(k, self.n_docs)
         packed = self.pack_plans(plans)
-        out = np.asarray(
-            _fused_query(
-                self.doc_ids,
-                self.tfs,
-                self.inv_norm,
-                self.live,
-                self.dense,
-                jax.device_put(packed),
-                t_rare=self.t_rare,
-                n_hot=self.n_hot_slots,
-                k=k,
-                with_cnt=with_cnt,
-            )
+        out = _fused_query(
+            self.doc_ids,
+            self.tfs,
+            self.inv_norm,
+            self.live,
+            self.dense,
+            jax.device_put(packed),
+            t_rare=self.t_rare,
+            n_hot=self.n_hot_slots,
+            k=k,
+            with_cnt=with_cnt,
         )
+        return out, k
+
+    @staticmethod
+    def decode_result(pending):
+        """Blocks on the device transfer and unpacks to
+        (scores f32[B,k], docs i32[B,k], totals i64[B])."""
+        out, k = pending
+        out = np.asarray(out)
         scores = out[:, :k].copy().view(np.float32)
         docs = out[:, k : 2 * k]
         totals = out[:, 2 * k].astype(np.int64)
         return scores, docs, totals
+
+    def search(self, plans, k: int, with_cnt: bool):
+        """One device round trip for up to BPAD jobs. Returns
+        (scores f32[B,k], docs i32[B,k], totals i64[B])."""
+        return self.decode_result(self.search_async(plans, k, with_cnt))
 
 
 @functools.partial(
@@ -519,28 +533,30 @@ class MultiFusedScorer:
             out[j, F * sec] = msm
         return out
 
-    def search(self, plans, k: int, combine: str, tie: float):
+    def search_async(self, plans, k: int, combine: str, tie: float):
+        """Async launch (see FusedScorer.search_async): returns
+        (device_out, k) for decode_result()."""
         k = min(k, self.n_docs)
         packed = self.pack_plans(plans)
-        out = np.asarray(
-            _fused_query_mf(
-                tuple(p["doc_ids"] for p in self.parts),
-                tuple(p["tfs"] for p in self.parts),
-                tuple(p["inv_norm"] for p in self.parts),
-                tuple(p["dense"] for p in self.parts),
-                self.live,
-                jax.device_put(packed),
-                jnp.float32(tie),
-                t_rare=self.t_rare,
-                n_hot=self.n_hot_slots,
-                k=k,
-                combine=combine,
-            )
+        out = _fused_query_mf(
+            tuple(p["doc_ids"] for p in self.parts),
+            tuple(p["tfs"] for p in self.parts),
+            tuple(p["inv_norm"] for p in self.parts),
+            tuple(p["dense"] for p in self.parts),
+            self.live,
+            jax.device_put(packed),
+            jnp.float32(tie),
+            t_rare=self.t_rare,
+            n_hot=self.n_hot_slots,
+            k=k,
+            combine=combine,
         )
-        scores = out[:, :k].copy().view(np.float32)
-        docs = out[:, k: 2 * k]
-        totals = out[:, 2 * k].astype(np.int64)
-        return scores, docs, totals
+        return out, k
+
+    decode_result = staticmethod(FusedScorer.decode_result)
+
+    def search(self, plans, k: int, combine: str, tie: float):
+        return self.decode_result(self.search_async(plans, k, combine, tie))
 
 
 @functools.partial(

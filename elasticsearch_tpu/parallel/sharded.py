@@ -39,7 +39,18 @@ from ..models import bm25
 from ..ops.scoring import _score_tiles_inner, next_bucket
 from .mesh import DATA_AXIS, SHARD_AXIS
 
-shard_map = jax.shard_map
+if hasattr(jax, "shard_map"):
+    shard_map = jax.shard_map
+else:
+    # older jax (< 0.6): the API lives in jax.experimental and the
+    # replication-check kwarg is named check_rep, not check_vma
+    from jax.experimental.shard_map import shard_map as _shard_map_exp
+
+    def shard_map(f, *, mesh, in_specs, out_specs, check_vma=True):
+        return _shard_map_exp(
+            f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+            check_rep=bool(check_vma),
+        )
 
 
 class ShardedTopK(NamedTuple):
@@ -375,39 +386,12 @@ def rrf_fuse(
     """Reciprocal-rank fusion of two ranked lists (x-pack rank-rrf:
     `RRFQueryPhaseRankCoordinatorContext`, score = Σ 1/(rank_constant+rank)).
 
-    Device-side: builds sparse rank maps by comparing global doc ids, no
-    host round-trip. Returns (scores[B,k], global_docs[B,k]).
-    """
+    Device-side via the shared ops/fusion kernel (also the serving
+    path's fuser): exact-doc dedup over the union of both lists, top-k
+    with ascending-global-doc tie-break. Returns (scores[B,k],
+    global_docs[B,k])."""
+    from ..ops.fusion import rrf_fuse_device
 
-    @jax.jit
-    def fuse(ls, ld, vs, vd):
-        B, kl = ld.shape
-        kv = vd.shape[1]
-        ranks_l = jnp.arange(1, kl + 1, dtype=jnp.float32)[None, :]
-        ranks_v = jnp.arange(1, kv + 1, dtype=jnp.float32)[None, :]
-        contrib_l = jnp.where(ld >= 0, 1.0 / (rank_constant + ranks_l), 0.0)
-        contrib_v = jnp.where(vd >= 0, 1.0 / (rank_constant + ranks_v), 0.0)
-        # candidate set = union of both lists (dedup via pairwise compare)
-        docs = jnp.concatenate([ld, vd], axis=1)  # [B, kl+kv]
-        scr_l = jnp.where(
-            (docs[:, :, None] == ld[:, None, :]) & (ld[:, None, :] >= 0),
-            contrib_l[:, None, :],
-            0.0,
-        ).sum(-1)
-        scr_v = jnp.where(
-            (docs[:, :, None] == vd[:, None, :]) & (vd[:, None, :] >= 0),
-            contrib_v[:, None, :],
-            0.0,
-        ).sum(-1)
-        fused = jnp.where(docs >= 0, scr_l + scr_v, -jnp.inf)
-        # dedup: keep first occurrence of each doc
-        first = (docs[:, :, None] == docs[:, None, :]) & (
-            jnp.arange(docs.shape[1])[None, None, :]
-            < jnp.arange(docs.shape[1])[None, :, None]
-        )
-        fused = jnp.where(first.any(-1), -jnp.inf, fused)
-        s, i = jax.lax.top_k(fused, min(k, fused.shape[1]))
-        d = jnp.take_along_axis(docs, i, axis=1)
-        return s, jnp.where(s > -jnp.inf, d, -1)
-
-    return fuse(lex.scores, lex.global_docs, vec.scores, vec.global_docs)
+    return rrf_fuse_device(
+        (lex.global_docs, vec.global_docs), k, rank_constant
+    )

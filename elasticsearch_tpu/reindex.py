@@ -219,8 +219,22 @@ def update_by_query(
     )
 
     def process(h: dict):
-        src = dict(h.get("_source") or {})
         doc_id = h["_id"]
+        # re-read through the primary for the doc's CURRENT source and
+        # seq_no, then write with a seq_no CAS: a concurrent write
+        # between the read and the reindex raises VersionConflictError
+        # (counted into version_conflicts / honored per conflicts=
+        # proceed by the driver) instead of being silently lost
+        cur = idx.get_doc(doc_id)
+        if cur is None:
+            raise VersionConflictError(
+                f"[{doc_id}]: version conflict, document deleted"
+            )
+        src = dict(cur["_source"] or {})
+        cas = {
+            "if_seq_no": cur["_seq_no"],
+            "if_primary_term": cur["_primary_term"],
+        }
         op = "index"
         if script is not None:
             src, op = _run_script_ctx(script, src, doc_id, op)
@@ -228,11 +242,11 @@ def update_by_query(
             driver.counters["noops"] += 1
             return
         if op == "delete":
-            r = idx.delete_doc(doc_id)
+            r = idx.delete_doc(doc_id, **cas)
             if r.result == "deleted":
                 driver.counters["deleted"] += 1
             return
-        idx.index_doc(doc_id, src)
+        idx.index_doc(doc_id, src, **cas)
         driver.counters["updated"] += 1
 
     resp = driver.run(process)

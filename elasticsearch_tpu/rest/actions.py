@@ -861,6 +861,25 @@ class RestActions:
                 "action_request_validation_exception",
                 "doc must be specified if doc_as_upsert is enabled",
             )
+        # read-then-write races are caught by a seq_no CAS (the engine's
+        # if_seq_no/if_primary_term) and retried per retry_on_conflict —
+        # UpdateHelper + TransportUpdateAction semantics; without the CAS
+        # a concurrent write between our get and our index is silently
+        # overwritten (lost write)
+        retries = int(qs.get("retry_on_conflict", ["0"])[0])
+        while True:
+            try:
+                return self._update_doc_once(idx, params, routing, body, qs)
+            except VersionConflictError as e:
+                if retries <= 0:
+                    return 409, error_body(
+                        409, "version_conflict_engine_exception", str(e)
+                    )
+                retries -= 1
+
+    def _update_doc_once(self, idx, params, routing, body, qs):
+        doc_part = body.get("doc")
+        script = body.get("script")
         existing = idx.get_doc(params["id"], routing=routing)
         if existing is None:
             if body.get("doc_as_upsert") or "upsert" in body:
@@ -882,7 +901,11 @@ class RestActions:
                             "_shards": {"total": 0, "successful": 0,
                                         "failed": 0},
                         }
-                r = idx.index_doc(params["id"], merged, routing=routing)
+                # op_type=create: a doc created concurrently since our
+                # get is a conflict, not a blind overwrite
+                r = idx.index_doc(
+                    params["id"], merged, op_type="create", routing=routing
+                )
                 self._maybe_refresh(idx, qs)
                 return 201, self._doc_response(params["index"], r, idx.num_shards)
             return 404, error_body(
@@ -905,12 +928,20 @@ class RestActions:
                     "_primary_term": existing["_primary_term"],
                 }
             if op == "delete":
-                r = idx.delete_doc(params["id"], routing=routing)
+                r = idx.delete_doc(
+                    params["id"], routing=routing,
+                    if_seq_no=existing["_seq_no"],
+                    if_primary_term=existing["_primary_term"],
+                )
                 self._maybe_refresh(idx, qs)
                 return 200, self._doc_response(
                     params["index"], r, idx.num_shards
                 )
-            r = idx.index_doc(params["id"], merged, routing=routing)
+            r = idx.index_doc(
+                params["id"], merged, routing=routing,
+                if_seq_no=existing["_seq_no"],
+                if_primary_term=existing["_primary_term"],
+            )
             self._maybe_refresh(idx, qs)
             return 200, self._doc_response(params["index"], r, idx.num_shards)
         merged = deep_merge(existing["_source"], doc_part)
@@ -924,7 +955,11 @@ class RestActions:
                 "_seq_no": existing["_seq_no"],
                 "_primary_term": existing["_primary_term"],
             }
-        r = idx.index_doc(params["id"], merged, routing=routing)
+        r = idx.index_doc(
+            params["id"], merged, routing=routing,
+            if_seq_no=existing["_seq_no"],
+            if_primary_term=existing["_primary_term"],
+        )
         self._maybe_refresh(idx, qs)
         return 200, self._doc_response(params["index"], r, idx.num_shards)
 

@@ -19,8 +19,25 @@ from __future__ import annotations
 import hashlib
 import json
 import os
+import threading
 import time
 from typing import Dict, List, Optional
+
+# one mutation lock per repository LOCATION (several FsRepository
+# instances — e.g. one per in-process node — may point at the same
+# directory): create() writes content-addressed blobs BEFORE committing
+# its catalog entry, so a concurrent delete()'s _gc_blobs scan would see
+# them as unreferenced and unlink them out from under the new snapshot.
+# Serializing create/delete closes that window (the reference holds the
+# repository generation lock across BlobStoreRepository mutations).
+_LOCATION_LOCKS: Dict[str, threading.RLock] = {}
+_LOCATION_LOCKS_GUARD = threading.Lock()
+
+
+def _location_lock(location: str) -> threading.RLock:
+    key = os.path.abspath(location)
+    with _LOCATION_LOCKS_GUARD:
+        return _LOCATION_LOCKS.setdefault(key, threading.RLock())
 
 
 class SnapshotError(Exception):
@@ -43,6 +60,7 @@ class FsRepository:
     def __init__(self, name: str, location: str):
         self.name = name
         self.location = location
+        self._mutation_lock = _location_lock(location)
         os.makedirs(os.path.join(location, "blobs"), exist_ok=True)
 
     # ---- catalog (the index-N generation file) ----
@@ -92,7 +110,13 @@ class FsRepository:
     def create(self, snap: str, index_payloads: Dict[str, dict]) -> dict:
         """index_payloads: index name → {"settings", "mappings", "uuid",
         "num_shards", "shards": {sid: {"files": {rel: bytes}} |
-        {"docs": [...]}}}. Returns the catalog entry."""
+        {"docs": [...]}}}. Returns the catalog entry. Serialized with
+        delete() so the GC can never unlink blobs written by a
+        not-yet-committed create."""
+        with self._mutation_lock:
+            return self._create_locked(snap, index_payloads)
+
+    def _create_locked(self, snap: str, index_payloads: Dict[str, dict]) -> dict:
         catalog = self._read_catalog()
         if snap in catalog["snapshots"]:
             raise SnapshotError(
@@ -153,12 +177,13 @@ class FsRepository:
         return list(self._read_catalog()["snapshots"].values())
 
     def delete(self, snap: str) -> None:
-        catalog = self._read_catalog()
-        if snap not in catalog["snapshots"]:
-            raise SnapshotMissingError(self.name, snap)
-        del catalog["snapshots"][snap]
-        self._write_catalog(catalog)
-        self._gc_blobs(catalog)
+        with self._mutation_lock:
+            catalog = self._read_catalog()
+            if snap not in catalog["snapshots"]:
+                raise SnapshotMissingError(self.name, snap)
+            del catalog["snapshots"][snap]
+            self._write_catalog(catalog)
+            self._gc_blobs(catalog)
 
     def _gc_blobs(self, catalog: dict) -> None:
         """Removes blobs no surviving snapshot references (the cleanup

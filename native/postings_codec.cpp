@@ -71,6 +71,10 @@ int64_t vb_decode(const uint8_t* in, int64_t len, int32_t* out, int64_t n) {
 // padding is encoded as the value -1 delta'd against itself (delta 0
 // would collide), so we simply switch to absolute -1, which zigzags to
 // one byte.
+//
+// Rows MUST be ascending: a negative delta would alias the -1 padding
+// sentinel and round-trip silently corrupted, so an unsorted row
+// returns -1 (the Python wrapper raises).
 int64_t tiles_encode(const int32_t* vals, int64_t n_tiles, int64_t width,
                      uint8_t* out) {
     uint8_t* p = out;
@@ -88,6 +92,7 @@ int64_t tiles_encode(const int32_t* vals, int64_t n_tiles, int64_t width,
                 prev = v;
                 first = 0;
             } else {
+                if (v < prev) return -1;  // unsorted row: refuse
                 enc = v - prev;
                 prev = v;
             }

@@ -1,0 +1,279 @@
+"""Regression tests for the round-5 advisor findings.
+
+Each test fails on the pre-fix code path:
+  * replica write fencing: a demoted primary's ops (stale primary_term)
+    must be rejected by replicas, not silently interleaved;
+  * scripted _update / _update_by_query: a write landing between the
+    read and the re-index must surface as a version conflict (seq_no
+    CAS), never a silent lost write;
+  * snapshot repository: delete()'s blob GC must not unlink blobs
+    written by a concurrent, not-yet-committed create();
+  * postings codec: an unsorted doc-id tile row must be rejected loudly
+    instead of aliasing the -1 padding sentinel.
+"""
+
+import json
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from elasticsearch_tpu.cluster.node import NodeError, TpuNode
+from elasticsearch_tpu.cluster.service import ClusterService
+from elasticsearch_tpu.native import codec
+from elasticsearch_tpu.reindex import update_by_query
+from elasticsearch_tpu.rest.actions import RestActions
+from elasticsearch_tpu.snapshots.repository import FsRepository
+from elasticsearch_tpu.tasks import TaskManager
+
+
+def make_task():
+    return TaskManager("n").register("test")
+
+
+# ---------------------------------------------------------------------------
+# replica primary-term fencing
+# ---------------------------------------------------------------------------
+
+
+class TestReplicaTermFencing:
+    def test_replica_rejects_stale_term_ops(self):
+        node = TpuNode("n0").start()
+        try:
+            node.create_index("f", {"settings": {"number_of_shards": 1}})
+            eng = node.indices["f"].local_shards[0]
+            eng.primary_term = 2  # simulated promotion on this copy
+            payload = {
+                "index": "f", "shard": 0, "primary_term": 1,
+                "ops": [{"op": "index", "id": "d1", "source": {"x": 1},
+                         "version": 1, "seq_no": 0}],
+            }
+            with pytest.raises(NodeError) as ei:
+                node._handle_replica_ops(payload)
+            assert "stale_primary_term" in str(ei.value)
+            assert eng.get("d1") is None, "fenced op must not apply"
+            # a current-term batch still applies normally
+            node._handle_replica_ops({**payload, "primary_term": 2})
+            assert eng.get("d1") is not None
+        finally:
+            node.close()
+
+    def test_stale_primary_ops_do_not_reach_promoted_replica(self):
+        a = TpuNode("a", fd_interval=0.2, fd_retries=3).start()
+        b = TpuNode("b", seeds=[a.address], fd_interval=0.2,
+                    fd_retries=3).start()
+        try:
+            a.create_index(
+                "g",
+                {"settings": {"number_of_shards": 1,
+                              "number_of_replicas": 1}},
+            )
+            routing = a.state["indices"]["g"]["routing"]
+            entry = routing[0] if 0 in routing else routing["0"]
+            primary_node = a if entry["primary"] == "a" else b
+            replica_node = b if primary_node is a else a
+            # simulate the replica having been promoted (bumped term)
+            # while the old primary still serves writes
+            replica_node.indices["g"].local_shards[0].primary_term = 99
+            primary_node.index_doc("g", "doc-1", {"v": 1})
+            # the write acks on the (stale) primary...
+            assert (
+                primary_node.indices["g"].local_shards[0].get("doc-1")
+                is not None
+            )
+            # ...but the fenced replica never applied it (pre-fix it
+            # interleaved the stale op, diverging the copies)
+            assert replica_node.indices["g"].local_shards[0].get("doc-1") is None
+        finally:
+            b.close()
+            a.close()
+
+
+# ---------------------------------------------------------------------------
+# scripted update / update_by_query lost writes
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture
+def cluster():
+    c = ClusterService()
+    c.create_index(
+        "s",
+        {
+            "settings": {"number_of_shards": 1,
+                         "search.backend": "numpy"},
+            "mappings": {"properties": {"n": {"type": "integer"}}},
+        },
+    )
+    yield c
+    c.close()
+
+
+class TestUpdateCas:
+    def _racy_script_runner(self, idx, interfere_source):
+        """Wraps _run_update_script so the FIRST call loses the race:
+        a concurrent writer lands between the read and our re-index."""
+        orig = RestActions._run_update_script
+        calls = {"n": 0}
+
+        def racy(script, source, doc_id):
+            if calls["n"] == 0:
+                calls["n"] += 1
+                idx.index_doc(doc_id, dict(interfere_source))
+            return orig(script, source, doc_id)
+
+        return racy
+
+    def test_scripted_update_conflict_not_lost_write(self, cluster, monkeypatch):
+        a = RestActions(cluster)
+        idx = cluster.get_index("s")
+        idx.index_doc("c1", {"n": 1})
+        monkeypatch.setattr(
+            RestActions, "_run_update_script",
+            staticmethod(self._racy_script_runner(idx, {"n": 999})),
+        )
+        st, resp = a.update_doc(
+            {"script": {"source": "ctx['_source']['n'] += 1"}},
+            {"index": "s", "id": "c1"}, {},
+        )
+        assert st == 409, "read-then-write race must surface as a conflict"
+        assert resp["error"]["type"] == "version_conflict_engine_exception"
+        # the concurrent write survived — pre-fix it was overwritten
+        # with n == 2 (script applied to the STALE read)
+        assert idx.get_doc("c1")["_source"]["n"] == 999
+
+    def test_retry_on_conflict_reapplies_on_fresh_read(self, cluster, monkeypatch):
+        a = RestActions(cluster)
+        idx = cluster.get_index("s")
+        idx.index_doc("c2", {"n": 1})
+        monkeypatch.setattr(
+            RestActions, "_run_update_script",
+            staticmethod(self._racy_script_runner(idx, {"n": 100})),
+        )
+        st, resp = a.update_doc(
+            {"script": {"source": "ctx['_source']['n'] += 1"}},
+            {"index": "s", "id": "c2"},
+            {"retry_on_conflict": ["2"]},
+        )
+        assert st == 200 and resp["result"] == "updated"
+        # retried attempt read the CONCURRENT version, not the stale one
+        assert idx.get_doc("c2")["_source"]["n"] == 101
+
+    def test_update_by_query_counts_version_conflicts(self, cluster, monkeypatch):
+        import elasticsearch_tpu.reindex as reindex_mod
+
+        idx = cluster.get_index("s")
+        for i in range(5):
+            idx.index_doc(f"d{i}", {"n": i})
+        idx.refresh()
+        orig = reindex_mod._run_script_ctx
+        calls = {"n": 0}
+
+        def racy(script, source, doc_id, op):
+            if calls["n"] == 0:
+                calls["n"] += 1
+                idx.index_doc(doc_id, {"n": 777})
+            return orig(script, source, doc_id, op)
+
+        monkeypatch.setattr(reindex_mod, "_run_script_ctx", racy)
+        r = update_by_query(
+            cluster, "s",
+            {"script": {"source": "ctx['_source']['n'] += 1"},
+             "conflicts": "proceed"},
+            make_task(),
+        )
+        # pre-fix: version_conflicts could NEVER fire (no CAS) and the
+        # concurrent write was silently overwritten
+        assert r["version_conflicts"] == 1
+        assert r["updated"] == 4
+        conflicted = [
+            i for i in range(5)
+            if cluster.get_index("s").get_doc(f"d{i}")["_source"]["n"] == 777
+        ]
+        assert len(conflicted) == 1
+
+
+# ---------------------------------------------------------------------------
+# snapshot repository GC vs concurrent create
+# ---------------------------------------------------------------------------
+
+
+class TestSnapshotGcRace:
+    @staticmethod
+    def _payload(tag: str) -> dict:
+        return {
+            "idx": {
+                "settings": {}, "mappings": {}, "uuid": "u",
+                "num_shards": 1,
+                "shards": {0: {"docs": [
+                    {"id": "d", "source": {"v": tag},
+                     "version": 1, "seq_no": 0},
+                ]}},
+            }
+        }
+
+    def test_gc_cannot_unlink_uncommitted_create_blobs(self, tmp_path):
+        repo = FsRepository("r", str(tmp_path / "repo"))
+        repo.create("s1", self._payload("first"))
+        in_create = threading.Event()
+        release = threading.Event()
+        orig_put = FsRepository._put_blob
+
+        def slow_put(self, data):
+            digest = orig_put(self, data)
+            # blob is on disk, catalog entry NOT yet committed — the
+            # window the GC race lives in
+            in_create.set()
+            release.wait(10)
+            return digest
+
+        repo._put_blob = slow_put.__get__(repo)
+        errors = []
+
+        def do_create():
+            try:
+                repo.create("s2", self._payload("second"))
+            except Exception as e:  # pragma: no cover - diagnostic
+                errors.append(e)
+
+        t_create = threading.Thread(target=do_create)
+        t_create.start()
+        assert in_create.wait(10)
+        t_delete = threading.Thread(target=lambda: repo.delete("s1"))
+        t_delete.start()
+        # pre-fix the delete runs to completion here and its GC unlinks
+        # s2's uncommitted blob; post-fix it blocks on the repo lock
+        t_delete.join(timeout=1.0)
+        release.set()
+        t_create.join(timeout=10)
+        t_delete.join(timeout=10)
+        assert not errors
+        # the new snapshot's payload must be readable (its blob intact)
+        docs = repo.shard_docs("s2", "idx", 0)
+        assert docs and docs[0]["source"]["v"] == "second"
+
+
+# ---------------------------------------------------------------------------
+# postings codec: unsorted rows fail loudly
+# ---------------------------------------------------------------------------
+
+
+class TestCodecAscendingGuard:
+    def test_sorted_rows_round_trip(self):
+        tiles = np.array([[1, 5, 9, -1], [0, 2, 2, 7]], np.int32)
+        enc = codec.tiles_encode(tiles)
+        dec = codec.tiles_decode(enc, 2, 4)
+        np.testing.assert_array_equal(dec, tiles)
+
+    def test_unsorted_row_rejected(self):
+        # pre-fix this row round-tripped CORRUPTED: the 9→5 negative
+        # delta encoded as the padding sentinel's alias
+        tiles = np.array([[1, 9, 5, -1]], np.int32)
+        with pytest.raises(ValueError):
+            codec.tiles_encode(tiles)
+
+    def test_python_fallback_rejects_unsorted_row(self):
+        tiles = np.array([[3, 2, 4, -1]], np.int32)
+        with pytest.raises(ValueError):
+            codec._py_tiles_encode(tiles)
