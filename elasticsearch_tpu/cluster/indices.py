@@ -682,6 +682,23 @@ class IndexService:
         for s in self.shards:
             s.close()
         self._batcher.close()
+        # drop this index's cache entries (and their ledger charges)
+        from ..search.query_cache import filter_cache, request_cache
+
+        filter_cache.clear([self.uuid])
+        request_cache.clear([self.uuid])
+
+    def clear_caches(self, query: bool = True, request: bool = True) -> int:
+        """POST {index}/_cache/clear: drops this index's filter-bitset
+        and/or request-cache entries; returns the entry count removed."""
+        from ..search.query_cache import filter_cache, request_cache
+
+        n = 0
+        if query:
+            n += filter_cache.clear([self.uuid])
+        if request:
+            n += request_cache.clear([self.uuid])
+        return n
 
     # ---- search: shard-level query phase (SearchService.executeQueryPhase
     # analog; runs on the shard's owning node) ----
@@ -694,16 +711,32 @@ class IndexService:
             cached = self._executors.get(shard.shard_id)
             if cached is not None and cached[0] == shard.change_generation:
                 return cached[1]
+            from ..search.query_cache import (
+                CacheCtx,
+                filter_cache,
+                request_cache,
+            )
+
             reader = shard.reader()
+            gen = shard.change_generation
+            shard_key = f"{self.uuid}[{shard.shard_id}]"
             backend = str(self.settings.get("search.backend", "numpy"))
             if backend == "jax":
                 from ..search.executor_jax import JaxExecutor
 
                 ex = JaxExecutor(reader)
+                ex.cache_ctx = CacheCtx(shard_key, gen, "jax")
+                ex._oracle.cache_ctx = CacheCtx(shard_key, gen, "np")
             else:
                 ex = NumpyExecutor(reader)
+                ex.cache_ctx = CacheCtx(shard_key, gen, "np")
+            # the refresh/merge that bumped the generation made every
+            # older-generation cache entry unreachable (keys embed the
+            # generation) — reclaim their bytes eagerly
+            filter_cache.invalidate_shard(shard_key, keep_generation=gen)
+            request_cache.invalidate_shard(shard_key, keep_generation=gen)
             old = self._executors.get(shard.shard_id)
-            self._executors[shard.shard_id] = (shard.change_generation, ex)
+            self._executors[shard.shard_id] = (gen, ex)
         if old is not None and hasattr(old[1], "close"):
             # release the stale generation's HBM ledger charges (an
             # executor pinned by scroll/PIT contexts stops charging once
@@ -723,6 +756,37 @@ class IndexService:
         by the coordinator."""
         ts = time.perf_counter_ns()
         body = body or {}
+        # ---- shard request cache (IndicesRequestCache): whole size:0 /
+        # agg-only responses keyed by (canonical request bytes, refresh
+        # generation) — a refresh that changed anything bumps the
+        # generation, so a stale entry can never be served ----
+        rc_key = None
+        if (
+            pinned_executor is None
+            and int(body.get("size", 10)) == 0
+            and not body.get("profile")
+            and "_dfs" not in body
+        ):
+            from ..search.query_cache import (
+                request_cache,
+                request_cacheable_body,
+            )
+
+            rc_flag = body.get("request_cache")
+            rc_enabled = (
+                bool(rc_flag)
+                if rc_flag is not None
+                else bool(self.settings.get("requests.cache.enable", True))
+            )
+            if rc_enabled and request_cacheable_body(body):
+                rc_key = (
+                    f"{self.uuid}[{sid}]",
+                    self.local_shard(sid).change_generation,
+                    dsl.canonical_body_key(body),
+                )
+                hit = request_cache.get(*rc_key)
+                if hit is not None:
+                    return hit
         k = int(body.get("size", 10))
         min_score = body.get("min_score")
         source_spec = body.get("_source", True)
@@ -806,6 +870,7 @@ class IndexService:
                 extract_knn_plan,
                 extract_match_plan,
                 extract_serve_plan,
+                split_filtered_bool,
             )
             from ..search.executor_jax import JaxExecutor
 
@@ -831,6 +896,18 @@ class IndexService:
                         )
                     except RuntimeError:
                         td = None  # batcher closed mid-request → unbatched
+                if td is None and plan is None and query is not None and knn is None:
+                    # bool with filter clauses: peel the filters into a
+                    # cached device bitset and run the scoring part as a
+                    # fused plan with the bitset masking the kernels
+                    split = split_filtered_bool(query)
+                    if split is not None and all(
+                        dsl.is_cacheable_filter(c) for c in split[1]
+                    ):
+                        td = ex.search_plan_filtered(
+                            split[0], split[1], k, tth,
+                            self.mappings, self.analysis,
+                        )
         agg_partial = None
         try:
             if (
@@ -1089,6 +1166,10 @@ class IndexService:
                 ],
                 "aggregations": [],
             }
+        if rc_key is not None:
+            from ..search.query_cache import request_cache
+
+            request_cache.put(*rc_key, out)
         return out
 
     # ---- can_match prefilter (CanMatchPreFilterSearchPhase) ----
@@ -2129,6 +2210,10 @@ class IndexService:
             "merges": {"total": agg["merge_total"]},
             "segments": {"count": agg["segments"]},
         }
+        from ..search.query_cache import filter_cache, request_cache
+
+        body["query_cache"] = filter_cache.stats_for_index(self.uuid)
+        body["request_cache"] = request_cache.stats_for_index(self.uuid)
         return {"uuid": self.uuid, "primaries": body, "total": body}
 
     def metadata(self) -> dict:

@@ -103,6 +103,20 @@ class HbmLedger:
                 "degraded_allocations": self.stats_counters["degraded"],
             }
 
+    def child_breakers(self) -> Dict[str, dict]:
+        """ES-style child-breaker entries, one per ledger category
+        (postings tiles, norms, dense rows, query_cache bitsets, …) —
+        the per-category byte usage the `_nodes/stats` breakers section
+        surfaces next to the `hbm` parent."""
+        with self._lock:
+            return {
+                f"hbm.{cat}": {
+                    "limit_size_in_bytes": self.budget,
+                    "estimated_size_in_bytes": nbytes,
+                }
+                for cat, nbytes in sorted(self._by_category.items())
+            }
+
 
 # process-wide ledger (one device per process in this deployment shape)
 hbm_ledger = HbmLedger()

@@ -109,6 +109,11 @@ INDEX_SETTINGS: Dict[str, Setting] = {
         Setting("merge.policy.max_segments", 8, INDEX_SCOPE, parser=int,
                 validator=_positive("merge.policy.max_segments")),
         Setting("knn.quantization", "none", INDEX_SCOPE),
+        # shard request cache default for size:0/agg-only requests
+        # (IndicesRequestCache's index.requests.cache.enable); the
+        # per-request ?request_cache= param overrides it either way
+        Setting("requests.cache.enable", True, INDEX_SCOPE,
+                parser=_parse_bool),
         Setting("hidden", False, INDEX_SCOPE, parser=_parse_bool),
         Setting("codec", "default", INDEX_SCOPE, dynamic=False),
         Setting("default_pipeline", None, INDEX_SCOPE),

@@ -237,15 +237,25 @@ class ChunkedScorer:
                 )
         return acc, cnt
 
-    def threshold(self, acc, k: int):
+    def threshold(self, acc, k: int, live=None):
+        """`live` optionally overrides the constructor's live-docs mask
+        (a cached filter bitset ANDed with live docs rides here — same
+        traced operand, no recompile)."""
         theta, accmax = _threshold(
-            acc, self.live, k=min(k, self.n_docs), block_size=self.block_size
+            acc,
+            live if live is not None else self.live,
+            k=min(k, self.n_docs),
+            block_size=self.block_size,
         )
         return np.asarray(theta), np.asarray(accmax)
 
-    def finalize(self, acc, cnt, msm: np.ndarray, k: int):
+    def finalize(self, acc, cnt, msm: np.ndarray, k: int, live=None):
         s, d, tot = _finalize(
-            acc, cnt, self.live, jnp.asarray(msm, jnp.int32), k=min(k, self.n_docs)
+            acc,
+            cnt,
+            live if live is not None else self.live,
+            jnp.asarray(msm, jnp.int32),
+            k=min(k, self.n_docs),
         )
         return np.asarray(s), np.asarray(d), np.asarray(tot)
 
@@ -367,19 +377,21 @@ class FusedScorer:
             out[j, 2 * T + 2 * H] = msm
         return out
 
-    def search_async(self, plans, k: int, with_cnt: bool):
+    def search_async(self, plans, k: int, with_cnt: bool, live=None):
         """Launches the fused kernel WITHOUT waiting for the result:
         returns (device_out, k) for decode_result(). Device dispatch is
         async in jax, so a caller can launch several groups (e.g. the
         BM25 and kNN legs of a hybrid search) back-to-back and only
-        block when it collects."""
+        block when it collects. `live` optionally overrides the
+        constructor's live-docs mask — cached filter bitsets mask the
+        kernel through this operand (traced arg: no recompile)."""
         k = min(k, self.n_docs)
         packed = self.pack_plans(plans)
         out = _fused_query(
             self.doc_ids,
             self.tfs,
             self.inv_norm,
-            self.live,
+            live if live is not None else self.live,
             self.dense,
             jax.device_put(packed),
             t_rare=self.t_rare,
@@ -400,10 +412,12 @@ class FusedScorer:
         totals = out[:, 2 * k].astype(np.int64)
         return scores, docs, totals
 
-    def search(self, plans, k: int, with_cnt: bool):
+    def search(self, plans, k: int, with_cnt: bool, live=None):
         """One device round trip for up to BPAD jobs. Returns
         (scores f32[B,k], docs i32[B,k], totals i64[B])."""
-        return self.decode_result(self.search_async(plans, k, with_cnt))
+        return self.decode_result(
+            self.search_async(plans, k, with_cnt, live=live)
+        )
 
 
 @functools.partial(
@@ -533,9 +547,11 @@ class MultiFusedScorer:
             out[j, F * sec] = msm
         return out
 
-    def search_async(self, plans, k: int, combine: str, tie: float):
+    def search_async(self, plans, k: int, combine: str, tie: float,
+                     live=None):
         """Async launch (see FusedScorer.search_async): returns
-        (device_out, k) for decode_result()."""
+        (device_out, k) for decode_result(). `live` optionally overrides
+        the live-docs mask (cached filter bitsets ride here)."""
         k = min(k, self.n_docs)
         packed = self.pack_plans(plans)
         out = _fused_query_mf(
@@ -543,7 +559,7 @@ class MultiFusedScorer:
             tuple(p["tfs"] for p in self.parts),
             tuple(p["inv_norm"] for p in self.parts),
             tuple(p["dense"] for p in self.parts),
-            self.live,
+            live if live is not None else self.live,
             jax.device_put(packed),
             jnp.float32(tie),
             t_rare=self.t_rare,
@@ -555,8 +571,10 @@ class MultiFusedScorer:
 
     decode_result = staticmethod(FusedScorer.decode_result)
 
-    def search(self, plans, k: int, combine: str, tie: float):
-        return self.decode_result(self.search_async(plans, k, combine, tie))
+    def search(self, plans, k: int, combine: str, tie: float, live=None):
+        return self.decode_result(
+            self.search_async(plans, k, combine, tie, live=live)
+        )
 
 
 @functools.partial(

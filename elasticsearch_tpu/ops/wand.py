@@ -269,11 +269,21 @@ class BlockMaxIndex:
         return base if scale == 1.0 else base * np.float32(scale)
 
     def surviving_tiles(
-        self, p: TermPlan, potential: np.ndarray, theta: float
+        self,
+        p: TermPlan,
+        potential: np.ndarray,
+        theta: float,
+        block_live: Optional[np.ndarray] = None,
     ) -> np.ndarray:
         """Tile ids of one hot term whose block could still beat theta.
-        ``potential`` is accmax_row + Σ_t block_bounds per block."""
+        ``potential`` is accmax_row + Σ_t block_bounds per block.
+        ``block_live`` (bool[n_blocks]) additionally skips blocks with
+        no live/filter-passing doc at all — a cached filter bitset
+        reduced per block (a block the filter empties can never yield a
+        candidate, so skipping it is sound regardless of θ)."""
         sl = slice(p.tile_start, p.tile_start + p.tile_count)
         blocks = self.tiling.tile_block[sl]
         keep = potential[blocks] >= theta
+        if block_live is not None:
+            keep = keep & block_live[blocks]
         return np.arange(sl.start, sl.stop, dtype=np.int64)[keep]

@@ -72,6 +72,7 @@ class RestActions:
         add("GET", "/_cat/shards", self.cat_shards)
         add("GET", "/_cat/health", self.cat_health)
         add("POST", "/_bulk", self.bulk)
+        add("POST", "/_cache/clear", self.clear_cache)
         add("POST", "/_refresh", self.refresh_all)
         add("POST", "/_flush", self.flush_all)
         add("POST", "/_msearch", self.msearch)
@@ -132,6 +133,7 @@ class RestActions:
         add("GET", "/{index}/_settings", self.get_settings)
         add("PUT", "/{index}/_settings", self.put_settings)
         add("GET", "/{index}/_stats", self.index_stats)
+        add("POST", "/{index}/_cache/clear", self.clear_cache)
         add("POST", "/{index}/_refresh", self.refresh_index)
         add("GET", "/{index}/_refresh", self.refresh_index)
         add("POST", "/{index}/_flush", self.flush_index)
@@ -480,6 +482,34 @@ class RestActions:
             params["repo"], params["snap"], body
         )
 
+    def clear_cache(self, body, params, qs):
+        """POST [/{index}]/_cache/clear — drops filter-bitset and/or
+        request-cache entries (?query=false / ?request=false narrow it,
+        mirroring the reference's clear-cache flags)."""
+        do_query = qs.get("query", ["true"])[0] not in ("false", "0")
+        do_request = qs.get("request", ["true"])[0] not in ("false", "0")
+        index = params.get("index")
+        shards = 0
+        if index is not None:
+            targets = self.cluster.resolve(index)
+            for name, _ in targets:
+                idx = self.cluster.get_index(name)
+                idx.clear_caches(query=do_query, request=do_request)
+                shards += idx.num_shards
+        else:
+            from ..search.query_cache import filter_cache, request_cache
+
+            if do_query:
+                filter_cache.clear()
+            if do_request:
+                request_cache.clear()
+            shards = sum(
+                i.num_shards for i in self.cluster.indices.values()
+            )
+        return 200, {
+            "_shards": {"total": shards, "successful": shards, "failed": 0}
+        }
+
     def nodes_stats(self, body, params, qs):
         import resource
 
@@ -505,13 +535,22 @@ class RestActions:
             from ..search.batcher import QUEUE_CAPACITY
 
             queue_capacity = QUEUE_CAPACITY
+        from ..search.query_cache import filter_cache, request_cache
+
+        # per-category child breakers next to the "hbm" parent (per-
+        # category bytes were accounted but invisible before)
+        category_breakers = hbm_ledger.child_breakers()
         return 200, {
             "cluster_name": self.cluster.cluster_name,
             "nodes": {
                 "node-0": {
                     "name": self.cluster.node_name,
                     "roles": ["master", "data", "ingest"],
-                    "indices": {"docs": {"count": total_docs}},
+                    "indices": {
+                        "docs": {"count": total_docs},
+                        "query_cache": filter_cache.node_stats(),
+                        "request_cache": request_cache.node_stats(),
+                    },
                     "jvm": {  # shape parity; values are process RSS
                         "mem": {"heap_used_in_bytes": ru.ru_maxrss * 1024}
                     },
@@ -531,7 +570,8 @@ class RestActions:
                             "degraded_allocations": hbm[
                                 "degraded_allocations"
                             ],
-                        }
+                        },
+                        **category_breakers,
                     },
                     "thread_pool": {
                         "search": {
@@ -1030,6 +1070,12 @@ class RestActions:
             body["query"] = _parse_q_param(qs["q"][0])
         if "search_type" in qs:
             body["search_type"] = qs["search_type"][0]
+        if "request_cache" in qs:
+            # per-request shard-request-cache override (rides the body
+            # down to the shard; excluded from the cache key itself)
+            body["request_cache"] = qs["request_cache"][0] not in (
+                "false", "0",
+            )
         if "scroll" in qs:
             targets = self.cluster.resolve(params["index"])
             if len(targets) != 1:

@@ -895,10 +895,16 @@ class AggCollector:
     # ---- filter / filters / missing ----
 
     def _query_masks(self, query_json: dict, masks) -> List[np.ndarray]:
+        # agg filter contexts ride the node-level bitset cache (the
+        # reference caches agg `filter`/`filters` bitsets the same way)
         q = dsl.parse_query(query_json)
         out = []
         for si, mask in enumerate(masks):
-            m, _ = self.ex._exec(q, self.reader.segments[si])
+            seg = self.reader.segments[si]
+            if hasattr(self.ex, "filter_mask"):
+                m = self.ex.filter_mask(q, seg)
+            else:
+                m, _ = self.ex._exec(q, seg)
             out.append(mask & m)
         return out
 

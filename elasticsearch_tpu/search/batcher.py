@@ -305,6 +305,35 @@ def extract_serve_plan(
     return None
 
 
+def split_filtered_bool(query):
+    """(scoring-only bool, filter clauses) when `query` is a bool whose
+    filter clauses can be peeled off into a cached bitset while the
+    scoring part keeps its exact semantics; None otherwise.
+
+    The split is semantics-preserving only when the effective
+    minimum_should_match does not depend on the filters' presence:
+    with must clauses (or an explicit msm) the default is identical
+    either way; a should-only bool with filters defaults to msm 0,
+    which the stripped bool would flip to 1 — not splittable."""
+    if not isinstance(query, dsl.BoolQuery) or not query.filter:
+        return None
+    if query.must_not:
+        return None
+    if not (query.must or query.should):
+        return None  # pure filter: constant-score, generic path covers it
+    if not query.must and query.minimum_should_match is None:
+        return None
+    stripped = dsl.BoolQuery(
+        boost=query.boost,
+        must=list(query.must),
+        should=list(query.should),
+        filter=[],
+        must_not=[],
+        minimum_should_match=query.minimum_should_match,
+    )
+    return stripped, list(query.filter)
+
+
 def extract_knn_plan(knn_sections, mappings) -> Optional[KnnPlan]:
     """A single bare knn section (no filter, no similarity threshold)
     rides the batched matmul launch. A dims mismatch stays OFF the

@@ -861,3 +861,93 @@ def parse_minimum_should_match(msm: Any, num_clauses: int) -> int:
         return min(v, num_clauses)
     except ValueError as e:
         raise QueryParseError(f"invalid minimum_should_match [{msm}]") from e
+
+
+# ---------------------------------------------------------------------------
+# Canonical cache keys (query & request caching, search/query_cache.py)
+# ---------------------------------------------------------------------------
+
+def canonical_key(q: Any) -> str:
+    """Stable canonical serialization of a parsed query node — the
+    filter-bitset cache key. Keying the PARSED tree (not the raw JSON)
+    makes equivalent spellings share one bitset: {"term": {"f": "x"}}
+    and {"term": {"f": {"value": "x"}}} parse identically, so they hit
+    the same cache entry (the shape Lucene gets from Query.equals)."""
+    from dataclasses import fields as dc_fields
+
+    def enc(v: Any):
+        if isinstance(v, Query):
+            return [
+                type(v).__name__,
+                {f.name: enc(getattr(v, f.name)) for f in dc_fields(v)},
+            ]
+        if isinstance(v, (list, tuple)):
+            return [enc(x) for x in v]
+        if isinstance(v, dict):
+            return {str(k): enc(x) for k, x in v.items()}
+        if isinstance(v, (str, int, float, bool)) or v is None:
+            return v
+        return repr(v)
+
+    import json
+
+    return json.dumps(enc(q), sort_keys=True, separators=(",", ":"))
+
+
+def canonical_body_key(body: dict, exclude: tuple = ("request_cache",
+                                                     "preference")) -> str:
+    """Canonical request bytes for the shard request cache: the search
+    body minus per-request control flags that don't change the result."""
+    import json
+
+    return json.dumps(
+        {k: v for k, v in body.items() if k not in exclude},
+        sort_keys=True,
+        separators=(",", ":"),
+        default=repr,
+    )
+
+
+# Node types that never enter the filter-bitset cache (the analog of
+# UsageTrackingQueryCachingPolicy's never-cache list):
+#   * scripted / stateful nodes — not a pure function of the segment;
+#   * match_all / match_none — trivially cheap, caching wastes slots;
+#   * multi_match / query_string — field expansion reads the LIVE
+#     mappings dict, which dynamic mapping can grow without a refresh
+#     generation bump, so a cached bitset could go stale;
+#   * knn wrappers / function_score — per-request candidate cuts and
+#     score functions (random_score, scripts) aren't segment-pure;
+#   * percolate / more_like_this — evaluate against other documents.
+_UNCACHEABLE_FILTERS = (
+    "MatchAllQuery", "MatchNoneQuery", "MultiMatchQuery",
+    "QueryStringQuery", "FunctionScoreQuery", "ScriptScoreQuery",
+    "ScriptQuery", "PercolateQuery", "MoreLikeThisQuery",
+    "KnnQueryWrapper",
+)
+
+
+def is_cacheable_filter(q: Any) -> bool:
+    """True when a filter-context node is a pure function of one
+    segment's immutable data + the shard's searchable generation — the
+    gate for the filter-bitset cache. Compounds are cacheable iff every
+    child is."""
+    if not isinstance(q, Query):
+        return False
+    if type(q).__name__ in _UNCACHEABLE_FILTERS:
+        return False
+    if isinstance(q, BoolQuery):
+        kids = (
+            list(q.must) + list(q.should) + list(q.filter) + list(q.must_not)
+        )
+        return bool(kids) and all(is_cacheable_filter(c) for c in kids)
+    if isinstance(q, ConstantScoreQuery):
+        return is_cacheable_filter(q.filter_query)
+    if isinstance(q, DisMaxQuery):
+        return bool(q.queries) and all(is_cacheable_filter(c) for c in q.queries)
+    if isinstance(q, BoostingQuery):
+        return is_cacheable_filter(q.positive) and is_cacheable_filter(
+            q.negative
+        )
+    if isinstance(q, SpanNearQuery):
+        return all(is_cacheable_filter(c) for c in q.clauses)
+    return True

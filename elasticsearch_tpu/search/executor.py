@@ -162,6 +162,35 @@ class NumpyExecutor:
         self.b = b
         self._weight_cache: Dict[Tuple[str, str], float] = {}
         self._norm_cache: Dict[str, np.ndarray] = {}
+        # filter-bitset cache identity; None (executors constructed
+        # outside IndexService) disables the node-level cache
+        self.cache_ctx = None
+        self._seg_index = {id(s): i for i, s in enumerate(reader.segments)}
+
+    # ---- filter-context evaluation via the node-level bitset cache ----
+
+    def filter_mask(self, q: Query, seg: Segment) -> np.ndarray:
+        """Match mask of one filter-context clause on one segment,
+        reusing the node-level bitset cache (LRUQueryCache analog; host
+        entries are np.packbits bitmaps). Falls back to direct
+        evaluation when uncached/uncacheable — bit-identical either way
+        (filter context ignores scores)."""
+        ctx = self.cache_ctx
+        if ctx is None or not dsl.is_cacheable_filter(q):
+            return self._exec(q, seg)[0]
+        from .query_cache import filter_cache
+
+        si = self._seg_index.get(id(seg))
+        if si is None:
+            return self._exec(q, seg)[0]
+        fkey = dsl.canonical_key(q)
+        packed = filter_cache.get(ctx, si, fkey)
+        if packed is not None:
+            return np.unpackbits(packed, count=seg.num_docs).astype(bool)
+        mask = self._exec(q, seg)[0]
+        bits = np.packbits(mask.astype(np.uint8))
+        filter_cache.put(ctx, si, fkey, bits, int(bits.nbytes))
+        return mask
 
     # ---- term weight / norm cache (BM25Similarity.scorer) ----
     #
@@ -449,7 +478,7 @@ class NumpyExecutor:
         if isinstance(q, BoolQuery):
             return self._exec_bool(q, seg)
         if isinstance(q, ConstantScoreQuery):
-            m, _ = self._exec(q.filter_query, seg)
+            m = self.filter_mask(q.filter_query, seg)
             return m, np.where(m, np.float32(q.boost), np.float32(0)).astype(np.float32)
         if isinstance(q, MultiMatchQuery):
             return self._exec_multi_match(q, seg)
@@ -1292,8 +1321,7 @@ class NumpyExecutor:
             mask &= m
             scores = (scores + s).astype(np.float32)
         for c in q.filter:
-            m, _ = self._exec(c, seg)
-            mask &= m
+            mask &= self.filter_mask(c, seg)
         if q.should:
             smasks = []
             sscores = np.zeros(n, np.float32)
@@ -1372,8 +1400,7 @@ class NumpyExecutor:
         )
         mask = vf.exists.copy()
         if sec.filter is not None:
-            fm, _ = self._exec(sec.filter, seg)
-            mask &= fm
+            mask &= self.filter_mask(sec.filter, seg)
         live = self.reader.live_docs[si]
         if live is not None:
             mask = mask & live

@@ -179,6 +179,9 @@ class JaxExecutor:
         self._charges: List[Tuple[str, int]] = []
         self._charges_lock = threading.Lock()
         self._closed = False
+        # filter-bitset cache identity (set by IndexService._executor);
+        # None disables the node-level cache (bare test executors)
+        self.cache_ctx = None
         self.device_segments = [
             DeviceSegment(s, device, charge=self._charge)
             for s in reader.segments
@@ -248,6 +251,238 @@ class JaxExecutor:
             charges, self._charges = self._charges, []
         for category, nbytes in charges:
             hbm_ledger.release(category, nbytes)
+
+    # ---- filter-context evaluation via the device bitset cache ----
+
+    def filter_mask(self, q: Query, si: int) -> jax.Array:
+        """Match mask of one filter-context clause on one segment. On
+        the jax backend cached bitsets are DEVICE-RESIDENT boolean
+        arrays (HBM, `query_cache` ledger category) that the scoring
+        kernels consume directly — a hit skips the whole filter
+        sub-tree evaluation."""
+        ctx = self.cache_ctx
+        if ctx is None or not dsl.is_cacheable_filter(q):
+            return self._exec(q, si)[0]
+        from .query_cache import filter_cache
+
+        fkey = dsl.canonical_key(q)
+        cached = filter_cache.get(ctx, si, fkey)
+        if cached is not None:
+            return cached
+        mask = self._exec(q, si)[0]
+        if mask.dtype != jnp.bool_:
+            mask = mask.astype(jnp.bool_)
+        mask = jax.device_put(mask, self.device)
+        filter_cache.put(ctx, si, fkey, mask, int(mask.nbytes))
+        return mask
+
+    def combined_filter_mask(self, fclauses, si: int) -> jax.Array:
+        """AND of the (cached) filter bitsets and the live-docs bitmap —
+        the combined mask the scoring kernels take as their live
+        operand."""
+        mask = None
+        for c in fclauses:
+            m = self.filter_mask(c, si)
+            mask = m if mask is None else (mask & m)
+        live = self.reader.live_docs[si]
+        if live is not None:
+            l = jnp.asarray(live)
+            mask = l if mask is None else (mask & l)
+        if mask is None:
+            mask = jnp.ones(self.reader.segments[si].num_docs, bool)
+        return mask
+
+    # ---- bitset-masked plan serving (the filtered-bool hot path) ----
+
+    def search_plan_filtered(
+        self, stripped, fclauses, k: int, tth, mappings, analysis
+    ) -> Optional[TopDocs]:
+        """Serving path for a bool query whose filter clauses resolve to
+        cached bitsets: the scoring part reduces to a flat Match/Serve
+        plan and the combined bitset rides the fused kernels' live-mask
+        operand (ops/scoring.py) — filter re-evaluation is skipped
+        entirely on a warm cache. Returns None when the scoring part
+        can't be planned (caller falls back to the generic tree walk,
+        which also consumes the cached bitsets)."""
+        from .batcher import extract_match_plan, extract_serve_plan
+
+        mplan = None
+        splan = None
+        if (
+            isinstance(stripped, dsl.BoolQuery)
+            and len(stripped.must) == 1
+            and not stripped.should
+            and stripped.boost == 1.0
+            and isinstance(stripped.must[0], MatchQuery)
+        ):
+            # single-must match: the single-field fused/chunked engine
+            # (with block-max pruning when totals are untracked)
+            mplan = extract_match_plan(
+                stripped.must[0], mappings, analysis, tth
+            )
+        if mplan is None:
+            splan = extract_serve_plan(stripped, mappings, analysis)
+            if splan is None:
+                return None
+        kb = 16 if k <= 16 else scoring.next_bucket(k, 16)
+        cands: List[Tuple[float, int, int]] = []
+        total = 0
+        pruned = False
+        for si, seg in enumerate(self.reader.segments):
+            n = seg.num_docs
+            if n == 0:
+                continue
+            base = self.combined_filter_mask(fclauses, si)
+            if mplan is not None:
+                got = self._match_segment_filtered(mplan, si, base, kb)
+            else:
+                got = self._serve_segment_filtered(splan, si, base, kb)
+            if got is None:
+                # small segment / slot overflow: dense scoring with the
+                # bitset masked straight into the top-k kernel
+                mask, sc = self._exec(stripped, si)
+                mask = mask & base
+                s, d = scoring.topk_hits(sc, mask, min(kb, n))
+                got = (
+                    np.asarray(s),
+                    np.asarray(d),
+                    int(np.asarray(mask.sum())),
+                    False,
+                )
+            s, d, tot, seg_pruned = got
+            pruned = pruned or seg_pruned
+            total += tot
+            finite = np.isfinite(s)
+            for sc_, doc in zip(s[finite], d[finite]):
+                cands.append((float(sc_), si, int(doc)))
+        cands.sort(key=lambda c: (-c[0], c[1], c[2]))
+        page = cands[:k]
+        hits = [
+            Hit(
+                score=s,
+                segment=si,
+                local_doc=d,
+                doc_id=self.reader.segments[si].doc_ids[d],
+            )
+            for s, si, d in page
+        ]
+        return TopDocs(
+            total=total,
+            hits=hits,
+            max_score=hits[0].score if hits else None,
+            # pruned tiles make the collected count a lower bound
+            relation="gte" if pruned else "eq",
+        )
+
+    def _match_segment_filtered(self, plan, si: int, base, kb: int):
+        """(scores[k], docs[k], total, pruned) for one MatchPlan on one
+        segment, the filter bitset masking the kernels; None → dense
+        fallback."""
+        field = plan.field
+        n = self.reader.segments[si].num_docs
+        kk = min(kb, n)
+        fs = self.fused_scorer(si, field)
+        if fs is not None:
+            fplan = self.fused_plan(
+                fs, si, field, plan.terms, plan.boost, plan.msm
+            )
+            if fplan is not None:
+                s, d, tot = fs.search([fplan], kk, plan.msm > 1, live=base)
+                return s[0], d[0], int(tot[0]), False
+        bmx = self.block_index(si, field)
+        cs = self.chunked_scorer(si, field)
+        if bmx is None or cs is None:
+            return None
+        # pruning only when totals are untracked: a term's doc_freq
+        # can't prove >= cap FILTERED matches, so the unfiltered path's
+        # capped-total shortcut is unsound here
+        prune_ok = plan.wand_ok and plan.tth_cap == 0
+        with_cnt = plan.msm > 1
+        acc, cnt = cs.new_acc(with_cnt)
+        plans = bmx.plan(list(plan.terms), plan.boost)
+        empty_i = np.empty(0, np.int64)
+        empty_w = np.empty(0, np.float32)
+        ess, hots = [], []
+        for p in plans:
+            (hots if (prune_ok and p.hot) else ess).append(p)
+        if not ess and hots:
+            # the essential set must be non-empty or θ is -inf
+            hots.sort(key=lambda p: p.tile_count)
+            ess.append(hots.pop(0))
+
+        def tiles_of(ps):
+            tl = [
+                np.arange(
+                    p.tile_start, p.tile_start + p.tile_count, dtype=np.int64
+                )
+                for p in ps
+            ]
+            wl = [np.full(p.tile_count, p.weight, np.float32) for p in ps]
+            return (
+                np.concatenate(tl) if tl else empty_i,
+                np.concatenate(wl) if wl else empty_w,
+            )
+
+        t_ess, w_ess = tiles_of(ess)
+        acc, cnt = cs.score_into(acc, cnt, [t_ess], [w_ess])
+        pruned = False
+        if hots:
+            theta, accmax = cs.threshold(acc, kk, live=base)
+            # blocks with zero filter-passing docs can never contribute
+            # a candidate — mask them out of the survival test
+            bl = np.asarray(base)
+            bs = bmx.tiling.block_size
+            nb = bmx.tiling.n_blocks
+            padded = np.zeros(nb * bs, bool)
+            padded[: len(bl)] = bl
+            block_live = padded.reshape(nb, bs).any(axis=1)
+            sum_bounds = np.zeros(nb, np.float32)
+            for p in hots:
+                sum_bounds += bmx.block_bounds(p)
+            potential = accmax[0] + sum_bounds
+            tl2, wl2 = [], []
+            for p in hots:
+                kept = bmx.surviving_tiles(
+                    p, potential, theta[0], block_live=block_live
+                )
+                if len(kept) < p.tile_count:
+                    pruned = True
+                if len(kept):
+                    tl2.append(kept)
+                    wl2.append(np.full(len(kept), p.weight, np.float32))
+            acc, cnt = cs.score_into(
+                acc,
+                cnt,
+                [np.concatenate(tl2) if tl2 else empty_i],
+                [np.concatenate(wl2) if wl2 else empty_w],
+            )
+        msm_arr = np.ones(scoring.BPAD, np.int32)
+        msm_arr[0] = plan.msm
+        s, d, tot = cs.finalize(acc, cnt, msm_arr, kk, live=base)
+        return s[0], d[0], int(tot[0]), pruned
+
+    def _serve_segment_filtered(self, plan, si: int, base, kb: int):
+        """(scores[k], docs[k], total, pruned) for one ServePlan on one
+        segment via the multi-field fused kernel with the bitset as its
+        live operand; None → dense fallback."""
+        n = self.reader.segments[si].num_docs
+        kk = min(kb, n)
+        fs = self.fused_scorer_mf(si, plan.fields)
+        if fs is None:
+            return None
+        sections = []
+        for g in plan.groups:
+            parts = self.fused_parts(si, g.field)
+            if parts is None:
+                return None
+            sec = self.fused_plan_field(si, g.field, parts, g.terms, plan.boost)
+            if sec is None:
+                return None
+            sections.append(sec)
+        s, d, tot = fs.search(
+            [(sections, plan.msm)], kk, plan.combine, plan.tie, live=base
+        )
+        return s[0], d[0], int(tot[0]), False
 
     def _inv_norm(self, si: int, field: str, n: int) -> jax.Array:
         from .executor import DFS_STATS
@@ -436,7 +671,7 @@ class JaxExecutor:
         if isinstance(q, BoolQuery):
             return self._exec_bool(q, si)
         if isinstance(q, ConstantScoreQuery):
-            m, _ = self._exec(q.filter_query, si)
+            m = self.filter_mask(q.filter_query, si)
             return m, jnp.where(m, jnp.float32(q.boost), 0.0)
         if isinstance(q, MultiMatchQuery):
             return self._exec_multi_match(q, si)
@@ -1213,8 +1448,7 @@ class JaxExecutor:
         scores = scoring.knn_scores(qv, vectors, vf.similarity)[0]
         mask = exists
         if sec.filter is not None:
-            fm, _ = self._exec(sec.filter, si)
-            mask = mask & fm
+            mask = mask & self.filter_mask(sec.filter, si)
         live = self.reader.live_docs[si]
         if live is not None:
             mask = mask & jnp.asarray(live)
@@ -1400,8 +1634,7 @@ class JaxExecutor:
             mask = mask & m
             scores = scores + s
         for c in q.filter:
-            m, _ = self._exec(c, si)
-            mask = mask & m
+            mask = mask & self.filter_mask(c, si)
         if q.should:
             sscores = jnp.zeros(n, jnp.float32)
             match_count = jnp.zeros(n, jnp.int32)
@@ -1475,8 +1708,7 @@ class JaxExecutor:
             q = jnp.asarray(np.asarray(sec.query_vector, np.float32))[None, :]
             cand_mask = exists
             if sec.filter is not None:
-                fm, _ = self._exec(sec.filter, si)
-                cand_mask = cand_mask & fm
+                cand_mask = cand_mask & self.filter_mask(sec.filter, si)
             live = self.reader.live_docs[si]
             if live is not None:
                 cand_mask = cand_mask & jnp.asarray(live)

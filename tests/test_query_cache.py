@@ -1,0 +1,481 @@
+"""Device filter-bitset cache + shard request cache.
+
+Covers the two-tier caching subsystem (search/query_cache.py):
+  * filter-bitset cache hits, float-exact parity with the uncached
+    oracle, and the bitset-masked fused plan path (jax backend);
+  * exact invalidation on refresh-after-update, delete, and rollover
+    (no stale hit is ever served);
+  * LRU eviction under a tiny HBM budget (degrade-don't-fail);
+  * shard request cache for size:0/agg-only requests, the
+    ?request_cache= override, index.requests.cache.enable, and the
+    _cache/clear endpoint;
+  * hit/miss/eviction/memory stats in _nodes/stats and {index}/_stats.
+"""
+
+import numpy as np
+import pytest
+
+from elasticsearch_tpu.cluster.indices import IndexService
+from elasticsearch_tpu.common import memory
+from elasticsearch_tpu.search import dsl
+from elasticsearch_tpu.search.query_cache import (
+    CacheCtx,
+    FilterBitsetCache,
+    filter_cache,
+    request_cache,
+)
+
+MAPPINGS = {
+    "properties": {
+        "title": {"type": "text"},
+        "body": {"type": "text"},
+        "tag": {"type": "keyword"},
+        "n": {"type": "integer"},
+    }
+}
+
+
+def build_service(backend, name=None, shards=1, n_docs=60, settings=None):
+    s = {"number_of_shards": shards, "search.backend": backend}
+    if settings:
+        s.update(settings)
+    svc = IndexService(
+        name or f"qc-{backend}-{shards}", settings=s, mappings_json=MAPPINGS
+    )
+    for i in range(n_docs):
+        svc.index_doc(
+            str(i),
+            {
+                "title": f"alpha beta {i % 5}",
+                "body": f"gamma delta epsilon {i % 11}",
+                "tag": f"t{i % 3}",
+                "n": i,
+            },
+        )
+    svc.refresh()
+    return svc
+
+
+FILTERED_BODY = {
+    "query": {
+        "bool": {
+            "must": [{"match": {"title": "alpha"}}],
+            "should": [{"match": {"body": "delta"}}],
+            "filter": [
+                {"term": {"tag": "t1"}},
+                {"range": {"n": {"gte": 10}}},
+            ],
+        }
+    },
+    "size": 10,
+}
+
+
+def hits_of(resp):
+    return [(h["_id"], h["_score"]) for h in resp["hits"]["hits"]]
+
+
+@pytest.fixture(autouse=True)
+def _clean_caches():
+    filter_cache.clear()
+    request_cache.clear()
+    yield
+    filter_cache.clear()
+    request_cache.clear()
+
+
+class TestFilterBitsetCache:
+    @pytest.mark.parametrize("backend", ["numpy", "jax"])
+    def test_warm_hits_and_exact_results(self, backend):
+        svc = build_service(backend)
+        cold = svc.search(FILTERED_BODY)
+        before = filter_cache.node_stats()
+        warm = svc.search(FILTERED_BODY)
+        after = filter_cache.node_stats()
+        assert after["hit_count"] > before["hit_count"]
+        assert hits_of(cold) == hits_of(warm)
+        assert cold["hits"]["total"] == warm["hits"]["total"]
+        svc.close()
+
+    @pytest.mark.parametrize("shards", [1, 3])
+    def test_jax_matches_uncached_oracle_exactly(self, shards):
+        svc_np = build_service("numpy", shards=shards)
+        svc_jx = build_service("jax", shards=shards)
+        rn = svc_np.search(FILTERED_BODY)
+        rj_cold = svc_jx.search(FILTERED_BODY)
+        rj_warm = svc_jx.search(FILTERED_BODY)
+        # float-exact: same ids AND bitwise-equal scores vs the oracle
+        assert hits_of(rn) == hits_of(rj_cold) == hits_of(rj_warm)
+        assert rn["hits"]["total"] == rj_warm["hits"]["total"]
+        svc_np.close()
+        svc_jx.close()
+
+    def test_filtered_plan_path_is_used(self):
+        from elasticsearch_tpu.search.executor_jax import JaxExecutor
+
+        svc = build_service("jax")
+        calls = []
+        orig = JaxExecutor.search_plan_filtered
+
+        def spy(self, *a, **kw):
+            out = orig(self, *a, **kw)
+            calls.append(out is not None)
+            return out
+
+        JaxExecutor.search_plan_filtered = spy
+        try:
+            svc.search(FILTERED_BODY)
+        finally:
+            JaxExecutor.search_plan_filtered = orig
+        assert calls and calls[0], "filtered bool did not ride the plan path"
+        svc.close()
+
+    def test_agg_filter_context_cached(self):
+        svc = build_service("numpy")
+        body = {
+            "size": 0,
+            "request_cache": False,  # isolate the FILTER cache
+            "aggs": {
+                "tagged": {
+                    "filter": {"term": {"tag": "t1"}},
+                    "aggs": {"avg_n": {"avg": {"field": "n"}}},
+                }
+            },
+        }
+        r1 = svc.search(body)
+        before = filter_cache.node_stats()
+        r2 = svc.search(body)
+        after = filter_cache.node_stats()
+        assert after["hit_count"] > before["hit_count"]
+        assert r1["aggregations"] == r2["aggregations"]
+        svc.close()
+
+    def test_knn_filter_uses_cache(self):
+        svc = IndexService(
+            "qc-knn",
+            settings={"number_of_shards": 1, "search.backend": "numpy"},
+            mappings_json={
+                "properties": {
+                    "tag": {"type": "keyword"},
+                    "v": {"type": "dense_vector", "dims": 4},
+                }
+            },
+        )
+        rng = np.random.default_rng(5)
+        for i in range(20):
+            svc.index_doc(
+                str(i),
+                {"tag": f"t{i % 2}", "v": [float(x) for x in rng.normal(size=4)]},
+            )
+        svc.refresh()
+        body = {
+            "knn": {
+                "field": "v",
+                "query_vector": [0.1, 0.2, 0.3, 0.4],
+                "k": 5,
+                "num_candidates": 10,
+                "filter": {"term": {"tag": "t1"}},
+            },
+            "size": 5,
+        }
+        r1 = svc.search(body)
+        before = filter_cache.node_stats()
+        r2 = svc.search(body)
+        after = filter_cache.node_stats()
+        assert after["hit_count"] > before["hit_count"]
+        assert hits_of(r1) == hits_of(r2)
+        svc.close()
+
+    def test_equivalent_spellings_share_one_entry(self):
+        q1 = dsl.parse_query({"term": {"tag": "x"}})
+        q2 = dsl.parse_query({"term": {"tag": {"value": "x"}}})
+        assert dsl.canonical_key(q1) == dsl.canonical_key(q2)
+
+    def test_uncacheable_filters_are_rejected(self):
+        for body in (
+            {"match_all": {}},
+            {"script": {"script": "doc['n'] > 1"}},
+            {"multi_match": {"query": "a", "fields": ["title"]}},
+        ):
+            assert not dsl.is_cacheable_filter(dsl.parse_query(body))
+        assert dsl.is_cacheable_filter(dsl.parse_query({"term": {"t": "a"}}))
+        assert dsl.is_cacheable_filter(
+            dsl.parse_query(
+                {"bool": {"filter": [{"range": {"n": {"gte": 2}}}]}}
+            )
+        )
+
+
+class TestInvalidation:
+    @pytest.mark.parametrize("backend", ["numpy", "jax"])
+    def test_refresh_after_update_never_serves_stale(self, backend):
+        svc = build_service(backend)
+        big = {**FILTERED_BODY, "size": 100}
+        warm = svc.search(big)
+        warm_total = warm["hits"]["total"]["value"]
+        assert not any(h[0] == "99" for h in hits_of(warm))
+        # a new doc that passes every filter clause
+        svc.index_doc(
+            "99", {"title": "alpha", "body": "delta", "tag": "t1", "n": 50}
+        )
+        svc.refresh()
+        after = svc.search(big)
+        assert after["hits"]["total"]["value"] == warm_total + 1
+        assert any(h[0] == "99" for h in hits_of(after)), "stale bitset served"
+        # flip it OUT of the filter via update + refresh
+        svc.index_doc(
+            "99", {"title": "alpha", "body": "delta", "tag": "t0", "n": 50}
+        )
+        svc.refresh()
+        svc.search(big)  # warm the new generation
+        final = svc.search(big)
+        assert final["hits"]["total"]["value"] == warm_total
+        assert not any(h[0] == "99" for h in hits_of(final))
+        svc.close()
+
+    @pytest.mark.parametrize("backend", ["numpy", "jax"])
+    def test_delete_then_refresh_invalidates(self, backend):
+        svc = build_service(backend)
+        warm = svc.search(FILTERED_BODY)
+        victim = hits_of(warm)[0][0]
+        svc.delete_doc(victim)
+        svc.refresh()
+        svc.search(FILTERED_BODY)
+        final = svc.search(FILTERED_BODY)
+        assert not any(h[0] == victim for h in hits_of(final))
+        svc.close()
+
+    def test_request_cache_refresh_invalidation(self):
+        svc = build_service("numpy")
+        body = {
+            "size": 0,
+            "query": {"match": {"title": "alpha"}},
+            "aggs": {"avg_n": {"avg": {"field": "n"}}},
+        }
+        r1 = svc.search(body)
+        r2 = svc.search(body)  # cache hit
+        assert r1["aggregations"] == r2["aggregations"]
+        assert request_cache.node_stats()["hit_count"] >= 1
+        svc.index_doc("100", {"title": "alpha", "tag": "t0", "n": 1000})
+        svc.refresh()
+        r3 = svc.search(body)
+        assert r3["hits"]["total"]["value"] == r1["hits"]["total"]["value"] + 1
+        assert r3["aggregations"] != r1["aggregations"]
+        svc.close()
+
+
+class TestLruEviction:
+    def test_eviction_under_tiny_hbm_budget(self, monkeypatch):
+        # a tiny ES_TPU_HBM_BUDGET_BYTES forces LRU eviction instead of
+        # tripping the breaker (degrade-don't-fail); the bitset cache's
+        # own budget is a 10% share of the ledger → 4 KiB here
+        monkeypatch.setenv("ES_TPU_HBM_BUDGET_BYTES", "40960")
+        monkeypatch.setattr(memory, "hbm_ledger", memory.HbmLedger())
+        cache = FilterBitsetCache()
+        ctx = CacheCtx("uuidX[0]", 1, "np")
+        blob = np.ones(1024, np.uint8)  # 1 KiB per entry
+        for i in range(10):
+            cache.put(ctx, 0, f"f{i}", blob, int(blob.nbytes))
+        st = cache.node_stats()
+        assert st["evictions"] > 0
+        assert st["memory_size_in_bytes"] <= 4096
+        assert (
+            memory.hbm_ledger.stats()["by_category"].get("query_cache", 0)
+            <= 4096
+        )
+        # newest entries survive (LRU discipline)
+        assert cache.get(ctx, 0, "f9") is not None
+        assert cache.get(ctx, 0, "f0") is None
+        cache.clear()
+        assert (
+            memory.hbm_ledger.stats()["by_category"].get("query_cache", 0) == 0
+        )
+
+    def test_oversized_entry_degrades_not_trips(self, monkeypatch):
+        monkeypatch.setenv("ES_TPU_HBM_BUDGET_BYTES", "1024")
+        monkeypatch.setattr(memory, "hbm_ledger", memory.HbmLedger())
+        cache = FilterBitsetCache()
+        ctx = CacheCtx("uuidY[0]", 1, "np")
+        blob = np.ones(4096, np.uint8)
+        assert not cache.put(ctx, 0, "big", blob, int(blob.nbytes))
+        assert memory.hbm_ledger.stats_counters["degraded"] == 1
+        assert memory.hbm_ledger.stats_counters["tripped"] == 0
+
+
+class TestRequestCacheControls:
+    def test_request_cache_false_param_disables(self):
+        svc = build_service("numpy")
+        body = {
+            "size": 0,
+            "query": {"match": {"title": "alpha"}},
+            "request_cache": False,
+        }
+        before = request_cache.node_stats()
+        svc.search(body)
+        svc.search(body)
+        after = request_cache.node_stats()
+        assert after["hit_count"] == before["hit_count"]
+        assert after["cache_count"] == before["cache_count"]
+        svc.close()
+
+    def test_index_setting_disables_and_param_overrides(self):
+        svc = build_service(
+            "numpy",
+            name="qc-disabled",
+            settings={"requests.cache.enable": False},
+        )
+        body = {"size": 0, "query": {"match": {"title": "alpha"}}}
+        svc.search(body)
+        svc.search(body)
+        assert request_cache.stats_for_index(svc.uuid)["cache_count"] == 0
+        # explicit ?request_cache=true overrides the index default
+        svc.search({**body, "request_cache": True})
+        svc.search({**body, "request_cache": True})
+        st = request_cache.stats_for_index(svc.uuid)
+        assert st["cache_count"] == 1 and st["hit_count"] == 1
+        svc.close()
+
+    def test_size_gt_0_not_cached(self):
+        svc = build_service("numpy")
+        body = {"size": 3, "query": {"match": {"title": "alpha"}}}
+        svc.search(body)
+        svc.search(body)
+        assert request_cache.stats_for_index(svc.uuid)["cache_count"] == 0
+        svc.close()
+
+    def test_scripted_body_not_cached(self):
+        svc = build_service("numpy")
+        body = {
+            "size": 0,
+            "query": {
+                "script_score": {
+                    "query": {"match_all": {}},
+                    "script": {"source": "doc['n'].value"},
+                }
+            },
+        }
+        svc.search(body)
+        svc.search(body)
+        assert request_cache.stats_for_index(svc.uuid)["cache_count"] == 0
+        svc.close()
+
+
+class TestRestEndpoints:
+    def _cluster(self):
+        from elasticsearch_tpu.cluster.service import ClusterService
+        from elasticsearch_tpu.rest.actions import RestActions
+
+        c = ClusterService()
+        return c, RestActions(c)
+
+    def test_cache_clear_endpoint_and_stats_sections(self):
+        c, actions = self._cluster()
+        try:
+            c.create_index(
+                "logs-000001",
+                {"mappings": MAPPINGS, "settings": {"number_of_shards": 1}},
+            )
+            for i in range(30):
+                c.get_index("logs-000001").index_doc(
+                    str(i), {"title": "alpha", "tag": f"t{i % 3}", "n": i}
+                )
+            c.get_index("logs-000001").refresh()
+            body = {
+                "size": 0,
+                "query": {
+                    "bool": {
+                        "must": [{"match": {"title": "alpha"}}],
+                        "filter": [{"term": {"tag": "t1"}}],
+                    }
+                },
+            }
+            c.search("logs-000001", body)
+            c.search("logs-000001", body)
+            # {index}/_stats carries both cache sections
+            status, resp = actions.index_stats(
+                None, {"index": "logs-000001"}, {}
+            )
+            assert status == 200
+            rc = resp["_all"]["primaries"]["request_cache"]
+            qc = resp["_all"]["primaries"]["query_cache"]
+            assert rc["hit_count"] >= 1 and rc["memory_size_in_bytes"] > 0
+            assert qc["memory_size_in_bytes"] > 0
+            # _nodes/stats carries node totals + per-category breakers
+            _, nresp = actions.nodes_stats(None, {}, {})
+            node = nresp["nodes"]["node-0"]
+            assert node["indices"]["request_cache"]["hit_count"] >= 1
+            assert "query_cache" in node["indices"]
+            assert "hbm" in node["breakers"]
+            assert "degraded_allocations" in node["breakers"]["hbm"]
+            assert any(
+                k.startswith("hbm.") for k in node["breakers"]
+            ), "per-category breaker children missing"
+            # clear drops the entries
+            status, cresp = actions.clear_cache(
+                None, {"index": "logs-000001"}, {}
+            )
+            assert status == 200 and "_shards" in cresp
+            uuid = c.get_index("logs-000001").uuid
+            assert request_cache.stats_for_index(uuid)["cache_count"] == 0
+            assert (
+                filter_cache.stats_for_index(uuid)["memory_size_in_bytes"] == 0
+            )
+        finally:
+            c.close()
+
+    def test_request_cache_qs_param_wiring(self):
+        c, actions = self._cluster()
+        try:
+            c.create_index("qsidx", {"mappings": MAPPINGS})
+            c.get_index("qsidx").index_doc("1", {"title": "alpha"})
+            c.get_index("qsidx").refresh()
+            body = {"size": 0, "query": {"match": {"title": "alpha"}}}
+            actions.search(body, {"index": "qsidx"}, {"request_cache": ["false"]})
+            actions.search(body, {"index": "qsidx"}, {"request_cache": ["false"]})
+            uuid = c.get_index("qsidx").uuid
+            assert request_cache.stats_for_index(uuid)["cache_count"] == 0
+            actions.search(body, {"index": "qsidx"}, {"request_cache": ["true"]})
+            actions.search(body, {"index": "qsidx"}, {"request_cache": ["true"]})
+            assert request_cache.stats_for_index(uuid)["hit_count"] == 1
+        finally:
+            c.close()
+
+    def test_rollover_never_serves_stale(self):
+        c, actions = self._cluster()
+        try:
+            c.create_index("roll-000001", {"mappings": MAPPINGS})
+            c.update_aliases(
+                {
+                    "actions": [
+                        {
+                            "add": {
+                                "index": "roll-000001",
+                                "alias": "roll",
+                                "is_write_index": True,
+                            }
+                        }
+                    ]
+                }
+            )
+            c.get_index("roll-000001").index_doc("1", {"title": "alpha"})
+            c.get_index("roll-000001").refresh()
+            body = {"size": 0, "query": {"match": {"title": "alpha"}}}
+            r1 = c.search("roll", body)
+            assert r1["hits"]["total"]["value"] == 1
+            r1b = c.search("roll", body)  # cached
+            assert r1b["hits"]["total"]["value"] == 1
+            status, _ = actions.rollover(None, {"index": "roll"}, {})
+            assert status == 200
+            # the write index moved; the old index's cached entry must
+            # not leak into the new one
+            idx2, name2 = c.resolve_write_index("roll")
+            idx2.index_doc("2", {"title": "alpha"})
+            idx2.refresh()
+            r2 = c.search(name2, body)
+            assert r2["hits"]["total"]["value"] == 1
+            # deleting the old index clears its cache entries
+            old_uuid = c.get_index("roll-000001").uuid
+            c.delete_index("roll-000001")
+            assert request_cache.stats_for_index(old_uuid)["cache_count"] == 0
+        finally:
+            c.close()
