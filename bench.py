@@ -398,7 +398,8 @@ def build_bodies(body_df, title_df):
 
 
 def run_load(svc, bodies, threads=THREADS):
-    """Concurrent closed-loop load; returns (qps, p50_ms, p99_ms)."""
+    """Concurrent closed-loop load; returns (qps, p50_ms, p99_ms,
+    wall_s)."""
     lat = []
     lat_lock = threading.Lock()
     qi = [0]
@@ -431,7 +432,36 @@ def run_load(svc, bodies, threads=THREADS):
         len(bodies) / wall,
         float(np.percentile(lat_ms, 50)),
         float(np.percentile(lat_ms, 99)),
+        wall,
     )
+
+
+def batch1_p50(svc, bodies, n=32):
+    """Single-inflight latency (bench honesty: pipelining gains must not
+    hide latency regressions behind batching) — p50 over n sequential
+    requests with exactly one in flight."""
+    _, p50, _, _ = run_load(svc, bodies[: max(1, n)], threads=1)
+    return p50
+
+
+def roofline_window(svc, before, wall_s, n_queries):
+    """Per-config MFU/roofline numbers from the batcher's pipeline
+    counters over one measured window: mfu over the WALL clock (the
+    serving-level number — includes every host stall), device_util =
+    fraction of the wall with kernels in flight, flops_per_query =
+    estimated useful flops per request."""
+    from elasticsearch_tpu.common.settings import peak_flops
+
+    after = svc._batcher.pipeline_stats()
+    flops = after["flops"] - before["flops"]
+    busy_s = (after["device_busy_ms"] - before["device_busy_ms"]) / 1000.0
+    return {
+        "mfu": float(f"{flops / (wall_s * peak_flops()):.4e}")
+        if wall_s > 0 else 0.0,
+        "device_util": round(min(busy_s / wall_s, 1.0), 4)
+        if wall_s > 0 else 0.0,
+        "flops_per_query": float(f"{flops / max(1, n_queries):.4e}"),
+    }
 
 
 def recall_gate(svc_jax, svc_oracle, bodies, n=12, k=1000):
@@ -481,6 +511,8 @@ def main():
     gate_n = {"match": 12, "bool": 8, "multi_match": 8, "knn": 8,
               "hybrid_rrf": 6}
 
+    batcher = svc_jax._batcher
+    depth_configured = batcher.pipeline_depth
     for name in ("match", "bool", "multi_match", "knn", "hybrid_rrf"):
         blist = bodies[name]
         log(f"[{name}] warmup/compile…")
@@ -494,10 +526,32 @@ def main():
             with svc_jax._rrf_lock:
                 for key in svc_jax.rrf_stats:
                     svc_jax.rrf_stats[key] = 0
-        qps, p50, p99 = run_load(svc_jax, blist)
+        pipe0 = batcher.pipeline_stats()
+        qps, p50, p99, wall = run_load(svc_jax, blist)
+        roof = roofline_window(svc_jax, pipe0, wall, len(blist))
         rrf_snapshot = dict(svc_jax.rrf_stats) if name == "hybrid_rrf" else None
-        log(f"[{name}] jax: {qps:.1f} QPS, p50={p50:.2f}ms p99={p99:.2f}ms")
-        o_qps, o_p50, _ = run_load(
+        log(f"[{name}] jax: {qps:.1f} QPS, p50={p50:.2f}ms p99={p99:.2f}ms "
+            f"mfu={roof['mfu']:.2e} device_util={roof['device_util']:.3f}")
+        # single-inflight latency: throughput-mode batching must not
+        # hide a latency regression
+        p50_b1 = batch1_p50(svc_jax, blist)
+        log(f"[{name}] single-inflight p50={p50_b1:.2f}ms")
+        # pipelining A/B on the SAME run: depth=1 (the classic
+        # dispatch→collect loop) vs the configured depth
+        depth_block = {}
+        if name in ("match", "knn") and depth_configured > 1:
+            batcher.pipeline_depth = 1
+            d1_qps, d1_p50, _, _ = run_load(svc_jax, blist)
+            batcher.pipeline_depth = depth_configured
+            depth_block = {
+                "qps_depth1": round(d1_qps, 1),
+                "p50_depth1_ms": round(d1_p50, 2),
+                "depth_speedup": round(qps / d1_qps, 3) if d1_qps else None,
+            }
+            log(f"[{name}] depth1: {d1_qps:.1f} QPS p50={d1_p50:.2f}ms "
+                f"→ depth{depth_configured} speedup "
+                f"{depth_block['depth_speedup']}x")
+        o_qps, o_p50, _, _ = run_load(
             svc_np, blist[: oracle_n[name]], threads=ORACLE_THREADS
         )
         log(f"[{name}] cpu oracle: {o_qps:.1f} QPS, p50={o_p50:.2f}ms")
@@ -510,10 +564,13 @@ def main():
             "qps": round(qps, 1),
             "p50_ms": round(p50, 2),
             "p99_ms": round(p99, 2),
+            "p50_batch1_ms": round(p50_b1, 2),
             "cpu_oracle_qps": round(o_qps, 1),
             "vs_oracle": round(qps / o_qps, 2) if o_qps else None,
             "recall": round(recall, 4),
             "max_score_rel_delta": float(f"{max_rel:.3e}"),
+            **roof,
+            **depth_block,
         }
         if name == "hybrid_rrf":
             # hybrid execution breakdown: per-leg wall time measured
@@ -544,7 +601,7 @@ def main():
         {**b, "track_total_hits": False} for b in bodies["match"]
     ]
     svc_jax.search(wand_bodies[0])
-    qps_wand, p50_wand, _ = run_load(svc_jax, wand_bodies)
+    qps_wand, p50_wand, _, _ = run_load(svc_jax, wand_bodies)
     log(f"[match+wand] jax: {qps_wand:.1f} QPS, p50={p50_wand:.2f}ms")
 
     # ---- cache configs: cold vs warm QPS + hit rates ----
@@ -559,14 +616,17 @@ def main():
     # cold: every request carries a UNIQUE filter term — full filter
     # evaluation per request even though bitsets get cached
     filter_cache.clear()
-    cold_qps, cold_p50, _ = run_load(svc_jax, bodies["filtered_bool_cold"])
+    cold_qps, cold_p50, _, _ = run_load(svc_jax, bodies["filtered_bool_cold"])
     # warm: 8 rotating filters — bitsets resolve from the device cache
     filter_cache.clear()
     for b in bodies["filtered_bool"][:8]:
         svc_jax.search(b)  # populate the 8 rotating bitsets
     st0 = filter_cache.node_stats()
-    warm_qps, warm_p50, warm_p99 = run_load(svc_jax, bodies["filtered_bool"])
+    warm_qps, warm_p50, warm_p99, _ = run_load(
+        svc_jax, bodies["filtered_bool"]
+    )
     st1 = filter_cache.node_stats()
+    fb_p50_b1 = batch1_p50(svc_jax, bodies["filtered_bool"])
     hits = st1["hit_count"] - st0["hit_count"]
     misses = st1["miss_count"] - st0["miss_count"]
     fb_hit_rate = hits / max(1, hits + misses)
@@ -579,6 +639,7 @@ def main():
         "warm_qps": round(warm_qps, 1),
         "p50_ms": round(warm_p50, 2),
         "p99_ms": round(warm_p99, 2),
+        "p50_batch1_ms": round(fb_p50_b1, 2),
         "cold_p50_ms": round(cold_p50, 2),
         "query_cache_hit_rate": round(fb_hit_rate, 4),
         "recall": round(fb_recall, 4),
@@ -593,12 +654,15 @@ def main():
     log("[repeated_agg] warmup/compile…")
     svc_jax.search(bodies["repeated_agg"][0])
     request_cache.clear()
-    agg_cold_qps, agg_cold_p50, _ = run_load(svc_jax, bodies["repeated_agg"])
+    agg_cold_qps, agg_cold_p50, _, _ = run_load(
+        svc_jax, bodies["repeated_agg"]
+    )
     st0 = request_cache.node_stats()
-    agg_warm_qps, agg_warm_p50, _ = run_load(
+    agg_warm_qps, agg_warm_p50, _, _ = run_load(
         svc_jax, bodies["repeated_agg"] * 8
     )
     st1 = request_cache.node_stats()
+    agg_p50_b1 = batch1_p50(svc_jax, bodies["repeated_agg"])
     hits = st1["hit_count"] - st0["hit_count"]
     misses = st1["miss_count"] - st0["miss_count"]
     agg_hit_rate = hits / max(1, hits + misses)
@@ -615,6 +679,7 @@ def main():
         "cold_qps": round(agg_cold_qps, 1),
         "warm_qps": round(agg_warm_qps, 1),
         "p50_ms": round(agg_warm_p50, 2),
+        "p50_batch1_ms": round(agg_p50_b1, 2),
         "cold_p50_ms": round(agg_cold_p50, 2),
         "request_cache_hit_rate": round(agg_hit_rate, 4),
         "agg_max_rel_delta": float(f"{agg_max_rel:.3e}"),
@@ -626,8 +691,18 @@ def main():
     )
 
     # single-thread oracle (GIL-free per-core honesty number)
-    o1_qps, _, _ = run_load(svc_np, bodies["match"][:24], threads=1)
+    o1_qps, _, _, _ = run_load(svc_np, bodies["match"][:24], threads=1)
     log(f"[match] cpu oracle single-thread: {o1_qps:.1f} QPS")
+
+    # cumulative serving-pipeline roofline block (the "23× vs oracle"
+    # headline finally gets a denominator: flops, device-busy time,
+    # MFU against ES_TPU_PEAK_FLOPS)
+    pipeline_block = batcher.pipeline_stats()
+    pipeline_block["mfu"] = float(f"{pipeline_block['mfu']:.4e}")
+    log(f"[pipeline] depth={pipeline_block['depth']} "
+        f"device_busy={pipeline_block['device_busy_ms']:.0f}ms "
+        f"host_stall={pipeline_block['host_stall_ms']:.0f}ms "
+        f"mfu={pipeline_block['mfu']:.2e}")
 
     headline = max(configs["match"]["qps"], qps_wand)
     base = configs["match"]["cpu_oracle_qps"]
@@ -650,6 +725,7 @@ def main():
                 "cpu_oracle_qps": base,
                 "cpu_oracle_qps_single_thread": round(o1_qps, 1),
                 "recall_at_1000": configs["match"]["recall"],
+                "pipeline": pipeline_block,
                 "configs": configs,
                 "baseline_kind": (
                     "measured NumPy oracle: dense vectorized scorer (no "

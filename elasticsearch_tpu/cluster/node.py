@@ -380,6 +380,11 @@ class TpuNode:
         # adaptive replica selection: EWMA response seconds per node
         # (ResponseCollectorService) fed by remote_call timings
         self.response_ewma: Dict[str, float] = {}
+        # quorum tracking: a master that loses contact with a majority
+        # of the last-known node set steps down — it keeps serving
+        # reads but refuses metadata mutations until quorum returns
+        # (the Zen2 voting-majority rule, single-phase approximation)
+        self._quorum_lost = False
         self._closed = False
         self._register_handlers()
 
@@ -629,6 +634,14 @@ class TpuNode:
     def _require_master(self):
         if not self.is_master():
             raise NotMasterError(f"[{self.name}] is not the master")
+        if self._quorum_lost:
+            # stepped down: a partitioned master must not accept
+            # metadata mutations its majority side could contradict
+            raise NotMasterError(
+                f"[{self.name}] is master but cannot reach a majority of "
+                "the last-known node set; refusing metadata mutations "
+                "until quorum returns"
+            )
 
     # ---- persisted cluster state (PersistedClusterStateService) ----
 
@@ -682,7 +695,15 @@ class TpuNode:
     def _check_followers(self):
         """Master pings every follower; a stale version gets the current
         state re-sent (lag repair); `fd_retries` consecutive failures
-        remove the node from the cluster."""
+        remove the node from the cluster.
+
+        Quorum bookkeeping (ADVICE r5): the master counts how many of
+        the last-known node set it can still reach. Below a majority it
+        steps down — `_require_master` rejects metadata mutations until
+        contact returns. A ping response advertising a NEWER state
+        version means the other side elected past us while we were
+        partitioned: adopt that state (monotonic apply) instead of
+        running a second divergent master."""
         with self._state_lock:
             nodes = {
                 nid: tuple(info["address"])
@@ -690,22 +711,48 @@ class TpuNode:
                 if nid != self.name
             }
             version = self.state.get("version", 0)
+        reachable = 1  # self
+        newer: Optional[Tuple[str, int]] = None  # (nid, version)
         for nid, addr in nodes.items():
             try:
                 resp = self.transport.send(
                     addr, "internal:fd/ping", {}, timeout=self.fd_interval * 5
                 )
+                reachable += 1
                 self._fd_failures[nid] = 0
-                if resp.get("version", 0) < version:
+                rv = resp.get("version", 0)
+                if rv < version:
                     with self._state_lock:
                         state = self.state
                     self.transport.send(addr, "cluster:state/publish", state)
+                elif rv > version and (newer is None or rv > newer[1]):
+                    newer = (nid, rv)
             except TransportError:
                 n = self._fd_failures.get(nid, 0) + 1
                 self._fd_failures[nid] = n
                 if n >= self.fd_retries:
                     self._fd_failures.pop(nid, None)
                     self._node_left(nid)
+        if newer is not None:
+            # superseded (healed partition): step down by adopting the
+            # majority side's state — monotonic apply handles ordering
+            try:
+                state = self.transport.send(
+                    nodes[newer[0]], "cluster:state/get", {},
+                    timeout=self.fd_interval * 5,
+                )
+                self._apply_state(state)
+            except TransportError:
+                pass
+            if not self.is_master():
+                self._quorum_lost = False
+                return
+        # recompute against the CURRENT node set: _node_left above may
+        # have shrunk it (removing a confirmed-dead node is what brings
+        # quorum back for the survivors)
+        with self._state_lock:
+            total = len(self.state["nodes"])
+        self._quorum_lost = reachable < (total // 2 + 1)
 
     def _check_master(self):
         """Follower pings the master; on sustained failure the lowest
@@ -731,12 +778,41 @@ class TpuNode:
                 self._elect_after_master_loss(master)
 
     def _elect_after_master_loss(self, dead_master: str):
+        """Deterministic takeover, quorum-gated (ADVICE r5): the lowest
+        surviving node id may only self-elect after confirming it can
+        reach a majority of the surviving last-known node set — the
+        minority side of a symmetric partition therefore never elects,
+        so two active masters cannot coexist. The confirmed-dead master
+        (fd_retries consecutive failed pings) is excluded from the
+        candidate set, the same shrink that keeps a 2-node cluster
+        recoverable (ES's auto-shrinking voting configuration)."""
         with self._state_lock:
             if self.state.get("master") != dead_master:
                 return  # someone already took over
             survivors = [n for n in self.state["nodes"] if n != dead_master]
             if not survivors or min(survivors) != self.name:
                 return  # not our job; wait for the new master's publish
+            peers = {
+                nid: tuple(info["address"])
+                for nid, info in self.state["nodes"].items()
+                if nid != self.name and nid != dead_master
+            }
+        # majority probe OUTSIDE the state lock (pings must not block
+        # publish application)
+        reachable = 1  # self
+        for nid, addr in peers.items():
+            try:
+                self.transport.send(
+                    addr, "internal:ping", {}, timeout=self.fd_interval * 5
+                )
+                reachable += 1
+            except TransportError:
+                pass
+        if reachable < (len(survivors) // 2 + 1):
+            return  # minority side of a partition: never self-elect
+        with self._state_lock:
+            if self.state.get("master") != dead_master:
+                return  # lost the race while probing
             new = _copy_state(self.state)
             new["master"] = self.name
             _remove_node_from_state(new, dead_master)

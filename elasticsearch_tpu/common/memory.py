@@ -8,7 +8,14 @@ vectors, norm caches, and dense hot-term rows all charge the ledger at
 upload. When a WOULD-BE upload cannot fit, the allocator either
 degrades (dense hot rows are an optimization — the chunked scorer path
 covers correctness without them) or trips the breaker.
-"""
+
+Categories in use: `postings`/`doc_values`/`vectors`/`norms`/`dense`
+(index-resident uploads), `query_cache` (device filter bitsets, own
+LRU budget), and `serving` — the serving pipeline's persistent padded
+staging slabs (executor_jax.staging_slab: fixed-size rings of reusable
+query-operand buffers, sized to workers × (pipeline_depth + 1), charged
+once at first use and released with the executor). Per-category bytes
+surface as child breakers in `_nodes/stats` (child_breakers())."""
 
 from __future__ import annotations
 

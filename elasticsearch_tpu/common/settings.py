@@ -11,6 +11,7 @@ reject updates, unknown settings are rejected on write (SURVEY.md §5
 
 from __future__ import annotations
 
+import os
 import threading
 from dataclasses import dataclass, field
 from typing import Any, Callable, Dict, List, Optional
@@ -18,6 +19,43 @@ from typing import Any, Callable, Dict, List, Optional
 NODE_SCOPE = "node"
 CLUSTER_SCOPE = "cluster"
 INDEX_SCOPE = "index"
+
+# ---- env-backed node-scope serving knobs (read at process start like
+# ES's jvm.options / system properties; not dynamically updatable) ----
+
+# Batches a dispatcher worker keeps in flight on device before blocking
+# on a collect: 1 reproduces the pre-pipeline dispatch→collect loop
+# bit-for-bit, 2 double-buffers (batch N+1's kernels launch while batch
+# N's hits are built on the host).
+PIPELINE_DEPTH_ENV = "ES_TPU_PIPELINE_DEPTH"
+PIPELINE_DEPTH_DEFAULT = 2
+
+# Peak accelerator FLOP/s used as the MFU/roofline denominator. The
+# default is a v5e's bf16 MXU peak (1.97e14) — a conservative (large)
+# denominator for the fp32 kernels, so reported MFU understates rather
+# than flatters. Override per part.
+PEAK_FLOPS_ENV = "ES_TPU_PEAK_FLOPS"
+PEAK_FLOPS_DEFAULT = 1.97e14
+
+
+def pipeline_depth() -> int:
+    """Dispatcher in-flight ring depth (>= 1)."""
+    raw = os.environ.get(PIPELINE_DEPTH_ENV, "")
+    try:
+        v = int(raw) if raw else PIPELINE_DEPTH_DEFAULT
+    except ValueError:
+        v = PIPELINE_DEPTH_DEFAULT
+    return max(1, v)
+
+
+def peak_flops() -> float:
+    """Accelerator peak FLOP/s for MFU accounting."""
+    raw = os.environ.get(PEAK_FLOPS_ENV, "")
+    try:
+        v = float(raw) if raw else PEAK_FLOPS_DEFAULT
+    except ValueError:
+        v = PEAK_FLOPS_DEFAULT
+    return v if v > 0 else PEAK_FLOPS_DEFAULT
 
 
 class SettingsError(ValueError):

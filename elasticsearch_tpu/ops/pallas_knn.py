@@ -107,11 +107,25 @@ class QuantizedVectors:
         self.qvecs = jnp.asarray(q)
         self.scales = jnp.asarray(scales)
 
+    def flops(self, n_queries: int) -> int:
+        """Estimated useful flops of one search over this corpus, for
+        the serving pipeline's MFU/roofline accounting (same convention
+        as ops/scoring.knn_flops): the 2·B·N·d MXU contraction plus the
+        per-element dequant scale multiply that rides the VPU pass.
+        Padding rows/lanes are excluded — MFU reflects useful work."""
+        return 2 * n_queries * self.n * self.dims + n_queries * self.n
+
     def search(
         self, queries: np.ndarray, k: int, interpret: Optional[bool] = None
     ) -> Tuple[jax.Array, jax.Array]:
         """(scores[B,k], docs[B,k]) with the similarity score transform
-        applied (models/similarity.py mapping, same as the f32 path)."""
+        applied (models/similarity.py mapping, same as the f32 path).
+
+        Zero-sync contract (serving pipeline): the returned pair are
+        DEVICE arrays from an async dispatch — no host transfer happens
+        here, so a batcher collect stage can feed them straight into
+        ops/scoring.knn_merge_segment_topk alongside the f32 segments
+        and pay one packed download for the whole group."""
         if interpret is None:
             interpret = jax.default_backend() != "tpu"
         q = np.asarray(queries, np.float32)

@@ -122,6 +122,33 @@ class BatchedScoreResult(NamedTuple):
 BPAD = 32  # fixed query rows per launch
 TCHUNK = 512  # fixed tiles per row per launch
 
+# ---- FLOP estimates for MFU/roofline accounting -------------------------
+# Useful (non-padding) work per scored element, counted at dispatch time
+# so bench.py / _nodes/stats can put a roofline denominator next to QPS.
+# Per posting slot the BM25 kernel does ~6 flops (tf·inv_norm multiply,
+# 1+x add, divide, w−x subtract, validity select, scatter add); a dense
+# hot-term row does ~4 per doc (no gather/scatter). top_k selection is
+# not counted (comparisons, not flops). These are estimates of USEFUL
+# work — padded rows/slots are excluded, so MFU reflects end-to-end
+# efficiency including padding waste.
+
+FLOPS_PER_POSTING_SLOT = 6
+FLOPS_PER_DENSE_SLOT = 4
+TILE_WIDTH = 128
+
+
+def text_plan_flops(n_tile_slots: int, n_hot_rows: int, n_docs: int) -> int:
+    """Estimated flops of one job's text-scoring plan on one segment."""
+    return (
+        n_tile_slots * TILE_WIDTH * FLOPS_PER_POSTING_SLOT
+        + n_hot_rows * n_docs * FLOPS_PER_DENSE_SLOT
+    )
+
+
+def knn_flops(n_queries: int, n_docs: int, dims: int) -> int:
+    """Flops of the brute-force similarity matmul (2·B·N·d)."""
+    return 2 * n_queries * n_docs * dims
+
 
 @functools.partial(jax.jit, donate_argnums=(3,))
 def _chunk_add(doc_ids, tfs, inv_norm, acc, ti, tw, tv):
@@ -214,14 +241,26 @@ class ChunkedScorer:
         cnt = jnp.zeros((BPAD, self.n_docs + 1), jnp.int32) if with_cnt else None
         return acc, cnt
 
-    def score_into(self, acc, cnt, tile_lists, weight_lists):
+    def score_into(self, acc, cnt, tile_lists, weight_lists, staging=None):
         """Streams per-row tile/weight lists (≤ BPAD rows, any length)
-        through TCHUNK-wide launches into the donated accumulators."""
+        through TCHUNK-wide launches into the donated accumulators.
+
+        `staging` optionally supplies reusable host buffers — a callable
+        (family, shape, dtype) → np.ndarray (the executor's persistent
+        staging slabs) — instead of fresh allocations per chunk. Only the
+        validity plane needs clearing: stale tile ids/weights under
+        tv=False rows contribute exactly zero (and gathers clamp)."""
         t_max = max((len(t) for t in tile_lists), default=0)
         for c0 in range(0, t_max, TCHUNK):
-            ti = np.zeros((BPAD, TCHUNK), np.int32)
-            tw = np.zeros((BPAD, TCHUNK), np.float32)
-            tv = np.zeros((BPAD, TCHUNK), bool)
+            if staging is not None:
+                ti = staging("chunk_ti", (BPAD, TCHUNK), np.int32)
+                tw = staging("chunk_tw", (BPAD, TCHUNK), np.float32)
+                tv = staging("chunk_tv", (BPAD, TCHUNK), np.bool_)
+                tv[:] = False
+            else:
+                ti = np.zeros((BPAD, TCHUNK), np.int32)
+                tw = np.zeros((BPAD, TCHUNK), np.float32)
+                tv = np.zeros((BPAD, TCHUNK), bool)
             for j, (tl, wl) in enumerate(zip(tile_lists, weight_lists)):
                 sl = tl[c0 : c0 + TCHUNK]
                 m = len(sl)
@@ -250,14 +289,20 @@ class ChunkedScorer:
         return np.asarray(theta), np.asarray(accmax)
 
     def finalize(self, acc, cnt, msm: np.ndarray, k: int, live=None):
-        s, d, tot = _finalize(
+        s, d, tot = self.finalize_device(acc, cnt, msm, k, live=live)
+        return np.asarray(s), np.asarray(d), np.asarray(tot)
+
+    def finalize_device(self, acc, cnt, msm: np.ndarray, k: int, live=None):
+        """Like finalize() but the (scores, docs, totals) triple STAYS on
+        device, so the cross-segment merge kernel can consume it with no
+        per-segment host sync."""
+        return _finalize(
             acc,
             cnt,
             live if live is not None else self.live,
             jnp.asarray(msm, jnp.int32),
             k=min(k, self.n_docs),
         )
-        return np.asarray(s), np.asarray(d), np.asarray(tot)
 
 
 def _score_tiles_inner(doc_rows, tf_rows, tile_weights, tile_valid, inv_norm, n_docs):
@@ -359,13 +404,22 @@ class FusedScorer:
         self.t_rare = t_rare
         self.n_hot_slots = n_hot_slots
 
-    def pack_plans(self, plans) -> np.ndarray:
+    @property
+    def plan_shape(self):
+        return (BPAD, 2 * self.t_rare + 2 * self.n_hot_slots + 1)
+
+    def pack_plans(self, plans, out=None) -> np.ndarray:
         """plans: per job (rare_tiles i64[], rare_w f32[], hot_ranks
         i64[], hot_w f32[], msm int). Jobs beyond BPAD are an error;
-        overflowing a slot budget must be handled by the caller."""
+        overflowing a slot budget must be handled by the caller. `out`
+        optionally reuses a persistent staging slab (fully rewritten:
+        every region is reset before the per-job fills)."""
         T, H = self.t_rare, self.n_hot_slots
-        out = np.full((BPAD, 2 * T + 2 * H + 1), -1, np.int32)
+        if out is None:
+            out = np.empty(self.plan_shape, np.int32)
+        out[:, :T] = -1
         out[:, T : 2 * T] = 0
+        out[:, 2 * T : 2 * T + H] = -1
         out[:, 2 * T + H :] = 0
         fout = out.view(np.float32)
         for j, (rt, rw, hr, hw, msm) in enumerate(plans):
@@ -377,16 +431,24 @@ class FusedScorer:
             out[j, 2 * T + 2 * H] = msm
         return out
 
-    def search_async(self, plans, k: int, with_cnt: bool, live=None):
+    def search_async(self, plans, k: int, with_cnt: bool, live=None,
+                     staging=None):
         """Launches the fused kernel WITHOUT waiting for the result:
         returns (device_out, k) for decode_result(). Device dispatch is
         async in jax, so a caller can launch several groups (e.g. the
         BM25 and kNN legs of a hybrid search) back-to-back and only
         block when it collects. `live` optionally overrides the
         constructor's live-docs mask — cached filter bitsets mask the
-        kernel through this operand (traced arg: no recompile)."""
+        kernel through this operand (traced arg: no recompile).
+        `staging` optionally supplies the reusable plan-upload buffer
+        (a (family, shape, dtype) → np.ndarray callable)."""
         k = min(k, self.n_docs)
-        packed = self.pack_plans(plans)
+        buf = (
+            staging("fused_plan", self.plan_shape, np.int32)
+            if staging is not None
+            else None
+        )
+        packed = self.pack_plans(plans, out=buf)
         out = _fused_query(
             self.doc_ids,
             self.tfs,
@@ -411,6 +473,16 @@ class FusedScorer:
         docs = out[:, k : 2 * k]
         totals = out[:, 2 * k].astype(np.int64)
         return scores, docs, totals
+
+    @staticmethod
+    def device_result(pending):
+        """Unpacks a pending launch WITHOUT leaving the device: returns
+        (scores f32[B,k], docs i32[B,k], totals i32[B]) as device arrays
+        for the cross-segment merge kernel (merge_segment_topk) — no
+        host transfer happens here."""
+        out, k = pending
+        scores = jax.lax.bitcast_convert_type(out[:, :k], jnp.float32)
+        return scores, out[:, k : 2 * k], out[:, 2 * k]
 
     def search(self, plans, k: int, with_cnt: bool, live=None):
         """One device round trip for up to BPAD jobs. Returns
@@ -522,14 +594,22 @@ class MultiFusedScorer:
         self.t_rare = t_rare
         self.n_hot_slots = n_hot_slots
 
-    def pack_plans(self, plans) -> np.ndarray:
+    @property
+    def plan_shape(self):
+        sec = 2 * self.t_rare + 2 * self.n_hot_slots
+        return (BPAD, len(self.fields) * sec + 1)
+
+    def pack_plans(self, plans, out=None) -> np.ndarray:
         """plans: per job, a list of F per-field tuples
         (rare_tiles i64[], rare_w_signed f32[], hot_ranks i64[],
-        hot_w_signed f32[]) plus a trailing msm int."""
+        hot_w_signed f32[]) plus a trailing msm int. `out` optionally
+        reuses a persistent staging slab (fully rewritten)."""
         T, H = self.t_rare, self.n_hot_slots
         F = len(self.fields)
         sec = 2 * T + 2 * H
-        out = np.full((BPAD, F * sec + 1), -1, np.int32)
+        if out is None:
+            out = np.empty(self.plan_shape, np.int32)
+        out[:] = -1
         for f in range(F):
             base = f * sec
             out[:, base + T: base + 2 * T] = 0
@@ -548,12 +628,18 @@ class MultiFusedScorer:
         return out
 
     def search_async(self, plans, k: int, combine: str, tie: float,
-                     live=None):
+                     live=None, staging=None):
         """Async launch (see FusedScorer.search_async): returns
         (device_out, k) for decode_result(). `live` optionally overrides
-        the live-docs mask (cached filter bitsets ride here)."""
+        the live-docs mask (cached filter bitsets ride here); `staging`
+        optionally supplies the reusable plan-upload buffer."""
         k = min(k, self.n_docs)
-        packed = self.pack_plans(plans)
+        buf = (
+            staging("fused_plan_mf", self.plan_shape, np.int32)
+            if staging is not None
+            else None
+        )
+        packed = self.pack_plans(plans, out=buf)
         out = _fused_query_mf(
             tuple(p["doc_ids"] for p in self.parts),
             tuple(p["tfs"] for p in self.parts),
@@ -570,6 +656,7 @@ class MultiFusedScorer:
         return out, k
 
     decode_result = staticmethod(FusedScorer.decode_result)
+    device_result = staticmethod(FusedScorer.device_result)
 
     def search(self, plans, k: int, combine: str, tie: float, live=None):
         return self.decode_result(
@@ -666,6 +753,129 @@ def _fused_query_mf(
         ],
         axis=1,
     )
+
+
+# ---------------------------------------------------------------------------
+# Device-side cross-segment top-k merge — the round-6 zero-sync collect.
+#
+# Before this, every segment's (scores, docs, totals) came back to the
+# host separately (one device→host sync per segment) and merged in
+# Python. Here the per-segment candidate buffers STAY on device and one
+# padded top-k kernel selects the group-wide winners, so a whole batch
+# group costs exactly ONE packed download regardless of segment count —
+# the GPUSparse lesson (keep scoring AND merging accelerator-resident).
+#
+# Ordering parity with the host merge (score desc, (segment, doc) asc):
+# slots are concatenated (segment asc, per-segment rank asc) and
+# lax.top_k keeps the LOWEST slot among equal scores; per-segment ranks
+# already break equal scores doc-asc, so the merged order is identical
+# to the host sort — selection only, scores untouched → float-exact.
+# ---------------------------------------------------------------------------
+
+
+@functools.partial(jax.jit, static_argnames=("k",))
+def _merge_segments(s_list, d_list, t_list, seg_of_slot, k):
+    scores = jnp.concatenate(s_list, axis=1)  # [B, total_slots]
+    docs = jnp.concatenate(d_list, axis=1)
+    s, idx = jax.lax.top_k(scores, k)
+    seg = seg_of_slot[idx]
+    doc = jnp.take_along_axis(docs, idx, axis=1)
+    totals = jnp.stack([t.astype(jnp.int32) for t in t_list], axis=1)
+    return jnp.concatenate(
+        [jax.lax.bitcast_convert_type(s, jnp.int32), seg, doc, totals],
+        axis=1,
+    )
+
+
+def merge_segment_topk(items, k: int):
+    """items: [(si, scores f32[B,ki], docs i32[B,ki], totals i32[B])]
+    device triples in ascending segment order. Returns host arrays
+    (scores f32[B,k], segments i32[B,k], docs i32[B,k], totals
+    i64[B, n_segments]) via ONE top-k kernel and ONE device→host
+    transfer. Rows are ordered score desc / (segment, doc) asc; -inf
+    entries pad past the real candidates."""
+    widths = [int(s.shape[1]) for _, s, _, _ in items]
+    k = min(k, sum(widths))
+    seg_of_slot = jnp.asarray(
+        np.repeat(
+            np.asarray([si for si, *_ in items], np.int32), widths
+        )
+    )
+    out = np.asarray(
+        _merge_segments(
+            tuple(s for _, s, _, _ in items),
+            tuple(d for _, _, d, _ in items),
+            tuple(t for _, _, _, t in items),
+            seg_of_slot,
+            k=k,
+        )
+    )
+    scores = out[:, :k].copy().view(np.float32)
+    segs = out[:, k : 2 * k]
+    docs = out[:, 2 * k : 3 * k]
+    totals = out[:, 3 * k :].astype(np.int64)
+    return scores, segs, docs, totals
+
+
+@functools.partial(jax.jit, static_argnames=("k",))
+def _knn_merge_segments(s_list, d_list, seg_of_slot, nc_cat, k):
+    scores = jnp.concatenate(s_list, axis=1)  # [B, total_slots]
+    docs = jnp.concatenate(d_list, axis=1)
+    # per-(job, segment) num_candidates rank cut, applied on device: a
+    # slot survives when its within-segment rank is below the job's
+    # candidate budget for that segment AND it scored a real candidate
+    valid = jnp.isfinite(scores) & nc_cat
+    masked = jnp.where(valid, scores, -jnp.inf)
+    s, idx = jax.lax.top_k(masked, k)
+    seg = seg_of_slot[idx]
+    doc = jnp.take_along_axis(docs, idx, axis=1)
+    counts = valid.sum(axis=1, dtype=jnp.int32)
+    return jnp.concatenate(
+        [
+            jax.lax.bitcast_convert_type(s, jnp.int32),
+            seg,
+            doc,
+            counts[:, None],
+        ],
+        axis=1,
+    )
+
+
+def knn_merge_segment_topk(items, nc_rows: np.ndarray, k: int):
+    """kNN variant of merge_segment_topk. items: [(si, scores f32[B,ki],
+    docs i32[B,ki])] device pairs (segment asc); nc_rows: host int32
+    [B, n_segments] per-(job, segment) num_candidates cut (the
+    coordinator's per-segment candidate budget). Returns (scores,
+    segments, docs, counts i64[B]) — counts is the number of surviving
+    candidates across segments (before the final k cut), in ONE
+    device→host transfer."""
+    widths = [int(s.shape[1]) for _, s, _ in items]
+    k = min(k, sum(widths))
+    seg_of_slot = jnp.asarray(
+        np.repeat(np.asarray([si for si, *_ in items], np.int32), widths)
+    )
+    rank_of_slot = np.concatenate(
+        [np.arange(w, dtype=np.int32) for w in widths]
+    )
+    # bool [B, total_slots]: slot rank < that (job, segment)'s budget
+    nc_cat = jnp.asarray(
+        rank_of_slot[None, :]
+        < np.repeat(nc_rows.astype(np.int32), widths, axis=1)
+    )
+    out = np.asarray(
+        _knn_merge_segments(
+            tuple(s for _, s, _ in items),
+            tuple(d for _, _, d in items),
+            seg_of_slot,
+            nc_cat,
+            k=k,
+        )
+    )
+    scores = out[:, :k].copy().view(np.float32)
+    segs = out[:, k : 2 * k]
+    docs = out[:, 2 * k : 3 * k]
+    counts = out[:, 3 * k].astype(np.int64)
+    return scores, segs, docs, counts
 
 
 # ---------------- kNN ----------------

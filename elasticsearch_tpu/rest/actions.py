@@ -524,6 +524,13 @@ class RestActions:
             "jobs": 0, "launches": 0, "rejected": 0, "fused_jobs": 0,
             "pruned_jobs": 0, "fused_overflow_jobs": 0,
         }
+        # serving-pipeline roofline counters (QueryBatcher.pipeline_stats):
+        # depth/in_flight of the dispatch ring, device-busy and host-stall
+        # wall time, estimated useful flops, and MFU over busy time
+        pipeline = {
+            "depth": 0, "in_flight": 0, "device_busy_ms": 0.0,
+            "host_stall_ms": 0.0, "flops": 0, "mfu": 0.0,
+        }
         queue_capacity = 0
         for idx in self.cluster.indices.values():
             b = getattr(idx, "_batcher", None)
@@ -531,6 +538,24 @@ class RestActions:
                 for k in batch:
                     batch[k] += b.stats.get(k, 0)
                 queue_capacity = max(queue_capacity, b._queue.maxsize)
+                ps = b.pipeline_stats()
+                pipeline["depth"] = max(pipeline["depth"], ps["depth"])
+                pipeline["in_flight"] += ps["in_flight"]
+                pipeline["device_busy_ms"] += ps["device_busy_ms"]
+                pipeline["host_stall_ms"] += ps["host_stall_ms"]
+                pipeline["flops"] += ps["flops"]
+        if pipeline["depth"] == 0:
+            from ..common.settings import pipeline_depth
+
+            pipeline["depth"] = pipeline_depth()
+        if pipeline["device_busy_ms"] > 0:
+            from ..common.settings import peak_flops
+
+            pipeline["mfu"] = pipeline["flops"] / (
+                (pipeline["device_busy_ms"] / 1000.0) * peak_flops()
+            )
+        pipeline["device_busy_ms"] = round(pipeline["device_busy_ms"], 3)
+        pipeline["host_stall_ms"] = round(pipeline["host_stall_ms"], 3)
         if queue_capacity == 0:
             from ..search.batcher import QUEUE_CAPACITY
 
@@ -573,6 +598,7 @@ class RestActions:
                         },
                         **category_breakers,
                     },
+                    "pipeline": pipeline,
                     "thread_pool": {
                         "search": {
                             "queue_capacity": queue_capacity,

@@ -26,8 +26,11 @@ from __future__ import annotations
 
 import queue
 import threading
+import time
+import weakref
+from collections import deque
 from dataclasses import dataclass
-from typing import Dict, List, Optional, Tuple, Union
+from typing import Deque, Dict, List, Optional, Tuple, Union
 
 import numpy as np
 
@@ -38,6 +41,10 @@ from . import dsl
 from .executor import Hit, TopDocs
 
 MAX_BATCH = BPAD
+
+# every live QueryBatcher (tier-1 leak fixture: a CLOSED batcher must
+# leave no worker threads behind)
+live_batchers: "weakref.WeakSet[QueryBatcher]" = weakref.WeakSet()
 
 # bounded dispatcher queue: ES's search threadpool has a bounded queue
 # (default 1000) and rejects overflow with EsRejectedExecutionException
@@ -380,6 +387,17 @@ class _Job:
         return self.event.is_set()
 
 
+class _BatchCtx:
+    """One dispatched batch in the worker's in-flight ring: the jobs it
+    carries plus the async serve/knn groups awaiting collect."""
+
+    __slots__ = ("batch", "pending")
+
+    def __init__(self, batch: List[_Job]):
+        self.batch = batch
+        self.pending: List[Tuple] = []  # (key, jobs, fam, pend)
+
+
 WORKERS = 6  # parallel dispatcher pipelines (the device tunnel overlaps
 # concurrent round trips — see ops/scoring.py module comment)
 
@@ -402,13 +420,35 @@ class QueryBatcher:
         max_batch: int = MAX_BATCH,
         workers: int = WORKERS,
         queue_capacity: int = QUEUE_CAPACITY,
+        pipeline_depth: Optional[int] = None,
     ):
+        from ..common.settings import pipeline_depth as _default_depth
+
         self.max_batch = min(max_batch, BPAD)
         self.workers = workers
+        # in-flight ring bound per worker (ES_TPU_PIPELINE_DEPTH):
+        # depth=1 is the classic dispatch→collect loop; depth=2 double-
+        # buffers so batch N+1's kernels launch while batch N's hits are
+        # built on the host. Mutable at runtime (bench A/B runs).
+        self.pipeline_depth = (
+            max(1, int(pipeline_depth))
+            if pipeline_depth is not None
+            else _default_depth()
+        )
         self._queue: "queue.Queue[_Job]" = queue.Queue(maxsize=queue_capacity)
         self._threads: List[threading.Thread] = []
         self._closed = False
         self._lock = threading.Lock()
+        # MFU/roofline accounting (guarded by self._lock): estimated
+        # useful flops dispatched, wall time with >= 1 batch in flight
+        # on device (union of dispatch→collect intervals), and time
+        # workers spent blocked on device→host downloads
+        self._flops = 0
+        self._ring_inflight = 0
+        self._busy_t0 = 0.0
+        self._device_busy_s = 0.0
+        self._host_stall_s = 0.0
+        live_batchers.add(self)
         # observability: how many launches / jobs / batched jobs
         self.stats = {
             "launches": 0,
@@ -444,10 +484,15 @@ class QueryBatcher:
 
     def close(self):
         self._closed = True
-        for _ in self._threads:
-            self._queue.put(None)  # wake the workers
-        # fail anything still queued so no submitter blocks forever
+        # fail anything still queued so no submitter blocks forever —
+        # BEFORE posting wake sentinels, so the drain cannot eat them
+        # and leave a worker blocked in queue.get() forever
         self._drain_queue(RuntimeError("query batcher closed"))
+        for _ in self._threads:
+            try:
+                self._queue.put_nowait(None)  # wake blocked workers
+            except queue.Full:  # pragma: no cover - submitters raced
+                break
 
     def _drain_queue(self, err: BaseException):
         while True:
@@ -506,12 +551,29 @@ class QueryBatcher:
             raise job.error
         return job.result
 
-    # ---- worker side ----
+    # ---- worker side (pipelined: dispatch ring + deferred collect) ----
 
     def _run(self):
+        # bounded in-flight ring: each entry is a dispatched batch whose
+        # serve/knn device results have not been collected yet. With
+        # pipeline_depth=1 this is exactly the classic loop (dispatch,
+        # then immediately collect); with depth=2 the worker dispatches
+        # batch N+1 while batch N's kernels are still on device and
+        # collects N afterwards, so the device never waits for the
+        # host-side hit building of the previous batch.
+        inflight: Deque[_BatchCtx] = deque()
         try:
             while not self._closed:
-                job = self._queue.get()
+                if inflight:
+                    # never block on the queue while batches are in
+                    # flight: their waiters come first when idle
+                    try:
+                        job = self._queue.get_nowait()
+                    except queue.Empty:
+                        self._collect_batch(inflight.popleft())
+                        continue
+                else:
+                    job = self._queue.get()
                 if job is None:
                     continue
                 if self._closed:
@@ -527,102 +589,182 @@ class QueryBatcher:
                         break
                     if j is not None:
                         batch.append(j)
+                inflight.append(self._dispatch_batch(batch))
+                while len(inflight) >= max(1, self.pipeline_depth):
+                    self._collect_batch(inflight.popleft())
+        finally:
+            # the dispatcher thread is exiting (close() or a crash
+            # outside the per-group guard): nobody may block forever —
+            # in-flight batches fail their waiters instead of hanging
+            err = RuntimeError("query batcher closed")
+            while inflight:
+                ctx = inflight.popleft()
+                for _, jobs, fam, _ in ctx.pending:
+                    self._exit_kind(fam)
+                for j in ctx.batch:
+                    if not j.event.is_set():
+                        j.error = err
+                        j.event.set()
+                self._ring_exit()
+            self._drain_queue(RuntimeError("query batcher worker exited"))
+            if self._closed:
+                # the drain above may have eaten peers' wake sentinels:
+                # cascade one forward so every blocked worker exits
                 try:
-                    with self._lock:
-                        self.stats["jobs"] += len(batch)
-                        self.stats["max_batch_seen"] = max(
-                            self.stats["max_batch_seen"], len(batch)
-                        )
-                    # group jobs that can share launches (same reader
-                    # generation, plan family, and top-k compile bucket)
-                    groups: Dict[Tuple, List[_Job]] = {}
-                    for j in batch:
-                        kb = 16 if j.k <= 16 else scoring.next_bucket(j.k, 16)
-                        if j.kind == "match":
-                            key = (id(j.executor), "m", j.plan.field, kb)
-                        elif j.kind == "serve":
-                            key = (
-                                id(j.executor), "s", j.plan.fields,
-                                j.plan.combine, j.plan.tie, kb,
-                            )
-                        else:  # knn
-                            key = (id(j.executor), "k", j.plan.field, kb)
-                        groups.setdefault(key, []).append(j)
-                    # two-phase execution: DISPATCH every serve/knn
-                    # group's device work first (async in jax), then
-                    # collect — a batch holding both hybrid legs puts
-                    # the BM25 and kNN kernels on device back-to-back
-                    # with no host sync in between. Match groups keep
-                    # the fused dispatch+collect shape (their pruning
-                    # rounds are host-dependent), so they run AFTER the
-                    # async dispatches: their host syncs then overlap
-                    # the in-flight serve/knn kernels instead of
-                    # stalling them.
-                    pending: List[Tuple] = []
-                    ordered = sorted(
-                        groups.items(), key=lambda kv: kv[0][1] == "m"
+                    self._queue.put_nowait(None)
+                except queue.Full:  # pragma: no cover
+                    pass
+
+    def _dispatch_batch(self, batch: List[_Job]) -> "_BatchCtx":
+        """Groups a batch and launches all its device work. serve/knn
+        groups dispatch asynchronously (collected later by
+        _collect_batch); match groups run dispatch+collect fused (their
+        pruning rounds are host-dependent) AFTER the async dispatches,
+        so their host syncs overlap the in-flight serve/knn kernels
+        instead of stalling them. Never raises: failures surface to the
+        affected jobs' waiters."""
+        ctx = _BatchCtx(batch)
+        self._ring_enter()
+        try:
+            with self._lock:
+                self.stats["jobs"] += len(batch)
+                self.stats["max_batch_seen"] = max(
+                    self.stats["max_batch_seen"], len(batch)
+                )
+            # group jobs that can share launches (same reader
+            # generation, plan family, and top-k compile bucket)
+            groups: Dict[Tuple, List[_Job]] = {}
+            for j in batch:
+                kb = 16 if j.k <= 16 else scoring.next_bucket(j.k, 16)
+                if j.kind == "match":
+                    key = (id(j.executor), "m", j.plan.field, kb)
+                elif j.kind == "serve":
+                    key = (
+                        id(j.executor), "s", j.plan.fields,
+                        j.plan.combine, j.plan.tie, kb,
                     )
-                    for key, jobs in ordered:
-                        kind, kb = key[1], key[-1]
-                        fam = "knn" if kind == "k" else "text"
-                        self._enter_kind(fam)
-                        dispatched = False
-                        try:
-                            if kind == "m":
-                                self._run_group(jobs, key[2], kb)
-                            elif kind == "s":
-                                pending.append(
-                                    (key, jobs, fam,
-                                     self._dispatch_serve_group(jobs, kb))
-                                )
-                                dispatched = True
-                            else:
-                                pending.append(
-                                    (key, jobs, fam,
-                                     self._dispatch_knn_group(jobs))
-                                )
-                                dispatched = True
-                        except BaseException as e:  # surface to waiters
-                            for j in jobs:
-                                if not j.event.is_set():
-                                    j.error = e
-                                    j.event.set()
-                        finally:
-                            if not dispatched:
-                                self._exit_kind(fam)
-                    for key, jobs, fam, pend in pending:
-                        try:
-                            if key[1] == "s":
-                                self._collect_serve_group(
-                                    jobs, key[-1], pend
-                                )
-                            else:
-                                self._collect_knn_group(jobs, pend)
-                        except BaseException as e:
-                            for j in jobs:
-                                if not j.event.is_set():
-                                    j.error = e
-                                    j.event.set()
-                        finally:
-                            self._exit_kind(fam)
-                except BaseException as e:
-                    # stats/grouping crash between dequeue and the
-                    # per-group guard: already-dequeued jobs are not in
-                    # the queue, so the finally-drain can't reach them —
-                    # fail them here so no submitter blocks forever
-                    for j in batch:
+                else:  # knn
+                    key = (id(j.executor), "k", j.plan.field, kb)
+                groups.setdefault(key, []).append(j)
+            ordered = sorted(
+                groups.items(), key=lambda kv: kv[0][1] == "m"
+            )
+            for key, jobs in ordered:
+                kind, kb = key[1], key[-1]
+                fam = "knn" if kind == "k" else "text"
+                self._enter_kind(fam)
+                dispatched = False
+                try:
+                    if kind == "m":
+                        self._run_group(jobs, key[2], kb)
+                    elif kind == "s":
+                        ctx.pending.append(
+                            (key, jobs, fam,
+                             self._dispatch_serve_group(jobs, kb))
+                        )
+                        dispatched = True
+                    else:
+                        ctx.pending.append(
+                            (key, jobs, fam,
+                             self._dispatch_knn_group(jobs))
+                        )
+                        dispatched = True
+                except BaseException as e:  # surface to waiters
+                    for j in jobs:
                         if not j.event.is_set():
                             j.error = e
                             j.event.set()
+                finally:
+                    if not dispatched:
+                        self._exit_kind(fam)
+        except BaseException as e:
+            # stats/grouping crash between dequeue and the per-group
+            # guard: already-dequeued jobs are not in the queue, so the
+            # finally-drain can't reach them — fail them here so no
+            # submitter blocks forever (already-dispatched groups still
+            # collect normally)
+            for j in batch:
+                if not j.event.is_set():
+                    j.error = e
+                    j.event.set()
+        return ctx
+
+    def _collect_batch(self, ctx: "_BatchCtx"):
+        """Host side of one dispatched batch: transfer the merged device
+        results and finish the waiters. Never raises."""
+        try:
+            for key, jobs, fam, pend in ctx.pending:
+                try:
+                    if key[1] == "s":
+                        self._collect_serve_group(jobs, key[-1], pend)
+                    else:
+                        self._collect_knn_group(jobs, pend)
+                except BaseException as e:
+                    for j in jobs:
+                        if not j.event.is_set():
+                            j.error = e
+                            j.event.set()
+                finally:
+                    self._exit_kind(fam)
         finally:
-            # the dispatcher thread is exiting (close() or a crash
-            # outside the per-group guard): nobody may block forever
-            self._drain_queue(RuntimeError("query batcher worker exited"))
+            ctx.pending = []
+            self._ring_exit()
+
+    # ---- pipeline accounting (MFU/roofline) ----
+
+    def _ring_enter(self):
+        with self._lock:
+            self._ring_inflight += 1
+            if self._ring_inflight == 1:
+                self._busy_t0 = time.perf_counter()
+
+    def _ring_exit(self):
+        with self._lock:
+            self._ring_inflight -= 1
+            if self._ring_inflight == 0:
+                self._device_busy_s += time.perf_counter() - self._busy_t0
+
+    def _add_flops(self, n: int):
+        with self._lock:
+            self._flops += int(n)
+
+    def _add_stall(self, seconds: float):
+        with self._lock:
+            self._host_stall_s += seconds
+
+    def pipeline_stats(self) -> dict:
+        """Snapshot of the serving-pipeline roofline counters.
+
+        device_busy_ms approximates accelerator-occupied wall time as
+        the union of dispatch→collect intervals across workers (an
+        upper bound: host work inside a match group's pruning round is
+        included). mfu = estimated useful flops / (device_busy ·
+        ES_TPU_PEAK_FLOPS) — flop formulas in ops/scoring.py."""
+        from ..common.settings import peak_flops
+
+        with self._lock:
+            busy = self._device_busy_s
+            if self._ring_inflight > 0:
+                busy += time.perf_counter() - self._busy_t0
+            flops = self._flops
+            stall = self._host_stall_s
+            inflight = self._ring_inflight
+        return {
+            "depth": self.pipeline_depth,
+            "in_flight": inflight,
+            "device_busy_ms": round(busy * 1000.0, 3),
+            "host_stall_ms": round(stall * 1000.0, 3),
+            "flops": int(flops),
+            "mfu": (
+                flops / (busy * peak_flops()) if busy > 0 else 0.0
+            ),
+        }
 
     def _run_group(self, jobs: List[_Job], field: str, kb: int):
         ex = jobs[0].executor
         reader = ex.reader
         nj = len(jobs)
+        staging = getattr(ex, "staging_slab", None)
         # shard-level pruning eligibility: a capped total may only be
         # shortcut to (cap, gte) when ≥ cap live matches are guaranteed
         # up front (doc_freq of some term minus deleted docs)
@@ -636,12 +778,14 @@ class QueryBatcher:
                 ok = max_df - ex.deleted_count >= j.plan.tth_cap
             prune.append(ok)
         with_cnt = any(j.plan.msm > 1 for j in jobs)
-        per_job_cands: List[List[Tuple[float, int, int]]] = [[] for _ in jobs]
-        totals = np.zeros(nj, np.int64)
+        # per-segment candidate buffers STAY on device; one merge kernel
+        # + one packed download replaces the per-segment host syncs
+        dev_items: List[Tuple] = []  # (si, s_dev, d_dev, tot_dev)
         pruned_flags = [False] * nj
         empty_i = np.empty(0, np.int64)
         empty_w = np.empty(0, np.float32)
         for si in range(len(reader.segments)):
+            n_docs = reader.segments[si].num_docs
             # ---- fused single-round-trip path (large segments) ----
             fs = ex.fused_scorer(si, field)
             if fs is not None:
@@ -652,11 +796,17 @@ class QueryBatcher:
                     for j in jobs
                 ]
                 if all(p is not None for p in fplans):
-                    s, d, tot = fs.search(fplans, kb, with_cnt)
+                    pend = fs.search_async(
+                        fplans, kb, with_cnt, staging=staging
+                    )
                     with self._lock:
                         self.stats["launches"] += 1
                         self.stats["fused_jobs"] += nj
-                    self._collect(jobs, per_job_cands, totals, si, s, d, tot)
+                    self._add_flops(sum(
+                        scoring.text_plan_flops(len(p[0]), len(p[2]), n_docs)
+                        for p in fplans
+                    ))
+                    dev_items.append((si, *fs.device_result(pend)))
                     continue
                 with self._lock:
                     self.stats["fused_overflow_jobs"] += sum(
@@ -698,12 +848,18 @@ class QueryBatcher:
                 a_tiles.append(np.concatenate(tl) if tl else empty_i)
                 a_w.append(np.concatenate(wl) if wl else empty_w)
                 deferred.append(hots)
-            acc, cnt = cs.score_into(acc, cnt, a_tiles, a_w)
+            acc, cnt = cs.score_into(acc, cnt, a_tiles, a_w, staging=staging)
             with self._lock:
                 self.stats["launches"] += 1
+            self._add_flops(scoring.text_plan_flops(
+                sum(len(t) for t in a_tiles), 0, 0
+            ))
             if any(deferred):
-                # ---- the threshold broadcast + survival test ----
+                # ---- the threshold broadcast + survival test (the one
+                # host-dependent round: only runs when pruning engages) ----
+                t0 = time.perf_counter()
                 theta, accmax = cs.threshold(acc, kb)
+                self._add_stall(time.perf_counter() - t0)
                 b_tiles: List[np.ndarray] = []
                 b_w: List[np.ndarray] = []
                 for ji, hots in enumerate(deferred):
@@ -724,28 +880,46 @@ class QueryBatcher:
                                 )
                     b_tiles.append(np.concatenate(tl) if tl else empty_i)
                     b_w.append(np.concatenate(wl) if wl else empty_w)
-                acc, cnt = cs.score_into(acc, cnt, b_tiles, b_w)
+                acc, cnt = cs.score_into(
+                    acc, cnt, b_tiles, b_w, staging=staging
+                )
                 with self._lock:
                     self.stats["launches"] += 1
+                self._add_flops(scoring.text_plan_flops(
+                    sum(len(t) for t in b_tiles), 0, 0
+                ))
             msm = np.ones(BPAD, np.int32)
             msm[:nj] = [j.plan.msm for j in jobs]
-            s, d, tot = cs.finalize(acc, cnt, msm, kb)
-            self._collect(jobs, per_job_cands, totals, si, s, d, tot)
-        # merge across segments per job: score desc, (segment, doc) asc
+            dev_items.append(
+                (si, *cs.finalize_device(acc, cnt, msm, kb))
+            )
+        # device-side cross-segment merge: ONE top-k kernel + ONE packed
+        # download per group (score desc, (segment, doc) asc — identical
+        # ordering to the old host sort, selection only → float-exact)
+        if dev_items:
+            t0 = time.perf_counter()
+            ms, mseg, mdoc, mtot = scoring.merge_segment_topk(dev_items, kb)
+            self._add_stall(time.perf_counter() - t0)
+        else:
+            ms = np.full((nj, 0), -np.inf, np.float32)
+            mseg = mdoc = np.zeros((nj, 0), np.int32)
+            mtot = np.zeros((nj, 0), np.int64)
         for ji, j in enumerate(jobs):
-            cands = per_job_cands[ji]
-            cands.sort(key=lambda c: (-c[0], c[1], c[2]))
-            page = cands[: j.k]
+            finite = np.isfinite(ms[ji])
             hits = [
                 Hit(
-                    score=s,
-                    segment=si,
-                    local_doc=d,
-                    doc_id=reader.segments[si].doc_ids[d],
+                    score=float(s),
+                    segment=int(si),
+                    local_doc=int(d),
+                    doc_id=reader.segments[int(si)].doc_ids[int(d)],
                 )
-                for s, si, d in page
+                for s, si, d in zip(
+                    ms[ji][finite][: j.k],
+                    mseg[ji][finite][: j.k],
+                    mdoc[ji][finite][: j.k],
+                )
             ]
-            total = int(totals[ji])
+            total = int(mtot[ji].sum())
             relation = "eq"
             if pruned_flags[ji]:
                 with self._lock:
@@ -787,6 +961,7 @@ class QueryBatcher:
         fallback, which runs at collect time."""
         ex = jobs[0].executor
         nj = len(jobs)
+        staging = getattr(ex, "staging_slab", None)
         plan0 = jobs[0].plan
         fields = plan0.fields
         items: List[Tuple] = []
@@ -814,10 +989,18 @@ class QueryBatcher:
                         (sections, j.plan.msm) if sections is not None else None
                     )
             if fs is not None and all(p is not None for p in fplans):
-                pend = fs.search_async(fplans, kb, plan0.combine, plan0.tie)
+                pend = fs.search_async(
+                    fplans, kb, plan0.combine, plan0.tie, staging=staging
+                )
                 with self._lock:
                     self.stats["launches"] += 1
                     self.stats["fused_jobs"] += nj
+                n_docs = ex.reader.segments[si].num_docs
+                self._add_flops(sum(
+                    scoring.text_plan_flops(len(sec[0]), len(sec[2]), n_docs)
+                    for sections, _ in fplans
+                    for sec in sections
+                ))
                 items.append(("fused", si, fs, pend))
             else:
                 if fs is not None and fplans is not None:
@@ -829,26 +1012,44 @@ class QueryBatcher:
         return items
 
     def _collect_serve_group(self, jobs: List[_Job], kb: int, items):
-        """Host side of the serve group: transfer fused results, run
-        fallback segments, merge, finish. Totals are exact (the fused
-        program scores exactly — no pruning on this path)."""
+        """Host side of the serve group: one device-side merge + packed
+        download covers every fused segment; fallback segments (below
+        FUSED_MIN_DOCS / slot overflow) run per job on the host and join
+        the final merge. Totals are exact (the fused program scores
+        exactly — no pruning on this path)."""
         ex = jobs[0].executor
         reader = ex.reader
         per_job_cands: List[List[Tuple[float, int, int]]] = [[] for _ in jobs]
         totals = np.zeros(len(jobs), np.int64)
+        fused_items = [
+            (si, *fs.device_result(pend))
+            for tag, si, fs, pend in items
+            if tag == "fused"
+        ]
+        if fused_items:
+            t0 = time.perf_counter()
+            ms, mseg, mdoc, mtot = scoring.merge_segment_topk(
+                fused_items, kb
+            )
+            self._add_stall(time.perf_counter() - t0)
+            for ji in range(len(jobs)):
+                finite = np.isfinite(ms[ji])
+                for s, si, d in zip(
+                    ms[ji][finite], mseg[ji][finite], mdoc[ji][finite]
+                ):
+                    per_job_cands[ji].append((float(s), int(si), int(d)))
+                totals[ji] += int(mtot[ji].sum())
         for tag, si, fs, pend in items:
-            if tag == "fused":
-                s, d, tot = fs.decode_result(pend)
-                self._collect(jobs, per_job_cands, totals, si, s, d, tot)
-            else:
-                for ji, j in enumerate(jobs):
-                    s1, d1, t1 = ex.segment_topk(j.query, si, kb)
-                    with self._lock:
-                        self.stats["launches"] += 1
-                    self._collect(
-                        [j], [per_job_cands[ji]], totals[ji: ji + 1],
-                        si, s1[None, :], d1[None, :], np.array([t1]),
-                    )
+            if tag != "fallback":
+                continue
+            for ji, j in enumerate(jobs):
+                s1, d1, t1 = ex.segment_topk(j.query, si, kb)
+                with self._lock:
+                    self.stats["launches"] += 1
+                self._collect(
+                    [j], [per_job_cands[ji]], totals[ji: ji + 1],
+                    si, s1[None, :], d1[None, :], np.array([t1]),
+                )
         self._finish_jobs(jobs, per_job_cands, totals, reader)
 
     def _dispatch_knn_group(self, jobs: List[_Job]) -> List[Tuple]:
@@ -857,6 +1058,7 @@ class QueryBatcher:
         ex = jobs[0].executor
         reader = ex.reader
         nj = len(jobs)
+        staging = getattr(ex, "staging_slab", None)
         field = jobs[0].plan.field
         items: List[Tuple] = []
         for si, seg in enumerate(reader.segments):
@@ -867,8 +1069,13 @@ class QueryBatcher:
             vf = seg.vectors[field]
             dims = int(vectors.shape[1])
             n = seg.num_docs
-            q = np.zeros((BPAD, dims), np.float32)
-            valid = np.zeros(BPAD, bool)
+            if staging is not None:
+                q = staging("knn_q", (BPAD, dims), np.float32)
+                valid = staging("knn_valid", (BPAD,), np.bool_)
+                valid[:] = False  # stale rows are masked, not re-scored
+            else:
+                q = np.zeros((BPAD, dims), np.float32)
+                valid = np.zeros(BPAD, bool)
             for ji, j in enumerate(jobs):
                 q[ji] = np.asarray(j.plan.vector, np.float32)
                 valid[ji] = True
@@ -892,14 +1099,60 @@ class QueryBatcher:
             with self._lock:
                 self.stats["launches"] += 1
                 self.stats["fused_jobs"] += nj
+            self._add_flops(scoring.knn_flops(nj, n, dims))
             items.append((si, n, s, d))
         return items
 
     def _collect_knn_group(self, jobs: List[_Job], items):
         """Per-segment top num_candidates, then a global per-job k cut —
-        the coordinator merge of DfsPhase.executeKnnVectorQuery."""
+        the coordinator merge of DfsPhase.executeKnnVectorQuery. The
+        per-segment candidate buffers never leave the device: one merge
+        kernel applies the per-(job, segment) num_candidates rank cut
+        and selects the global winners in a single packed download.
+        Boost multiplies AFTER selection on the host (a per-job
+        strictly-positive constant cannot change the order), so scores
+        are float-identical to the host merge; a job carrying a zero or
+        negative boost would reorder, so that group merges on host."""
         reader = jobs[0].executor.reader
         per_job_cands: List[List[Tuple[float, int, int]]] = [[] for _ in jobs]
+        if items and all(j.plan.boost > 0.0 for j in jobs):
+            # BPAD rows to match the device buffers; padded query rows
+            # keep nc=0 (their scores are -inf anyway)
+            nc_rows = np.zeros((BPAD, len(items)), np.int32)
+            for ii, (si, n, _, _) in enumerate(items):
+                for ji, j in enumerate(jobs):
+                    nc_rows[ji, ii] = min(j.plan.num_candidates, n)
+            k_out = max(max(j.k, 1) for j in jobs)
+            t0 = time.perf_counter()
+            ms, mseg, mdoc, counts = scoring.knn_merge_segment_topk(
+                [(si, s, d) for si, _, s, d in items], nc_rows, k_out
+            )
+            self._add_stall(time.perf_counter() - t0)
+            for ji, j in enumerate(jobs):
+                finite = np.isfinite(ms[ji])
+                cap = min(j.plan.k, j.k)
+                boost = j.plan.boost
+                hits = [
+                    Hit(
+                        score=float(s) * boost,
+                        segment=int(si),
+                        local_doc=int(d),
+                        doc_id=reader.segments[int(si)].doc_ids[int(d)],
+                    )
+                    for s, si, d in zip(
+                        ms[ji][finite][:cap],
+                        mseg[ji][finite][:cap],
+                        mdoc[ji][finite][:cap],
+                    )
+                ]
+                j.result = TopDocs(
+                    total=min(int(counts[ji]), j.plan.k),
+                    hits=hits,
+                    max_score=hits[0].score if hits else None,
+                    relation="eq",
+                )
+                j.event.set()
+            return
         for si, n, s, d in items:
             s = np.asarray(s)
             d = np.asarray(d)

@@ -211,6 +211,13 @@ class JaxExecutor:
         # DENSE_ROWS_HBM_BUDGET of HBM) or a tiling/compile; RLock
         # because fused_scorer → _inv_norm/_segment_weights nest
         self._build_lock = threading.RLock()
+        # persistent padded staging slabs: per-(family, shape) rings of
+        # reusable query-operand buffers (fused plan uploads, kNN query
+        # rows, chunk tile planes) handed out round-robin to the batcher
+        # instead of fresh allocations every batch; bytes ride the
+        # `serving` ledger category
+        self._staging_slabs: Dict[tuple, list] = {}
+        self._staging_lock = threading.Lock()
 
     # ---- per-(segment, field) dense inverse-norm array ----
 
@@ -240,6 +247,32 @@ class JaxExecutor:
                 return
             hbm_ledger.add(category, nbytes, breaker=False)
             self._charges.append((category, nbytes))
+
+    def staging_slab(self, family: str, shape, dtype=np.int32) -> np.ndarray:
+        """A reusable pre-allocated query-operand buffer for the serving
+        hot path (batcher dispatch). Buffers are handed out from a
+        fixed-size ring per (family, shape, dtype) so a buffer is never
+        rewritten while an earlier batch's upload can still be reading
+        it: the ring is sized to cover every dispatcher worker at full
+        pipeline depth with one spare each. Callers must fully rewrite
+        the regions they use (pack_plans/score_into do)."""
+        key = (family, tuple(int(x) for x in shape), np.dtype(dtype).str)
+        with self._staging_lock:
+            entry = self._staging_slabs.get(key)
+            if entry is None:
+                from ..common.settings import pipeline_depth
+                from .batcher import WORKERS
+
+                ring = max(2, WORKERS * (pipeline_depth() + 1))
+                bufs = [np.zeros(shape, dtype) for _ in range(ring)]
+                self._charge(
+                    "serving", int(sum(b.nbytes for b in bufs)), False
+                )
+                entry = [0, bufs]
+                self._staging_slabs[key] = entry
+            i, bufs = entry
+            entry[0] = (i + 1) % len(bufs)
+            return bufs[i]
 
     def close(self) -> None:
         """Releases this executor's HBM ledger charges (the device

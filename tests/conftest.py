@@ -29,3 +29,27 @@ import pytest
 @pytest.fixture(autouse=True)
 def _seed():
     np.random.seed(0)
+
+
+@pytest.fixture(autouse=True, scope="module")
+def _no_leaked_batcher_threads():
+    """After each test module, every CLOSED QueryBatcher must have let
+    its worker threads exit — a pipeline regression that leaves a
+    worker blocked (e.g. on the in-flight ring or the queue) shows up
+    here instead of as a hung interpreter at process exit. Batchers of
+    still-open services legitimately keep their workers alive and are
+    not checked."""
+    yield
+    from elasticsearch_tpu.search.batcher import live_batchers
+
+    leaked = []
+    for b in list(live_batchers):
+        if not getattr(b, "_closed", False):
+            continue
+        for t in list(b._threads):
+            t.join(timeout=10.0)
+            if t.is_alive():
+                leaked.append(t.name)
+    assert not leaked, (
+        f"closed QueryBatcher left live worker threads: {leaked}"
+    )

@@ -1,0 +1,163 @@
+"""Quorum-aware master election (ADVICE r5, cluster/node.py).
+
+The single-phase coordinator used to let the lowest surviving node id
+self-elect unconditionally, so a symmetric partition produced TWO
+active masters whose metadata mutations diverged and later "healed" by
+whichever version number was higher. Now:
+
+  * a node may only self-elect after reaching a majority of the
+    surviving last-known node set (minority partitions never elect);
+  * a master that loses contact with a majority steps down — it keeps
+    serving reads but refuses metadata mutations;
+  * a stepped-down master that sees a newer state version on a healed
+    partition adopts it instead of running a second master.
+
+fd loops are parked (huge fd_interval) and ticks driven by hand so the
+partitions are deterministic.
+"""
+
+import pytest
+
+from elasticsearch_tpu.cluster.node import NotMasterError, TpuNode
+from elasticsearch_tpu.transport.service import ConnectTransportError
+
+
+def make_cluster(n):
+    nodes = [TpuNode("node-0", fd_interval=120.0, fd_retries=2).start()]
+    for i in range(1, n):
+        nodes.append(
+            TpuNode(
+                f"node-{i}", seeds=[nodes[0].address],
+                fd_interval=120.0, fd_retries=2,
+            ).start()
+        )
+    return nodes
+
+
+def partition(nodes, groups):
+    """Blocks transport.send across partition groups (by target
+    address). Returns a healer callable that restores full
+    connectivity."""
+    group_of_addr = {}
+    group_of_node = {}
+    for gi, group in enumerate(groups):
+        for node in group:
+            group_of_addr[node.address] = gi
+            group_of_node[node.name] = gi
+    originals = [(node, node.transport.send) for node in nodes]
+    for node in nodes:
+        gi = group_of_node[node.name]
+        orig = node.transport.send
+
+        def send(address, action, payload, timeout=30.0,
+                 _orig=orig, _gi=gi):
+            target = group_of_addr.get(tuple(address))
+            if target is not None and target != _gi:
+                raise ConnectTransportError(
+                    f"simulated partition to {address}"
+                )
+            return _orig(address, action, payload, timeout)
+
+        node.transport.send = send
+
+    def heal():
+        for node, orig in originals:
+            node.transport.send = orig
+
+    return heal
+
+
+def tick_master_checks(node, times):
+    for _ in range(times):
+        node._check_master()
+
+
+class TestMinorityNeverElects:
+    def test_symmetric_partition_single_active_master(self):
+        """The dual-master regression: 3 nodes, master node-0 isolated
+        WITH node-2, while node-1 (the deterministic next master) sits
+        alone. Pre-fix, node-1 self-elected the moment its master pings
+        failed → two active masters. Now the minority side never
+        elects."""
+        a, b, c = make_cluster(3)
+        try:
+            heal = partition([a, b, c], [[a, c], [b]])
+            # node-1's leader checker fails fd_retries times → election
+            # attempt → must be refused (reachable 1 of survivors {1,2})
+            tick_master_checks(b, 3)
+            assert not b.is_master()
+            assert b.state.get("master") == "node-0"
+            # the majority side is untouched: node-0 keeps quorum and
+            # keeps accepting metadata mutations
+            a._check_followers()
+            assert a.is_master() and not a._quorum_lost
+            a.create_index("maj", {"settings": {"number_of_shards": 1}})
+            assert "maj" in a.indices
+            heal()
+        finally:
+            for n in (a, b, c):
+                n.close()
+
+
+class TestMasterStepsDown:
+    def test_isolated_master_refuses_mutations_majority_elects(self):
+        a, b, c = make_cluster(3)
+        try:
+            heal = partition([a, b, c], [[a], [b, c]])
+            # master node-0 loses both followers → quorum lost
+            a._check_followers()
+            assert a.is_master()
+            assert a._quorum_lost
+            with pytest.raises(NotMasterError):
+                a.cluster.create_index("split", {})
+            # the majority side elects node-1 (reachable 2 of
+            # survivors {1,2} — majority)
+            tick_master_checks(b, 3)
+            assert b.is_master()
+            b.create_index("ok", {"settings": {"number_of_shards": 1}})
+            assert "ok" in b.indices and "ok" in c.indices
+            # heal: the deposed master sees the newer version on the
+            # next follower check and adopts the majority state instead
+            # of running a second master
+            heal()
+            a._check_followers()
+            assert not a.is_master()
+            assert a.state.get("master") == "node-1"
+            assert a._quorum_lost is False
+        finally:
+            for n in (a, b, c):
+                n.close()
+
+    def test_quorum_restores_after_reconnect(self):
+        a, b = make_cluster(2)
+        try:
+            heal = partition([a, b], [[a], [b]])
+            a._check_followers()
+            assert a._quorum_lost
+            with pytest.raises(NotMasterError):
+                a.cluster.create_index("nope", {})
+            heal()
+            a._check_followers()
+            assert not a._quorum_lost
+            a.create_index("yes", {"settings": {"number_of_shards": 1}})
+            assert "yes" in a.indices
+        finally:
+            for n in (a, b):
+                n.close()
+
+
+class TestTwoNodeFailoverStillWorks:
+    def test_dead_master_excluded_from_candidate_set(self):
+        """The voting-configuration shrink: with the confirmed-dead
+        master excluded, a 2-node cluster still fails over (the
+        pre-existing reelection behavior must not regress)."""
+        a, b = make_cluster(2)
+        try:
+            a.close()
+            tick_master_checks(b, 3)
+            assert b.is_master()
+            assert set(b.state["nodes"]) == {"node-1"}
+            b.create_index("after", {"settings": {"number_of_shards": 1}})
+            assert "after" in b.indices
+        finally:
+            b.close()
