@@ -31,11 +31,18 @@ from concurrent.futures import ThreadPoolExecutor
 from typing import Any, Dict, List, Optional
 
 from ..analysis import AnalysisRegistry
+from ..common.faults import faults
 from ..index.engine import OpResult, ShardEngine, VersionConflictError
 from ..index.mapping import Mappings
 from ..search import dsl
 from ..search.coordinator import _col_key
 from ..search.executor import NumpyExecutor, ShardReader
+from ..search.failures import (
+    SearchTimeoutError,
+    deadline_from,
+    parse_allow_partial,
+    shard_failure,
+)
 from ..utils.murmur3 import shard_id as route_shard_id
 
 from ..common.settings import INDEX_SETTINGS, SettingsError, validate_index_settings
@@ -67,6 +74,31 @@ ACTION_SHARD_REPLICA_OPS = "indices:data/write/replica_ops"
 ACTION_SNAPSHOT_SHARD = "internal:snapshot/shard"
 ACTION_SHARD_DFS = "indices:data/read/dfs"
 ACTION_SHARD_CAN_MATCH = "indices:data/read/can_match"
+
+
+def _request_scoped_error(e: BaseException) -> bool:
+    """Errors that indict the REQUEST, not the shard copy: parse
+    errors, 4xx-shaped ClusterErrors, and backpressure/breaker
+    rejections. They propagate unchanged from the fan-out instead of
+    becoming `_shards.failures` entries — retrying a malformed query
+    on a replica cannot succeed, and a 429 must keep its contract."""
+    from ..common.memory import CircuitBreakingException
+    from ..search.batcher import EsRejectedExecutionError
+    from .service import ClusterError
+
+    if isinstance(
+        e, (dsl.QueryParseError, EsRejectedExecutionError,
+            CircuitBreakingException),
+    ):
+        return True
+    try:
+        from ..search.aggs import AggParseError
+
+        if isinstance(e, AggParseError):
+            return True
+    except ImportError:  # pragma: no cover
+        pass
+    return isinstance(e, ClusterError) and e.status < 500
 
 
 def _tree_has_range(q) -> bool:
@@ -322,6 +354,11 @@ class IndexService:
         # round-robin cursor for in-sync copy selection on search
         # (adaptive replica selection, radically simplified)
         self._ars_cursor = 0
+        # coordinator → master shard-failure reporting hook; the
+        # distributed node wires this to TpuNode._report_shard_failed
+        # so a copy that failed a search leaves the in-sync set
+        # (ShardStateAction.shardFailed bookkeeping)
+        self.on_shard_failure = None
         self._local: Dict[int, ShardEngine] = {}
         for s in range(n):
             if not self._owns(s):
@@ -417,6 +454,48 @@ class IndexService:
                     return unmeasured[self._ars_cursor % len(unmeasured)]
                 return min(in_sync, key=lambda n: times[n])
         return in_sync[self._ars_cursor % len(in_sync)]
+
+    def _red_shard(self, sid: int) -> bool:
+        """True when NO searchable copy of the shard exists: the primary
+        is gone and the in-sync set holds no assigned copy (a red shard
+        in cluster-health terms). Local mode is never red."""
+        e = self._entry(sid)
+        if e is None:
+            return False
+        if e["primary"] is not None:
+            return False
+        return not [n for n in e["in_sync"] if n in self._copies(sid)]
+
+    def _retry_copy(self, sid: int, exclude) -> Optional[str]:
+        """Next in-sync copy to retry a failed shard call on, excluding
+        the copies already tried (AsyncSearchContext's
+        performPhaseOnShard move-to-next-copy). None = no copy left."""
+        e = self._entry(sid)
+        if e is None:
+            return None
+        cands = [
+            n
+            for n in e["in_sync"]
+            if n in self._copies(sid) and n not in exclude
+        ]
+        if not cands:
+            return None
+        if self.local_node in cands:
+            return self.local_node
+        return cands[0]
+
+    def _note_shard_failed(self, sid: int, node: Optional[str]) -> None:
+        """Best-effort master notification that a remote copy failed a
+        read (mirrors the write path's _report_shard_failed)."""
+        if node is None or node == self.local_node:
+            return
+        cb = self.on_shard_failure
+        if cb is None:
+            return
+        try:
+            cb(self.name, sid, node)
+        except Exception:
+            pass  # reporting must never fail the search
 
     def replica_targets(self, sid: int) -> List[str]:
         """Write fan-out set on the primary: assigned in-sync copies plus
@@ -756,6 +835,21 @@ class IndexService:
         by the coordinator."""
         ts = time.perf_counter_ns()
         body = body or {}
+        # per-shard cooperative timeout (QueryPhase's timer analog): the
+        # request's `timeout` rides the wire inside the body and each
+        # shard enforces its own budget; expiry raises SearchTimeoutError
+        # which the coordinator converts into a timed-out partial result
+        shard_deadline = deadline_from(body)
+
+        def _check_shard_deadline():
+            if (
+                shard_deadline is not None
+                and time.monotonic() > shard_deadline
+            ):
+                raise SearchTimeoutError(
+                    f"shard [{self.name}][{sid}] exceeded the search "
+                    "timeout budget"
+                )
         # ---- shard request cache (IndicesRequestCache): whole size:0 /
         # agg-only responses keyed by (canonical request bytes, refresh
         # generation) — a refresh that changed anything bumps the
@@ -891,9 +985,27 @@ class IndexService:
                     kind = "knn"
                 if plan is not None:
                     try:
-                        td = self._batcher.execute(
+                        job = self._batcher.submit_nowait(
                             ex, plan, k, kind=kind, query=query
                         )
+                        # the batcher future honors the shard's timeout
+                        # budget: an expired wait abandons the job (the
+                        # worker completes it into the void) and reports
+                        # this shard timed-out instead of blocking
+                        wait_s = (
+                            None
+                            if shard_deadline is None
+                            else max(shard_deadline - time.monotonic(), 0.0)
+                        )
+                        try:
+                            from ..search.batcher import QueryBatcher
+
+                            td = QueryBatcher.wait(job, timeout=wait_s)
+                        except TimeoutError:
+                            raise SearchTimeoutError(
+                                f"shard [{self.name}][{sid}] batched query "
+                                "exceeded the search timeout budget"
+                            )
                     except RuntimeError:
                         td = None  # batcher closed mid-request → unbatched
                 if td is None and plan is None and query is not None and knn is None:
@@ -980,6 +1092,7 @@ class IndexService:
 
         # ---- folded fetch phase: sources + highlight for this shard's
         # candidates (FetchPhase, SURVEY.md §3.3) ----
+        _check_shard_deadline()
         highlight_specs = None
         highlight_terms = None
         if "highlight" in body:
@@ -1346,14 +1459,20 @@ class IndexService:
         spec = {f: sorted(ts) for f, ts in wanted.items()}
 
         def one(sid: int) -> dict:
-            owner = self._search_node(sid)
-            if owner is None or owner == self.local_node:
-                return self.shard_dfs_local(sid, spec)
-            return self.remote_call(
-                owner,
-                ACTION_SHARD_DFS,
-                {"index": self.name, "shard": sid, "spec": spec},
-            )
+            try:
+                owner = self._search_node(sid)
+                if owner is None or owner == self.local_node:
+                    return self.shard_dfs_local(sid, spec)
+                return self.remote_call(
+                    owner,
+                    ACTION_SHARD_DFS,
+                    {"index": self.name, "shard": sid, "spec": spec},
+                )
+            except Exception:
+                # a shard that can't contribute statistics must not fail
+                # the round — if it is truly broken the query phase will
+                # record the failure with full accounting
+                return {"fields": {}, "terms": {}}
 
         agg_fields = {f: [0, 0] for f in spec}
         agg_terms: Dict[str, Dict[str, int]] = {
@@ -1392,37 +1511,32 @@ class IndexService:
         pinned: Optional[List] = None,
         skipped: Optional[set] = None,
         owners: Optional[Dict[int, Optional[str]]] = None,
-    ) -> List[dict]:
+        deadline: Optional[float] = None,
+        task=None,
+    ):
         """Scatter the per-shard request to every shard (local direct
-        call or transport hop), gather wire-shaped results in shard
-        order. `pinned[sid]` is a local executor or a {"node","ctx"}
-        token from pin_executors(). Shards in `skipped` (can_match
-        prefilter) contribute empty results without dispatch; `owners`
-        pins copy selection to the copies the prefilter consulted."""
+        call or transport hop) with per-shard failure isolation.
 
-        def run(sid: int) -> dict:
-            if skipped and sid in skipped:
-                return {
-                    "total": 0,
-                    "relation": "eq",
-                    "max_score": None,
-                    "hits": [],
-                }
-            pin = pinned[sid] if pinned is not None else None
-            if isinstance(pin, dict):
-                # remote (or registry-held) pinned context
-                return self.remote_call(
-                    pin["node"],
-                    ACTION_SHARD_SEARCH,
-                    {
-                        "index": self.name,
-                        "shard": sid,
-                        "body": body,
-                        "ctx": pin["ctx"],
-                    },
-                )
-            owner = (
-                owners[sid] if owners is not None else self._search_node(sid)
+        Returns ``(results, failures, timed_out)``: `results[sid]` is
+        the wire-shaped shard result or None when the shard failed;
+        `failures` holds ShardSearchFailure-shaped entries; `timed_out`
+        is True when any shard blew the request's `timeout` budget.
+
+        One shard's exception never poisons the fan-out: the call is
+        retried once on another in-sync copy (excluding the failed
+        node, with the failure reported toward the master like
+        `_report_shard_failed`), and only then recorded as failed. A
+        red shard (no searchable copy) is failed without dispatch.
+        `pinned[sid]` is a local executor or a {"node","ctx"} token
+        from pin_executors(). Shards in `skipped` (can_match prefilter)
+        contribute empty results without dispatch; `owners` pins copy
+        selection to the copies the prefilter consulted."""
+        from ..tasks import TaskCancelledException
+
+        def attempt(sid: int, owner: Optional[str], pin) -> dict:
+            faults.check(
+                "shard.search", index=self.name, shard=sid,
+                node=owner if owner is not None else (self.local_node or "local"),
             )
             if owner is None or owner == self.local_node:
                 return self.shard_search_local(sid, body, pinned_executor=pin)
@@ -1432,11 +1546,129 @@ class IndexService:
                 {"index": self.name, "shard": sid, "body": body},
             )
 
+        def run(sid: int):
+            if skipped and sid in skipped:
+                return "ok", {
+                    "total": 0,
+                    "relation": "eq",
+                    "max_score": None,
+                    "hits": [],
+                }
+            if task is not None:
+                task.check_cancelled()
+            pin = pinned[sid] if pinned is not None else None
+            if isinstance(pin, dict):
+                # remote (or registry-held) pinned context: the reader
+                # context is node-bound, so there is no copy to retry on
+                try:
+                    return "ok", self.remote_call(
+                        pin["node"],
+                        ACTION_SHARD_SEARCH,
+                        {
+                            "index": self.name,
+                            "shard": sid,
+                            "body": body,
+                            "ctx": pin["ctx"],
+                        },
+                    )
+                except SearchTimeoutError as e:
+                    return "timeout", shard_failure(
+                        self.name, sid, pin["node"], e
+                    )
+                except Exception as e:
+                    if _request_scoped_error(e):
+                        raise
+                    return "fail", shard_failure(self.name, sid, pin["node"], e)
+            if self._red_shard(sid):
+                from .service import ClusterError
+
+                return "fail", shard_failure(
+                    self.name,
+                    sid,
+                    None,
+                    ClusterError(
+                        503,
+                        f"primary shard [{self.name}][{sid}] is not active",
+                        "unavailable_shards_exception",
+                    ),
+                )
+            owner = (
+                owners[sid] if owners is not None else self._search_node(sid)
+            )
+            try:
+                return "ok", attempt(sid, owner, pin)
+            except TaskCancelledException:
+                raise
+            except SearchTimeoutError as e:
+                return "timeout", shard_failure(self.name, sid, owner, e)
+            except Exception as e:
+                if _request_scoped_error(e):
+                    raise
+                self._note_shard_failed(sid, owner)
+                alt = self._retry_copy(sid, exclude={owner})
+                if alt is not None:
+                    try:
+                        return "ok", attempt(sid, alt, pin)
+                    except SearchTimeoutError as e2:
+                        return "timeout", shard_failure(self.name, sid, alt, e2)
+                    except Exception as e2:
+                        if _request_scoped_error(e2):
+                            raise
+                        self._note_shard_failed(sid, alt)
+                        return "fail", shard_failure(self.name, sid, alt, e2)
+                return "fail", shard_failure(self.name, sid, owner, e)
+
         n = self.num_shards
-        if n == 1:
-            return [run(0)]
-        futs = [_FANOUT_POOL.submit(run, sid) for sid in range(n)]
-        return [f.result() for f in futs]
+        if n == 1 and deadline is None and task is None:
+            outcomes = [run(0)]
+        else:
+            futs = [_FANOUT_POOL.submit(run, sid) for sid in range(n)]
+            outcomes = []
+            for sid, f in enumerate(futs):
+                outcomes.append(
+                    self._gather_one(f, sid, deadline, task)
+                )
+        results: List[Optional[dict]] = [None] * n
+        failures: List[dict] = []
+        timed_out = False
+        for sid, (tag, payload) in enumerate(outcomes):
+            if tag == "ok":
+                results[sid] = payload
+            else:
+                failures.append(payload)
+                if tag == "timeout":
+                    timed_out = True
+        return results, failures, timed_out
+
+    def _gather_one(self, fut, sid: int, deadline: Optional[float], task):
+        """Bounded wait for one shard future: an expired request budget
+        abandons the shard (its worker thread finishes into the void)
+        and records a timed-out failure; with a cancellable task, the
+        wait polls so a cancel landing mid-collect aborts promptly."""
+        from concurrent.futures import TimeoutError as _FutTimeout
+
+        while True:
+            if task is not None:
+                task.check_cancelled()
+            step: Optional[float] = 0.02 if task is not None else None
+            if deadline is not None:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0 and not fut.done():
+                    fut.cancel()
+                    return "timeout", shard_failure(
+                        self.name,
+                        sid,
+                        None,
+                        SearchTimeoutError(
+                            f"shard [{self.name}][{sid}] did not complete "
+                            "within the search timeout"
+                        ),
+                    )
+                step = remaining if step is None else min(step, remaining)
+            try:
+                return fut.result(timeout=step)
+            except _FutTimeout:
+                continue
 
     def pin_executors(self, keep_alive: Optional[float] = None) -> List:
         """Point-in-time executor snapshot (ReaderContext acquire): scroll
@@ -1470,10 +1702,13 @@ class IndexService:
                     pass  # best-effort (context TTL reaps it anyway)
 
     def search(
-        self, body: Optional[dict] = None, pinned_executors: Optional[List] = None
+        self,
+        body: Optional[dict] = None,
+        pinned_executors: Optional[List] = None,
+        task=None,
     ) -> dict:
         resp, agg_nodes, agg_partials = self.search_internal(
-            body, pinned_executors
+            body, pinned_executors, task=task
         )
         if agg_nodes is not None:
             from ..search.aggs import reduce_aggs
@@ -1486,6 +1721,7 @@ class IndexService:
         body: Optional[dict] = None,
         pinned_executors: Optional[List] = None,
         extra_filter: Optional[dict] = None,
+        task=None,
     ):
         """Returns (response-without-aggs, agg_nodes, agg_partials) so a
         multi-index coordinator can reduce aggs across indices (the
@@ -1553,9 +1789,38 @@ class IndexService:
             dfs = self._dfs_round(body, skipped_shards)
             if dfs is not None:
                 sub["_dfs"] = dfs
-        shard_results = self._fan_out(
-            sub, pinned_executors, skipped_shards, fixed_owners
+        deadline = deadline_from(body)
+        per_shard, failures, timed_out = self._fan_out(
+            sub, pinned_executors, skipped_shards, fixed_owners,
+            deadline=deadline, task=task,
         )
+        allow_partial = parse_allow_partial(
+            body.get("allow_partial_search_results")
+        )
+        shard_results = [r for r in per_shard if r is not None]
+        if failures and not allow_partial:
+            from .service import ClusterError
+
+            first = failures[0]["reason"]
+            raise ClusterError(
+                503,
+                f"Search rejected due to missing shards "
+                f"[[{self.name}][{failures[0]['shard']}]]: "
+                f"{first['type']}: {first['reason']} "
+                "(allow_partial_search_results is false)",
+                "search_phase_execution_exception",
+            )
+        if failures and not shard_results and not timed_out:
+            # every shard failed hard: there is nothing partial to serve
+            # (SearchPhaseExecutionException "all shards failed")
+            from .service import ClusterError
+
+            first = failures[0]["reason"]
+            raise ClusterError(
+                503,
+                f"all shards failed: {first['type']}: {first['reason']}",
+                "search_phase_execution_exception",
+            )
 
         # ---- coordinator reduce (SearchPhaseController.reducedQueryPhase:
         # merge-sort per-shard pages by score/sort key, shard asc, rank
@@ -1568,7 +1833,9 @@ class IndexService:
             if ms is not None:
                 max_score = ms if max_score is None else max(max_score, ms)
         entries = []
-        for si, r in enumerate(shard_results):
+        for si, r in enumerate(per_shard):
+            if r is None:
+                continue
             for rank, h in enumerate(r["hits"]):
                 if sort_specs is not None:
                     key = tuple(
@@ -1601,15 +1868,18 @@ class IndexService:
                 "relation": "gte" if (total > limit or gte_shard) else "eq",
             }
         n = self.num_shards
+        shards_obj: dict = {
+            "total": n,
+            "successful": n - len(failures),
+            "skipped": len(skipped_shards),
+            "failed": len(failures),
+        }
+        if failures:
+            shards_obj["failures"] = failures
         resp = {
             "took": took,
-            "timed_out": False,
-            "_shards": {
-                "total": n,
-                "successful": n,
-                "skipped": len(skipped_shards),
-                "failed": 0,
-            },
+            "timed_out": timed_out,
+            "_shards": shards_obj,
             "hits": hits_obj,
         }
         if profile:
@@ -1997,8 +2267,11 @@ class IndexService:
                 "query": {"bool": {"must": [inner], "filter": [extra_filter]}},
             }
 
-        def run(sid: int) -> dict:
-            owner = self._search_node(sid)
+        def attempt(sid: int, owner: Optional[str]) -> dict:
+            faults.check(
+                "shard.count", index=self.name, shard=sid,
+                node=owner if owner is not None else (self.local_node or "local"),
+            )
             if owner is None or owner == self.local_node:
                 return self.shard_count_local(sid, body)
             return self.remote_call(
@@ -2007,20 +2280,70 @@ class IndexService:
                 {"index": self.name, "shard": sid, "body": body},
             )
 
+        def run(sid: int):
+            if self._red_shard(sid):
+                from .service import ClusterError
+
+                return "fail", shard_failure(
+                    self.name,
+                    sid,
+                    None,
+                    ClusterError(
+                        503,
+                        f"primary shard [{self.name}][{sid}] is not active",
+                        "unavailable_shards_exception",
+                    ),
+                )
+            owner = self._search_node(sid)
+            try:
+                return "ok", attempt(sid, owner)
+            except Exception as e:
+                if _request_scoped_error(e):
+                    raise
+                self._note_shard_failed(sid, owner)
+                alt = self._retry_copy(sid, exclude={owner})
+                if alt is not None:
+                    try:
+                        return "ok", attempt(sid, alt)
+                    except Exception as e2:
+                        if _request_scoped_error(e2):
+                            raise
+                        self._note_shard_failed(sid, alt)
+                        return "fail", shard_failure(self.name, sid, alt, e2)
+                return "fail", shard_failure(self.name, sid, owner, e)
+
         n = self.num_shards
         if n == 1:
-            results = [run(0)]
+            outcomes = [run(0)]
         else:
             futs = [_FANOUT_POOL.submit(run, sid) for sid in range(n)]
-            results = [f.result() for f in futs]
+            outcomes = [f.result() for f in futs]
+        failures = [p for tag, p in outcomes if tag != "ok"]
+        if failures and not parse_allow_partial(
+            (body or {}).get("allow_partial_search_results")
+        ):
+            from .service import ClusterError
+
+            first = failures[0]["reason"]
+            raise ClusterError(
+                503,
+                f"Count rejected due to missing shards "
+                f"[[{self.name}][{failures[0]['shard']}]]: "
+                f"{first['type']}: {first['reason']} "
+                "(allow_partial_search_results is false)",
+                "search_phase_execution_exception",
+            )
+        shards_obj: dict = {
+            "total": n,
+            "successful": n - len(failures),
+            "skipped": 0,
+            "failed": len(failures),
+        }
+        if failures:
+            shards_obj["failures"] = failures
         return {
-            "count": sum(r["count"] for r in results),
-            "_shards": {
-                "total": n,
-                "successful": n,
-                "skipped": 0,
-                "failed": 0,
-            },
+            "count": sum(p["count"] for tag, p in outcomes if tag == "ok"),
+            "_shards": shards_obj,
         }
 
     # ---- metadata ----

@@ -207,6 +207,9 @@ class DistributedClusterService(ClusterService):
                 )
                 idx.uuid = meta.get("uuid", idx.uuid)
                 idx.creation_date = meta.get("creation_date", idx.creation_date)
+                # a copy that fails a search leaves the in-sync set the
+                # same way a failed write replica does
+                idx.on_shard_failure = self.node._report_shard_failed
                 self.indices[name] = idx
             else:
                 new_mappings = meta.get("mappings") or {}

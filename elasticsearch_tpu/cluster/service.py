@@ -441,11 +441,31 @@ class ClusterService:
     # multi-index search (TransportSearchAction over resolved indices)
     # ------------------------------------------------------------------
 
-    def search(self, expression: str, body: Optional[dict] = None) -> dict:
+    def _with_partial_default(self, body: dict) -> dict:
+        """Applies the cluster-level request defaults the body didn't
+        choose explicitly: search.default_allow_partial_results and
+        search.default_search_timeout."""
+        out = body
+        if "allow_partial_search_results" not in out:
+            default = self.cluster_settings.get(
+                "search.default_allow_partial_results"
+            )
+            if default is not None and not bool(default):
+                out = {**out, "allow_partial_search_results": False}
+        if "timeout" not in out:
+            dt = self.cluster_settings.get("search.default_search_timeout")
+            if dt not in (None, "-1"):
+                out = {**out, "timeout": dt}
+        return out
+
+    def search(
+        self, expression: str, body: Optional[dict] = None, task=None
+    ) -> dict:
+        t0 = time.perf_counter()
         targets = self.resolve(expression)
-        body = body or {}
+        body = self._with_partial_default(body or {})
         if len(targets) == 1 and targets[0][1] is None:
-            return self.get_index(targets[0][0]).search(body)
+            return self.get_index(targets[0][0]).search(body, task=task)
         if not targets:
             return _empty_search_response()
         size = int(body.get("size", 10))
@@ -461,7 +481,9 @@ class ClusterService:
             sort_specs = parse_sort(body["sort"])
         for name, filt in targets:
             idx = self.get_index(name)
-            resp, nodes, partials = idx.search_internal(sub, extra_filter=filt)
+            resp, nodes, partials = idx.search_internal(
+                sub, extra_filter=filt, task=task
+            )
             responses.append((name, resp))
             if nodes is not None:
                 agg_nodes = nodes
@@ -470,9 +492,15 @@ class ClusterService:
         entries = []
         total = 0
         max_score = None
-        shards_total = 0
+        shards = {"total": 0, "successful": 0, "skipped": 0, "failed": 0}
+        failures: List[dict] = []
+        timed_out = False
         for pos, (name, resp) in enumerate(responses):
-            shards_total += resp["_shards"]["total"]
+            rs = resp["_shards"]
+            for k in ("total", "successful", "skipped", "failed"):
+                shards[k] += int(rs.get(k, 0))
+            failures.extend(rs.get("failures", []))
+            timed_out = timed_out or bool(resp.get("timed_out"))
             ht = resp["hits"].get("total")
             if ht:
                 total += ht["value"]
@@ -493,15 +521,15 @@ class ClusterService:
                 entries.append((key, pos, hi, h))
         entries.sort(key=lambda e: e[:3])
         hits = [h for _, _, _, h in entries[from_ : from_ + size]]
+        if failures:
+            shards["failures"] = failures
         out = {
-            "took": sum(r["took"] for _, r in responses),
-            "timed_out": False,
-            "_shards": {
-                "total": shards_total,
-                "successful": shards_total,
-                "skipped": 0,
-                "failed": 0,
-            },
+            # coordinator wall-clock, NOT the sum of per-index tooks —
+            # the per-index searches ran from one coordinator thread but
+            # their own tooks overlap fan-out waits
+            "took": int((time.perf_counter() - t0) * 1000),
+            "timed_out": timed_out,
+            "_shards": shards,
             "hits": {
                 "total": {"value": total, "relation": "eq"},
                 "max_score": max_score,
@@ -516,21 +544,20 @@ class ClusterService:
 
     def count(self, expression: str, body: Optional[dict] = None) -> dict:
         targets = self.resolve(expression)
+        body = self._with_partial_default(body or {})
         total = 0
-        shards = 0
+        shards = {"total": 0, "successful": 0, "skipped": 0, "failed": 0}
+        failures: List[dict] = []
         for name, filt in targets:
             r = self.get_index(name).count(body, extra_filter=filt)
             total += r["count"]
-            shards += r["_shards"]["total"]
-        return {
-            "count": total,
-            "_shards": {
-                "total": shards,
-                "successful": shards,
-                "skipped": 0,
-                "failed": 0,
-            },
-        }
+            rs = r["_shards"]
+            for k in ("total", "successful", "skipped", "failed"):
+                shards[k] += int(rs.get(k, 0))
+            failures.extend(rs.get("failures", []))
+        if failures:
+            shards["failures"] = failures
+        return {"count": total, "_shards": shards}
 
     # ------------------------------------------------------------------
     # index templates (MetadataIndexTemplateService, composable v2 subset)

@@ -1,0 +1,217 @@
+"""Deterministic fault-injection harness for the serving path.
+
+Reference analog: org.elasticsearch.test.transport.MockTransportService
++ the DisruptionScheme family (NetworkDisruption, SlowClusterStateProcessing)
+— ES's integration suites wrap the real transport/search services with
+rule-driven fault injectors so failure-handling code is exercised
+deterministically in CI. Here the production code itself carries named
+injection *sites* (`faults.check(site, **ctx)` — a no-op when no
+schedule is armed) and a process-wide registry holds the armed rules.
+
+Schedule shape (env `ES_TPU_FAULTS`, or `POST /_internal/faults`):
+
+    {"seed": 42, "rules": [
+        {"site": "shard.search", "match": {"index": "books", "shard": 1},
+         "kind": "error", "prob": 1.0, "times": 1},
+        {"site": "shard.search", "kind": "stall", "delay_ms": 2000,
+         "match": {"shard": 3}},
+        {"site": "transport.send", "kind": "drop", "prob": 0.1}
+    ]}
+
+* ``site``: fnmatch pattern over the site name. Known sites:
+  - ``transport.send``      (every outbound transport request)
+  - ``shard.search``        (per-shard query-phase call in the fan-out)
+  - ``shard.count``         (per-shard count call)
+  - ``batcher.dispatch``    (QueryBatcher device-dispatch of one group)
+  - ``batcher.collect``     (QueryBatcher host-collect of one group)
+  - ``knn.collect``         (kNN group device→host collect)
+* ``match``: exact-equality filters over the ctx kwargs the site passes
+  (string-compared, so {"shard": 1} matches shard=1).
+* ``kind``: ``error`` (raise InjectedFault, 500-shaped), ``drop``
+  (raise InjectedFault shaped like a connect_transport_exception),
+  ``delay`` / ``stall`` (sleep ``delay_ms`` then proceed — ``stall``
+  is the slow-kernel simulation; both behave identically, the name
+  documents intent).
+* ``prob``: trip probability (default 1.0). Draws are a pure hash of
+  (seed, rule index, site, ctx, per-ctx attempt counter) — NOT a
+  sequential RNG — so the schedule is deterministic regardless of
+  thread interleaving across the fan-out, and a replica retry of the
+  same shard re-draws with attempt+1 instead of being auto-doomed.
+* ``times``: cap on total trips for the rule (unlimited when absent).
+
+The registry is intentionally process-global (like the settings
+registries): tests and the `/_internal/faults` hook arm/clear it.
+"""
+
+from __future__ import annotations
+
+import fnmatch
+import hashlib
+import json
+import os
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+FAULTS_ENV = "ES_TPU_FAULTS"
+
+
+class InjectedFault(Exception):
+    """A fault raised by the harness. Carries a REST-ish status/err_type
+    so failure accounting can report it like a real exception class."""
+
+    def __init__(
+        self,
+        reason: str,
+        err_type: str = "injected_fault_exception",
+        status: int = 500,
+    ):
+        super().__init__(reason)
+        self.reason = reason
+        self.err_type = err_type
+        self.status = status
+
+
+class _Rule:
+    __slots__ = (
+        "index", "site", "match", "kind", "prob", "times", "delay_ms",
+        "trips", "attempts",
+    )
+
+    def __init__(self, index: int, spec: dict):
+        self.index = index
+        self.site = str(spec.get("site", "*"))
+        self.match = {
+            str(k): str(v) for k, v in (spec.get("match") or {}).items()
+        }
+        kind = str(spec.get("kind", "error"))
+        if kind not in ("error", "drop", "delay", "stall"):
+            raise ValueError(f"unknown fault kind [{kind}]")
+        self.kind = kind
+        self.prob = float(spec.get("prob", 1.0))
+        self.times = spec.get("times")
+        if self.times is not None:
+            self.times = int(self.times)
+        self.delay_ms = float(spec.get("delay_ms", 100.0))
+        self.trips = 0
+        self.attempts = 0
+
+    def matches(self, site: str, ctx: Dict[str, Any]) -> bool:
+        if not fnmatch.fnmatch(site, self.site):
+            return False
+        for k, v in self.match.items():
+            if str(ctx.get(k)) != v:
+                return False
+        return True
+
+    def info(self) -> dict:
+        return {
+            "site": self.site,
+            "match": dict(self.match),
+            "kind": self.kind,
+            "prob": self.prob,
+            "times": self.times,
+            "delay_ms": self.delay_ms,
+            "trips": self.trips,
+            "attempts": self.attempts,
+        }
+
+
+def _ctx_sig(ctx: Dict[str, Any]) -> str:
+    return "|".join(f"{k}={ctx[k]}" for k in sorted(ctx))
+
+
+class FaultRegistry:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._rules: List[_Rule] = []
+        self._seed = 0
+        # per-(rule, ctx) attempt counters: a retry of the same shard on
+        # another copy draws independently from the first attempt
+        self._attempts: Dict[tuple, int] = {}
+
+    @property
+    def active(self) -> bool:
+        return bool(self._rules)
+
+    def configure(self, config: Optional[dict]) -> dict:
+        """Replaces the schedule atomically; None/{} clears it."""
+        config = config or {}
+        rules = [
+            _Rule(i, spec) for i, spec in enumerate(config.get("rules") or [])
+        ]
+        with self._lock:
+            self._seed = int(config.get("seed", 0))
+            self._rules = rules
+            self._attempts.clear()
+        return self.describe()
+
+    def clear(self) -> None:
+        with self._lock:
+            self._rules = []
+            self._attempts.clear()
+
+    def describe(self) -> dict:
+        with self._lock:
+            return {
+                "active": bool(self._rules),
+                "seed": self._seed,
+                "rules": [r.info() for r in self._rules],
+            }
+
+    def _draw(self, rule: _Rule, site: str, sig: str, attempt: int) -> float:
+        key = f"{self._seed}|{rule.index}|{site}|{sig}|{attempt}"
+        h = hashlib.sha256(key.encode()).digest()
+        return int.from_bytes(h[:8], "big") / 2.0**64
+
+    def check(self, site: str, **ctx) -> None:
+        """Injection point. Raises InjectedFault (error/drop rules) or
+        sleeps (delay/stall rules); a no-op when nothing is armed."""
+        if not self._rules:  # fast path: unarmed in production
+            return
+        sleep_ms = 0.0
+        boom: Optional[InjectedFault] = None
+        with self._lock:
+            sig = _ctx_sig(ctx)
+            for rule in self._rules:
+                if not rule.matches(site, ctx):
+                    continue
+                if rule.times is not None and rule.trips >= rule.times:
+                    continue
+                akey = (rule.index, sig)
+                attempt = self._attempts.get(akey, 0)
+                self._attempts[akey] = attempt + 1
+                rule.attempts += 1
+                if rule.prob < 1.0 and (
+                    self._draw(rule, site, sig, attempt) >= rule.prob
+                ):
+                    continue
+                rule.trips += 1
+                if rule.kind in ("delay", "stall"):
+                    sleep_ms = max(sleep_ms, rule.delay_ms)
+                elif rule.kind == "drop":
+                    boom = InjectedFault(
+                        f"injected connection drop at [{site}] ({sig})",
+                        err_type="connect_transport_exception",
+                    )
+                    break
+                else:
+                    boom = InjectedFault(
+                        f"injected error at [{site}] ({sig})"
+                    )
+                    break
+        if sleep_ms > 0.0:
+            time.sleep(sleep_ms / 1000.0)
+        if boom is not None:
+            raise boom
+
+
+faults = FaultRegistry()
+
+# env-armed schedule (read once at import, like the other ES_TPU_* knobs)
+_raw = os.environ.get(FAULTS_ENV, "")
+if _raw:
+    try:
+        faults.configure(json.loads(_raw))
+    except (ValueError, TypeError):
+        pass  # a malformed schedule must never take the node down

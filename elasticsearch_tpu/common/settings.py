@@ -167,6 +167,12 @@ CLUSTER_SETTINGS: Dict[str, Setting] = {
         Setting("cluster.routing.allocation.enable", "all"),
         Setting("action.auto_create_index", True, parser=_parse_bool),
         Setting("search.default_search_timeout", "-1", parser=_parse_time),
+        # request default for allow_partial_search_results: false turns
+        # ANY shard failure/timeout into a 503 search_phase_execution_
+        # exception instead of a partial 200 (TransportSearchAction's
+        # SEARCH_DEFAULT_ALLOW_PARTIAL_RESULTS analog)
+        Setting("search.default_allow_partial_results", True,
+                parser=_parse_bool),
         Setting("search.max_buckets", 65536, parser=int,
                 validator=_positive("search.max_buckets")),
         Setting("indices.recovery.max_bytes_per_sec", "40mb"),

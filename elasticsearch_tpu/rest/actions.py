@@ -84,6 +84,13 @@ class RestActions:
         add("DELETE", "/_pit", self.close_pit)
         add("POST", "/_analyze", self.analyze)
         add("GET", "/_analyze", self.analyze)
+        # deterministic fault-injection test hook (common/faults.py):
+        # POST arms a seeded schedule, GET reports trip counters,
+        # DELETE disarms — never armed in production unless ES_TPU_FAULTS
+        # was set or a client posts a schedule explicitly
+        add("POST", "/_internal/faults", self.put_faults)
+        add("GET", "/_internal/faults", self.get_faults)
+        add("DELETE", "/_internal/faults", self.delete_faults)
         # async search (x-pack async-search: submit/get/delete)
         add("POST", "/{index}/_async_search", self.submit_async_search)
         add("GET", "/_async_search/{id}", self.get_async_search)
@@ -251,6 +258,30 @@ class RestActions:
 
     def put_cluster_settings(self, body, params, qs):
         return 200, self.cluster.update_cluster_settings(body or {})
+
+    # ---- fault-injection test hook (POST /_internal/faults) ----
+
+    def put_faults(self, body, params, qs):
+        from ..common.faults import faults
+
+        try:
+            return 200, faults.configure(body or {})
+        except (ValueError, TypeError) as e:
+            return 400, error_body(
+                400, "illegal_argument_exception",
+                f"malformed fault schedule: {e}",
+            )
+
+    def get_faults(self, body, params, qs):
+        from ..common.faults import faults
+
+        return 200, faults.describe()
+
+    def delete_faults(self, body, params, qs):
+        from ..common.faults import faults
+
+        faults.clear()
+        return 200, {"acknowledged": True}
 
     # ---- async search (SubmitAsyncSearchAction and friends) ----
 
@@ -1102,6 +1133,12 @@ class RestActions:
             body["request_cache"] = qs["request_cache"][0] not in (
                 "false", "0",
             )
+        if "timeout" in qs:
+            body["timeout"] = qs["timeout"][0]
+        if "allow_partial_search_results" in qs:
+            body["allow_partial_search_results"] = qs[
+                "allow_partial_search_results"
+            ][0] not in ("false", "0")
         if "scroll" in qs:
             targets = self.cluster.resolve(params["index"])
             if len(targets) != 1:
@@ -1120,15 +1157,18 @@ class RestActions:
             return 200, self.cluster.create_scroll(
                 name, body, qs["scroll"][0] or "1m"
             )
-        # every search runs as a registered task (TaskManager.register
-        # around TransportSearchAction) so GET _tasks shows it
+        # every search runs as a registered CANCELLABLE task
+        # (TaskManager.register around TransportSearchAction): the
+        # coordinator's gather loop polls check_cancelled(), so a
+        # cancel landing mid-collect aborts the request promptly now
+        # that timeout cancellation exists on the same path
         task = self.cluster.tasks.register(
             "indices:data/read/search",
             f"indices[{params['index']}]",
-            cancellable=False,
+            cancellable=True,
         )
         try:
-            return 200, self.cluster.search(params["index"], body)
+            return 200, self.cluster.search(params["index"], body, task=task)
         finally:
             self.cluster.tasks.unregister(task)
 

@@ -26,6 +26,7 @@ from ..index.mapping import MappingParseError
 from ..search.aggs import AggParseError
 from ..search.batcher import EsRejectedExecutionError
 from ..search.dsl import QueryParseError
+from ..tasks import TaskCancelledException
 from .actions import RestActions
 from .router import error_body
 
@@ -116,6 +117,12 @@ class ElasticHandler(BaseHTTPRequestHandler):
         except CircuitBreakingException as e:
             status, payload = 429, error_body(
                 429, "circuit_breaking_exception", str(e)
+            )
+        except TaskCancelledException as e:
+            # a cancelled search surfaces as 400 task_cancelled_exception
+            # (TransportSearchAction's cancellation contract)
+            status, payload = 400, error_body(
+                400, "task_cancelled_exception", str(e)
             )
         except EngineError as e:
             status, payload = 500, error_body(500, "engine_exception", str(e))

@@ -34,6 +34,7 @@ from typing import Deque, Dict, List, Optional, Tuple, Union
 
 import numpy as np
 
+from ..common.faults import faults
 from ..index.mapping import TEXT
 from ..ops import scoring
 from ..ops.scoring import BPAD
@@ -655,6 +656,11 @@ class QueryBatcher:
                 self._enter_kind(fam)
                 dispatched = False
                 try:
+                    # fault site: an injected dispatch failure surfaces
+                    # to exactly this group's waiters, not the batch
+                    faults.check(
+                        "batcher.dispatch", family=fam, jobs=len(jobs)
+                    )
                     if kind == "m":
                         self._run_group(jobs, key[2], kb)
                     elif kind == "s":
@@ -695,6 +701,11 @@ class QueryBatcher:
         try:
             for key, jobs, fam, pend in ctx.pending:
                 try:
+                    # fault site: a collect-phase failure (device→host
+                    # transfer) fails this group's waiters only
+                    faults.check(
+                        "batcher.collect", family=fam, jobs=len(jobs)
+                    )
                     if key[1] == "s":
                         self._collect_serve_group(jobs, key[-1], pend)
                     else:
@@ -1113,6 +1124,7 @@ class QueryBatcher:
         strictly-positive constant cannot change the order), so scores
         are float-identical to the host merge; a job carrying a zero or
         negative boost would reorder, so that group merges on host."""
+        faults.check("knn.collect", jobs=len(jobs))
         reader = jobs[0].executor.reader
         per_job_cands: List[List[Tuple[float, int, int]]] = [[] for _ in jobs]
         if items and all(j.plan.boost > 0.0 for j in jobs):

@@ -53,7 +53,7 @@ def _col_key(value, spec):
 
 
 def merge_sorted(
-    shard_results: Sequence[TopDocs],
+    shard_results: Sequence[Optional[TopDocs]],
     shard_sort_values: Sequence[Sequence[list]],
     sort_specs: Sequence[dict],
     from_: int,
@@ -61,10 +61,17 @@ def merge_sorted(
 ) -> tuple:
     """Coordinator merge for field-sorted results: compare raw sort
     values per column with direction/missing applied (TopFieldDocs merge
-    in SearchPhaseController). Returns (total, None, hits, hit_sorts)."""
-    total = sum(td.total for td in shard_results)
+    in SearchPhaseController). Returns (total, None, hits, hit_sorts).
+
+    A ``None`` entry is a FAILED shard (the partial-results contract of
+    the fault-tolerant fan-out): it contributes nothing, and surviving
+    shards keep their original shard indices for tie-breaks so a
+    degraded merge is the healthy merge minus the failed shards' hits."""
+    total = sum(td.total for td in shard_results if td is not None)
     entries = []
     for si, td in enumerate(shard_results):
+        if td is None:
+            continue
         svals = shard_sort_values[si]
         for i, h in enumerate(td.hits):
             vals = svals[i] if i < len(svals) else []
@@ -89,13 +96,18 @@ def merge_sorted(
 
 
 def merge_top_docs(
-    shard_results: Sequence[TopDocs], from_: int = 0, size: int = 10
+    shard_results: Sequence[Optional[TopDocs]], from_: int = 0, size: int = 10
 ) -> tuple:
-    """Returns (total, max_score, List[ShardHit]) for the global page."""
-    total = sum(td.total for td in shard_results)
+    """Returns (total, max_score, List[ShardHit]) for the global page.
+    ``None`` entries are failed shards (see merge_sorted): skipped, with
+    surviving shard indices preserved for the (score, shard, doc)
+    tie-break ordering."""
+    total = sum(td.total for td in shard_results if td is not None)
     max_score: Optional[float] = None
     entries: List[tuple] = []
     for si, td in enumerate(shard_results):
+        if td is None:
+            continue
         if td.max_score is not None:
             max_score = (
                 td.max_score if max_score is None else max(max_score, td.max_score)

@@ -415,6 +415,22 @@ class TransportService:
     ):
         """Synchronous request/response (`TransportService.sendRequest` +
         blocking future). Safe to call from any non-loop thread."""
+        from ..common.faults import InjectedFault, faults
+
+        # fault-injection site: drops/delays/errors on the outbound hop
+        # (MockTransportService-style disruption, armed via ES_TPU_FAULTS
+        # or POST /_internal/faults; a no-op when unarmed)
+        try:
+            faults.check(
+                "transport.send",
+                action=action,
+                address=f"{address[0]}:{address[1]}",
+            )
+        except InjectedFault as e:
+            if e.err_type == "connect_transport_exception":
+                # an injected drop looks exactly like a broken connection
+                raise ConnectTransportError(str(e))
+            raise
         fut = asyncio.run_coroutine_threadsafe(
             self._send_async(tuple(address), action, payload, timeout), self._loop
         )

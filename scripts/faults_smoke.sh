@@ -1,0 +1,125 @@
+#!/usr/bin/env bash
+# Fault-injection smoke: pre-push sanity for the degraded serving path.
+# Builds a tiny multi-shard corpus, arms a SEEDED fault schedule
+# (10% per-shard errors + one slow-kernel stall), and asserts:
+#   * every degraded response is a 200-shaped partial result with real
+#     _shards accounting (failed == injected failures, failures[] set)
+#   * recall vs the healthy run's surviving-shard hits >= 0.95
+#     (surviving shards are float-exact, so this gate is conservative)
+#   * the stalled query honors its timeout budget (timed_out: true,
+#     bounded wall time) instead of hanging a worker
+#   * no batcher worker threads leak (the tests/conftest.py
+#     _no_leaked_batcher_threads invariant, applied inline)
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+export JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}"
+
+python - <<'PY'
+import time
+
+from elasticsearch_tpu.cluster.indices import IndexService
+from elasticsearch_tpu.common.faults import faults
+from elasticsearch_tpu.utils.murmur3 import shard_id as route_shard_id
+
+SHARDS = 8
+N_DOCS = 400
+N_QUERIES = 24
+
+svc = IndexService(
+    "smoke",
+    settings={"number_of_shards": SHARDS, "search.backend": "jax"},
+    mappings_json={"properties": {
+        "body": {"type": "text"}, "n": {"type": "integer"},
+    }},
+)
+words = ["alpha", "beta", "gamma", "delta", "epsilon", "zeta"]
+for i in range(N_DOCS):
+    svc.index_doc(
+        f"d{i}",
+        {"body": f"{words[i % 6]} shared {words[(i * 7) % 6]} tok{i % 19}",
+         "n": i},
+    )
+svc.refresh()
+
+queries = [
+    {"query": {"match": {"body": words[qi % 6]}}, "size": 20}
+    for qi in range(N_QUERIES)
+]
+
+# healthy pass first (also warms the jax kernels)
+healthy = [svc.search(dict(q)) for q in queries]
+assert all(h["_shards"]["failed"] == 0 for h in healthy)
+
+# seeded schedule: 10% of shard-search calls error; shard 5 takes one
+# 1500ms slow-kernel stall (times=1 → exactly the stalled query trips)
+faults.configure({
+    "seed": 7,
+    "rules": [
+        {"site": "shard.search", "kind": "error", "prob": 0.10},
+        {"site": "shard.search", "match": {"shard": 5},
+         "kind": "stall", "delay_ms": 1500, "times": 1},
+    ],
+})
+
+total_failed = 0
+worst_recall = 1.0
+for q, h in zip(queries, healthy):
+    resp = svc.search(dict(q))
+    sh = resp["_shards"]
+    assert sh["total"] == SHARDS
+    assert sh["successful"] == SHARDS - sh["failed"]
+    total_failed += sh["failed"]
+    if sh["failed"]:
+        assert len(sh["failures"]) == sh["failed"]
+        assert all(f["reason"]["reason"] for f in sh["failures"])
+    failed = {f["shard"] for f in sh.get("failures", [])}
+    expected = [
+        (hit["_id"], hit["_score"])
+        for hit in h["hits"]["hits"]
+        if route_shard_id(hit["_id"], SHARDS) not in failed
+    ][:20]
+    got = [(hit["_id"], hit["_score"]) for hit in resp["hits"]["hits"]]
+    recall = (
+        len(set(got) & set(expected)) / len(expected) if expected else 1.0
+    )
+    worst_recall = min(worst_recall, recall)
+assert total_failed > 0, "the 10% schedule must have tripped at least once"
+assert worst_recall >= 0.95, f"surviving-shard recall {worst_recall} < 0.95"
+print(f"degraded pass: {total_failed} injected shard failures over "
+      f"{N_QUERIES} queries, worst surviving-shard recall {worst_recall}")
+
+# timeout vs a fresh stall: bounded, partial, timed_out
+faults.configure({
+    "seed": 7,
+    "rules": [{"site": "shard.search", "match": {"shard": 3},
+               "kind": "stall", "delay_ms": 4000}],
+})
+t0 = time.monotonic()
+resp = svc.search({"query": {"match": {"body": "shared"}},
+                   "size": 20, "timeout": "900ms"})
+elapsed = time.monotonic() - t0
+assert resp["timed_out"] is True, "stalled shard must flip timed_out"
+assert elapsed < 3.0, f"timeout did not bound the stall ({elapsed:.1f}s)"
+assert resp["hits"]["hits"], "partial hits must still be served"
+print(f"timeout pass: timed_out=true in {elapsed * 1000:.0f}ms "
+      f"with {len(resp['hits']['hits'])} partial hits")
+
+faults.clear()
+svc.close()
+
+# batcher-thread leak check (the tests/conftest.py fixture, inline)
+from elasticsearch_tpu.search.batcher import live_batchers
+
+leaked = []
+for b in list(live_batchers):
+    if not getattr(b, "_closed", False):
+        continue
+    for t in list(b._threads):
+        t.join(timeout=10.0)
+        if t.is_alive():
+            leaked.append(t.name)
+assert not leaked, f"closed QueryBatcher left live worker threads: {leaked}"
+print("no leaked batcher threads")
+print("FAULTS SMOKE OK")
+PY

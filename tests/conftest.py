@@ -26,9 +26,30 @@ import numpy as np
 import pytest
 
 
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers",
+        "faults: deterministic fault-injection tests (run in tier-1)",
+    )
+    config.addinivalue_line(
+        "markers", "slow: long-running tests excluded from tier-1"
+    )
+
+
 @pytest.fixture(autouse=True)
 def _seed():
     np.random.seed(0)
+
+
+@pytest.fixture(autouse=True)
+def _disarm_faults():
+    """A test that arms the fault-injection registry must never leak
+    its schedule into the next test."""
+    yield
+    from elasticsearch_tpu.common.faults import faults
+
+    if faults.active:
+        faults.clear()
 
 
 @pytest.fixture(autouse=True, scope="module")

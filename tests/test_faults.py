@@ -1,0 +1,756 @@
+"""Fault-tolerant search execution: per-shard failure isolation,
+replica retry, partial results, timeouts, and the deterministic
+fault-injection harness (ISSUE 4).
+
+Reference analogs: ShardSearchFailure / SearchPhaseExecutionException /
+allow_partial_search_results (TransportSearchAction), AsyncSearchContext
+retry-on-next-copy, and MockTransportService-style disruption schemes.
+"""
+
+import threading
+import time
+
+import pytest
+
+from elasticsearch_tpu.cluster.indices import (
+    ACTION_SHARD_SEARCH,
+    IndexService,
+)
+from elasticsearch_tpu.cluster.service import ClusterError, ClusterService
+from elasticsearch_tpu.common.faults import InjectedFault, faults
+from elasticsearch_tpu.utils.murmur3 import shard_id as route_shard_id
+
+pytestmark = pytest.mark.faults
+
+MAPPINGS = {
+    "properties": {
+        "body": {"type": "text"},
+        "n": {"type": "integer"},
+        "vec": {"type": "dense_vector", "dims": 4},
+    }
+}
+
+
+def build_service(backend, name, shards=4, n_docs=40):
+    svc = IndexService(
+        name,
+        settings={"number_of_shards": shards, "search.backend": backend},
+        mappings_json=MAPPINGS,
+    )
+    words = ["alpha", "beta", "gamma", "delta"]
+    for i in range(n_docs):
+        svc.index_doc(
+            f"d{i}",
+            {
+                "body": f"{words[i % 4]} common token {'alpha' if i % 3 == 0 else 'beta'}",
+                "n": i,
+                "vec": [1.0 * (i % 5), 0.5 * (i % 3), 1.0, 0.1 * i],
+            },
+        )
+    svc.refresh()
+    return svc
+
+
+def hits_of(resp):
+    return [(h["_id"], h["_score"]) for h in resp["hits"]["hits"]]
+
+
+def surviving(healthy_hits, failed_shards, n_shards):
+    return [
+        (i, s)
+        for i, s in healthy_hits
+        if route_shard_id(i, n_shards) not in failed_shards
+    ]
+
+
+class TestHarness:
+    def test_unarmed_is_noop(self):
+        faults.clear()
+        assert not faults.active
+        faults.check("shard.search", index="x", shard=0)  # no raise
+
+    def test_error_and_times_cap(self):
+        faults.configure(
+            {"rules": [{"site": "shard.search", "kind": "error", "times": 2}]}
+        )
+        for _ in range(2):
+            with pytest.raises(InjectedFault):
+                faults.check("shard.search", index="x", shard=0)
+        faults.check("shard.search", index="x", shard=0)  # cap reached
+        st = faults.describe()
+        assert st["rules"][0]["trips"] == 2
+
+    def test_match_filters(self):
+        faults.configure(
+            {
+                "rules": [
+                    {
+                        "site": "shard.search",
+                        "match": {"index": "a", "shard": 1},
+                        "kind": "error",
+                    }
+                ]
+            }
+        )
+        faults.check("shard.search", index="a", shard=0)
+        faults.check("shard.search", index="b", shard=1)
+        with pytest.raises(InjectedFault):
+            faults.check("shard.search", index="a", shard=1)
+
+    def test_delay_sleeps(self):
+        faults.configure(
+            {"rules": [{"site": "s", "kind": "delay", "delay_ms": 60}]}
+        )
+        t0 = time.monotonic()
+        faults.check("s")
+        assert time.monotonic() - t0 >= 0.05
+
+    def test_draws_are_pure_not_sequential(self):
+        cfg = {
+            "seed": 5,
+            "rules": [{"site": "s", "kind": "error", "prob": 0.5}],
+        }
+        outcomes = []
+        for _ in range(2):
+            faults.configure(cfg)
+            got = []
+            for sid in range(10):
+                try:
+                    faults.check("s", shard=sid)
+                    got.append(False)
+                except InjectedFault:
+                    got.append(True)
+            outcomes.append(got)
+        assert outcomes[0] == outcomes[1]
+        assert any(outcomes[0]) and not all(outcomes[0])
+
+
+class TestTransportSite:
+    def test_drop_raises_connect_error_then_recovers(self):
+        from elasticsearch_tpu.transport.service import (
+            ConnectTransportError,
+            TransportService,
+        )
+
+        a = TransportService("ta").start()
+        b = TransportService("tb").start()
+        try:
+            b.register_handler("demo:echo", lambda p: {"ok": True, **p})
+            assert a.send(b.address, "demo:echo", {"v": 1})["ok"]
+            faults.configure(
+                {
+                    "rules": [
+                        {"site": "transport.send",
+                         "match": {"action": "demo:echo"},
+                         "kind": "drop", "times": 1}
+                    ]
+                }
+            )
+            with pytest.raises(ConnectTransportError):
+                a.send(b.address, "demo:echo", {"v": 2})
+            # times=1: the retry-equivalent next call goes through
+            assert a.send(b.address, "demo:echo", {"v": 3})["ok"]
+        finally:
+            faults.clear()
+            a.close()
+            b.close()
+
+
+class TestPartialResults:
+    @pytest.mark.parametrize("backend", ["numpy", "jax"])
+    def test_failed_shard_partial_float_exact(self, backend):
+        svc = build_service(backend, f"pf-{backend}")
+        try:
+            body = {"query": {"match": {"body": "alpha"}}, "size": 100}
+            healthy = svc.search(body)
+            assert healthy["_shards"]["failed"] == 0
+            faults.configure(
+                {
+                    "rules": [
+                        {
+                            "site": "shard.search",
+                            "match": {"index": f"pf-{backend}", "shard": 1},
+                            "kind": "error",
+                            "times": 1,
+                        }
+                    ]
+                }
+            )
+            resp = svc.search(body)
+            sh = resp["_shards"]
+            assert sh["total"] == 4
+            assert sh["failed"] == 1
+            assert sh["successful"] == 3
+            f = sh["failures"][0]
+            assert f["shard"] == 1
+            assert f["index"] == f"pf-{backend}"
+            assert f["reason"]["type"] == "injected_fault_exception"
+            # surviving-shard hits are float-exact vs the healthy run
+            assert hits_of(resp) == surviving(hits_of(healthy), {1}, 4)
+        finally:
+            faults.clear()
+            svc.close()
+
+    def test_multiple_failed_shards(self):
+        svc = build_service("numpy", "pf-multi")
+        try:
+            faults.configure(
+                {
+                    "rules": [
+                        {"site": "shard.search", "match": {"shard": 0},
+                         "kind": "error"},
+                        {"site": "shard.search", "match": {"shard": 2},
+                         "kind": "error"},
+                    ]
+                }
+            )
+            body = {"query": {"match": {"body": "common"}}, "size": 100}
+            healthy_body = dict(body)
+            resp = svc.search(body)
+            assert resp["_shards"]["failed"] == 2
+            failed = {f["shard"] for f in resp["_shards"]["failures"]}
+            assert failed == {0, 2}
+            faults.clear()
+            healthy = svc.search(healthy_body)
+            assert hits_of(resp) == surviving(hits_of(healthy), failed, 4)
+        finally:
+            faults.clear()
+            svc.close()
+
+    def test_allow_partial_false_503(self):
+        svc = build_service("numpy", "pf-strict")
+        try:
+            faults.configure(
+                {
+                    "rules": [
+                        {"site": "shard.search", "match": {"shard": 1},
+                         "kind": "error"}
+                    ]
+                }
+            )
+            with pytest.raises(ClusterError) as ei:
+                svc.search(
+                    {
+                        "query": {"match": {"body": "alpha"}},
+                        "allow_partial_search_results": False,
+                    }
+                )
+            assert ei.value.status == 503
+            assert ei.value.err_type == "search_phase_execution_exception"
+        finally:
+            faults.clear()
+            svc.close()
+
+    def test_all_shards_failed_503(self):
+        svc = build_service("numpy", "pf-all")
+        try:
+            faults.configure(
+                {"rules": [{"site": "shard.search", "kind": "error"}]}
+            )
+            with pytest.raises(ClusterError) as ei:
+                svc.search({"query": {"match": {"body": "alpha"}}})
+            assert ei.value.status == 503
+        finally:
+            faults.clear()
+            svc.close()
+
+    def test_deterministic_schedule_repeats(self):
+        svc = build_service("numpy", "det", shards=8, n_docs=64)
+        try:
+            cfg = {
+                "seed": 0,
+                "rules": [
+                    {"site": "shard.search", "kind": "error", "prob": 0.4}
+                ],
+            }
+            sets = []
+            for _ in range(2):
+                faults.configure(cfg)
+                resp = svc.search(
+                    {"query": {"match": {"body": "common"}}, "size": 100}
+                )
+                sets.append(
+                    frozenset(
+                        f["shard"] for f in resp["_shards"].get("failures", [])
+                    )
+                )
+            assert sets[0] == sets[1] == frozenset({2, 6})
+        finally:
+            faults.clear()
+            svc.close()
+
+    def test_count_failure_isolation(self):
+        svc = build_service("numpy", "cnt")
+        try:
+            healthy = svc.count({"query": {"match": {"body": "common"}}})
+            assert healthy["_shards"]["failed"] == 0
+            faults.configure(
+                {
+                    "rules": [
+                        {"site": "shard.count", "match": {"shard": 3},
+                         "kind": "error"}
+                    ]
+                }
+            )
+            resp = svc.count({"query": {"match": {"body": "common"}}})
+            assert resp["_shards"]["failed"] == 1
+            assert resp["_shards"]["failures"][0]["shard"] == 3
+            lost = sum(
+                1
+                for i in range(40)
+                if route_shard_id(f"d{i}", 4) == 3
+            )
+            assert resp["count"] == healthy["count"] - lost
+        finally:
+            faults.clear()
+            svc.close()
+
+
+class TestBatcherFaults:
+    def test_dispatch_fault_isolated_to_one_shard(self):
+        svc = build_service("jax", "bf-dispatch", shards=2)
+        try:
+            faults.configure(
+                {
+                    "rules": [
+                        {"site": "batcher.dispatch", "kind": "error",
+                         "times": 1}
+                    ]
+                }
+            )
+            resp = svc.search({"query": {"match": {"body": "alpha"}}, "size": 50})
+            sh = resp["_shards"]
+            assert sh["failed"] == 1
+            assert sh["successful"] == 1
+            assert (
+                sh["failures"][0]["reason"]["type"]
+                == "injected_fault_exception"
+            )
+        finally:
+            faults.clear()
+            svc.close()
+
+    def test_knn_collect_fault_partial(self):
+        svc = build_service("jax", "bf-knn", shards=2)
+        try:
+            faults.configure(
+                {"rules": [{"site": "knn.collect", "kind": "error",
+                            "times": 1}]}
+            )
+            resp = svc.search(
+                {
+                    "knn": {
+                        "field": "vec",
+                        "query_vector": [1.0, 0.5, 1.0, 0.2],
+                        "k": 5,
+                        "num_candidates": 20,
+                    },
+                    "size": 10,
+                }
+            )
+            assert resp["_shards"]["failed"] == 1
+            assert len(resp["hits"]["hits"]) > 0
+        finally:
+            faults.clear()
+            svc.close()
+
+
+class TestTimeouts:
+    # the budget must cover an honest warm shard query on the backend
+    # (jax-on-CPU pays ~100ms+ per shard even warm) while staying far
+    # below the injected stall
+    @pytest.mark.parametrize(
+        "backend,budget", [("numpy", "200ms"), ("jax", "900ms")]
+    )
+    def test_stall_returns_partial_with_timed_out(self, backend, budget):
+        svc = build_service(backend, f"to-{backend}")
+        try:
+            # warm-up: the first jax query pays one-off kernel compiles
+            healthy = svc.search(
+                {"query": {"match": {"body": "common"}}, "size": 100}
+            )
+            faults.configure(
+                {
+                    "rules": [
+                        {
+                            "site": "shard.search",
+                            "match": {"index": f"to-{backend}", "shard": 2},
+                            "kind": "stall",
+                            "delay_ms": 4000,
+                        }
+                    ]
+                }
+            )
+            t0 = time.monotonic()
+            resp = svc.search(
+                {
+                    "query": {"match": {"body": "common"}},
+                    "size": 100,
+                    "timeout": budget,
+                }
+            )
+            elapsed = time.monotonic() - t0
+            assert elapsed < 3.0, "timeout must not wait out the stall"
+            assert resp["timed_out"] is True
+            sh = resp["_shards"]
+            assert sh["failed"] == 1
+            assert sh["failures"][0]["reason"]["type"] == "timeout_exception"
+            assert len(resp["hits"]["hits"]) > 0  # partial hits served
+            assert hits_of(resp) == surviving(hits_of(healthy), {2}, 4)
+        finally:
+            faults.clear()
+            svc.close()
+
+    def test_timeout_with_partial_false_503(self):
+        svc = build_service("numpy", "to-strict")
+        try:
+            faults.configure(
+                {
+                    "rules": [
+                        {"site": "shard.search", "match": {"shard": 1},
+                         "kind": "stall", "delay_ms": 2000}
+                    ]
+                }
+            )
+            with pytest.raises(ClusterError) as ei:
+                svc.search(
+                    {
+                        "query": {"match": {"body": "common"}},
+                        "timeout": "100ms",
+                        "allow_partial_search_results": False,
+                    }
+                )
+            assert ei.value.status == 503
+        finally:
+            faults.clear()
+            svc.close()
+
+    def test_no_timeout_when_fast(self):
+        svc = build_service("numpy", "to-fast")
+        try:
+            resp = svc.search(
+                {"query": {"match": {"body": "alpha"}}, "timeout": "30s"}
+            )
+            assert resp["timed_out"] is False
+            assert resp["_shards"]["failed"] == 0
+        finally:
+            svc.close()
+
+
+class TestReplicaRetry:
+    def _routed_service(self, fail_first_n=1):
+        calls = []
+
+        def fake_remote(node, action, payload):
+            calls.append((node, action))
+            n_search = sum(1 for c in calls if c[1] == ACTION_SHARD_SEARCH)
+            if action == ACTION_SHARD_SEARCH and n_search <= fail_first_n:
+                raise RuntimeError(f"simulated copy failure on [{node}]")
+            return {
+                "total": 1,
+                "relation": "eq",
+                "max_score": 1.0,
+                "hits": [{"_id": "x1", "_score": 1.0, "_source": {}}],
+            }
+
+        svc = IndexService(
+            "rep",
+            settings={"number_of_shards": 1, "search.backend": "numpy"},
+            mappings_json={"properties": {"body": {"type": "text"}}},
+            routing={
+                0: {
+                    "primary": "nB",
+                    "replicas": ["nC"],
+                    "in_sync": ["nB", "nC"],
+                }
+            },
+            local_node="coord",
+            remote_call=fake_remote,
+        )
+        reported = []
+        svc.on_shard_failure = lambda idx, sid, node: reported.append(
+            (idx, sid, node)
+        )
+        return svc, calls, reported
+
+    def test_retry_on_next_copy_succeeds(self):
+        svc, calls, reported = self._routed_service(fail_first_n=1)
+        resp = svc.search({"query": {"match_all": {}}, "size": 5})
+        assert resp["_shards"]["failed"] == 0
+        assert resp["_shards"]["successful"] == 1
+        assert [h["_id"] for h in resp["hits"]["hits"]] == ["x1"]
+        search_calls = [c for c in calls if c[1] == ACTION_SHARD_SEARCH]
+        assert len(search_calls) == 2
+        # the failed node was reported (shard-failed bookkeeping) and the
+        # retry went to the OTHER copy
+        assert reported == [("rep", 0, search_calls[0][0])]
+        assert search_calls[1][0] != search_calls[0][0]
+        assert {search_calls[0][0], search_calls[1][0]} == {"nB", "nC"}
+
+    def test_both_copies_fail_records_failure(self):
+        svc, calls, reported = self._routed_service(fail_first_n=99)
+        with pytest.raises(ClusterError) as ei:
+            svc.search({"query": {"match_all": {}}})
+        # single shard, both copies down → all shards failed
+        assert ei.value.status == 503
+        assert len(reported) == 2
+        assert {n for _, _, n in reported} == {"nB", "nC"}
+
+
+class TestRedShard:
+    def _red_service(self):
+        svc = IndexService(
+            "red",
+            settings={"number_of_shards": 2, "search.backend": "numpy"},
+            mappings_json={"properties": {"body": {"type": "text"}}},
+            routing={
+                0: {"primary": "nA", "replicas": [], "in_sync": ["nA"]},
+                1: {"primary": None, "replicas": [], "in_sync": []},
+            },
+            local_node="nA",
+            remote_call=lambda *a, **k: (_ for _ in ()).throw(
+                AssertionError("no remote call expected")
+            ),
+        )
+        eng = svc.local_shard(0)
+        self.doc_ids = []
+        for i in range(30):
+            did = f"r{i}"
+            if route_shard_id(did, 2) == 0:
+                eng.index(did, {"body": f"red shard doc {i}"})
+                self.doc_ids.append(did)
+        eng.refresh()
+        return svc
+
+    def test_search_partial_with_unavailable_failure(self):
+        svc = self._red_service()
+        try:
+            resp = svc.search({"query": {"match": {"body": "red"}}, "size": 50})
+            sh = resp["_shards"]
+            assert sh["total"] == 2
+            assert sh["failed"] == 1
+            assert sh["successful"] == 1
+            f = sh["failures"][0]
+            assert f["shard"] == 1
+            assert f["node"] is None
+            assert f["reason"]["type"] == "unavailable_shards_exception"
+            assert len(resp["hits"]["hits"]) == len(self.doc_ids)
+        finally:
+            svc.close()
+
+    def test_search_red_strict_503(self):
+        svc = self._red_service()
+        try:
+            with pytest.raises(ClusterError) as ei:
+                svc.search(
+                    {
+                        "query": {"match": {"body": "red"}},
+                        "allow_partial_search_results": False,
+                    }
+                )
+            assert ei.value.status == 503
+            assert ei.value.err_type == "search_phase_execution_exception"
+        finally:
+            svc.close()
+
+    def test_count_red_consistent(self):
+        svc = self._red_service()
+        try:
+            resp = svc.count({"query": {"match": {"body": "red"}}})
+            assert resp["count"] == len(self.doc_ids)
+            assert resp["_shards"]["failed"] == 1
+            assert (
+                resp["_shards"]["failures"][0]["reason"]["type"]
+                == "unavailable_shards_exception"
+            )
+        finally:
+            svc.close()
+
+
+class TestTaskCancellation:
+    def test_cancel_lands_mid_collect(self):
+        from elasticsearch_tpu.rest.actions import RestActions
+        from elasticsearch_tpu.tasks import TaskCancelledException
+
+        c = ClusterService()
+        actions = RestActions(c)
+        try:
+            c.create_index(
+                "c1",
+                {
+                    "settings": {
+                        "number_of_shards": 2,
+                        "search.backend": "numpy",
+                    },
+                    "mappings": {"properties": {"body": {"type": "text"}}},
+                },
+            )
+            idx = c.get_index("c1")
+            for i in range(10):
+                idx.index_doc(f"c{i}", {"body": "cancellable doc"})
+            idx.refresh()
+            faults.configure(
+                {
+                    "rules": [
+                        {"site": "shard.search", "kind": "stall",
+                         "delay_ms": 1500}
+                    ]
+                }
+            )
+            got = {}
+
+            def run_search():
+                try:
+                    got["resp"] = actions.search(
+                        {"query": {"match": {"body": "cancellable"}}},
+                        {"index": "c1"},
+                        {},
+                    )
+                except BaseException as e:
+                    got["err"] = e
+
+            t = threading.Thread(target=run_search)
+            t0 = time.monotonic()
+            t.start()
+            # the search task registers synchronously and is cancellable
+            task = None
+            while task is None and time.monotonic() - t0 < 2.0:
+                tasks = c.tasks.list("indices:data/read/search")
+                task = tasks[0] if tasks else None
+                if task is None:
+                    time.sleep(0.005)
+            assert task is not None
+            assert task.info()["cancellable"] is True
+            c.tasks.cancel(task.id, reason="test cancel")
+            t.join(timeout=5.0)
+            assert not t.is_alive()
+            # cancel aborted the collect loop well before the 1.5s stall
+            assert time.monotonic() - t0 < 1.2
+            assert isinstance(got.get("err"), TaskCancelledException)
+        finally:
+            faults.clear()
+            c.close()
+
+
+class TestRestFaultsHook:
+    def test_arm_inspect_disarm(self):
+        from elasticsearch_tpu.rest.actions import RestActions
+
+        c = ClusterService()
+        actions = RestActions(c)
+        try:
+            status, body = actions.put_faults(
+                {
+                    "seed": 9,
+                    "rules": [
+                        {"site": "shard.search", "kind": "error", "times": 1}
+                    ],
+                },
+                {},
+                {},
+            )
+            assert status == 200 and body["active"]
+            with pytest.raises(InjectedFault):
+                faults.check("shard.search", index="any", shard=0)
+            status, body = actions.get_faults(None, {}, {})
+            assert body["rules"][0]["trips"] == 1
+            status, body = actions.delete_faults(None, {}, {})
+            assert status == 200
+            assert not faults.active
+        finally:
+            faults.clear()
+            c.close()
+
+    def test_malformed_schedule_400(self):
+        from elasticsearch_tpu.rest.actions import RestActions
+
+        c = ClusterService()
+        actions = RestActions(c)
+        try:
+            status, body = actions.put_faults(
+                {"rules": [{"site": "s", "kind": "nonsense"}]}, {}, {}
+            )
+            assert status == 400
+            assert not faults.active
+        finally:
+            c.close()
+
+
+class TestCoordinatorMerge:
+    def test_merges_skip_failed_shards(self):
+        from elasticsearch_tpu.search.coordinator import (
+            merge_sorted,
+            merge_top_docs,
+        )
+        from elasticsearch_tpu.search.executor import Hit, TopDocs
+
+        a = TopDocs(
+            total=2,
+            hits=[
+                Hit(score=2.0, segment=0, local_doc=0, doc_id="a"),
+                Hit(score=1.0, segment=0, local_doc=1, doc_id="b"),
+            ],
+            max_score=2.0,
+        )
+        c = TopDocs(
+            total=1,
+            hits=[Hit(score=1.5, segment=0, local_doc=0, doc_id="c")],
+            max_score=1.5,
+        )
+        # a None entry is a failed shard: skipped, surviving shard
+        # indices preserved for tie-breaks
+        total, ms, hits = merge_top_docs([a, None, c], 0, 10)
+        assert total == 3 and ms == 2.0
+        assert [h.doc_id for h in hits] == ["a", "c", "b"]
+        assert [h.shard for h in hits] == [0, 2, 0]
+
+        spec = [{"field": "n", "order": "asc", "missing": "_last"}]
+        total, _, hits, sorts = merge_sorted(
+            [a, None, c], [[[1], [3]], [], [[2]]], spec, 0, 10
+        )
+        assert total == 3
+        assert [h.doc_id for h in hits] == ["a", "c", "b"]
+        assert sorts == [[1], [2], [3]]
+
+
+class TestMultiIndexAccounting:
+    def test_merged_shards_and_wall_clock_took(self):
+        c = ClusterService()
+        try:
+            for name in ("m1", "m2"):
+                c.create_index(
+                    name,
+                    {
+                        "settings": {
+                            "number_of_shards": 2,
+                            "search.backend": "numpy",
+                        },
+                        "mappings": {
+                            "properties": {"body": {"type": "text"}}
+                        },
+                    },
+                )
+                idx = c.get_index(name)
+                for i in range(8):
+                    idx.index_doc(f"{name}-{i}", {"body": "shared token"})
+                idx.refresh()
+            faults.configure(
+                {
+                    "rules": [
+                        {"site": "shard.search",
+                         "match": {"index": "m2", "shard": 0},
+                         "kind": "error"}
+                    ]
+                }
+            )
+            resp = c.search("m1,m2", {"query": {"match": {"body": "shared"}},
+                                      "size": 50})
+            sh = resp["_shards"]
+            assert sh["total"] == 4
+            assert sh["failed"] == 1
+            assert sh["successful"] == 3
+            assert sh["failures"][0]["index"] == "m2"
+            assert isinstance(resp["took"], int)
+        finally:
+            faults.clear()
+            c.close()
