@@ -75,7 +75,7 @@ TITLE_LEN = (3, 9)
 # ---------------------------------------------------------------------------
 
 
-def build_postings(rng, vocab, lengths):
+def build_postings(rng, vocab, lengths, n_docs=None):
     from elasticsearch_tpu.index.segment import (
         INVALID_DOC,
         TILE,
@@ -84,17 +84,18 @@ def build_postings(rng, vocab, lengths):
     )
     from elasticsearch_tpu.utils.smallfloat import encode_norms
 
+    n_docs = N_DOCS if n_docs is None else int(n_docs)
     probs = 1.0 / np.arange(1, vocab + 1)
     probs /= probs.sum()
     total = int(lengths.sum())
     log(f"sampling {total} tokens over {vocab} terms…")
     term_stream = rng.choice(vocab, size=total, p=probs).astype(np.int64)
-    doc_of = np.repeat(np.arange(N_DOCS, dtype=np.int64), lengths)
+    doc_of = np.repeat(np.arange(n_docs, dtype=np.int64), lengths)
 
-    key = term_stream * N_DOCS + doc_of
+    key = term_stream * n_docs + doc_of
     uniq, counts = np.unique(key, return_counts=True)
-    u_t = (uniq // N_DOCS).astype(np.int64)
-    u_d = (uniq % N_DOCS).astype(np.int32)
+    u_t = (uniq // n_docs).astype(np.int64)
+    u_d = (uniq % n_docs).astype(np.int32)
     tfs_flat = counts.astype(np.int32)
     log(f"{len(uniq)} postings")
 
@@ -122,12 +123,12 @@ def build_postings(rng, vocab, lengths):
     norms = encode_norms(lengths.astype(np.int64))
     tile_max_tf = tfs.max(axis=1).astype(np.int32)
     valid = doc_ids >= 0
-    tile_norms = np.where(valid, norms[np.clip(doc_ids, 0, N_DOCS - 1)], 255)
+    tile_norms = np.where(valid, norms[np.clip(doc_ids, 0, n_docs - 1)], 255)
     tile_min_norm = tile_norms.min(axis=1).astype(np.uint8)
 
     terms = [f"w{i:05d}" for i in range(vocab)]  # sorted lexicographically
     stats = FieldStats(
-        doc_count=N_DOCS,
+        doc_count=n_docs,
         sum_total_term_freq=int(term_total_tf.sum()),
         sum_doc_freq=int(term_df.sum()),
     )
@@ -494,6 +495,250 @@ def recall_gate(svc_jax, svc_oracle, bodies, n=12, k=1000):
     return float(np.mean(recalls)), float(max_rel)
 
 
+# ---------------------------------------------------------------------------
+# mesh scaling sweep: the live search path as ONE SPMD program across
+# 1/2/4/8 devices (parallel/mesh_executor.py). Its own multi-shard index
+# — each shard an independent segment — so the sweep exercises the real
+# stacked-entry layout, not a re-labeled single shard.
+# ---------------------------------------------------------------------------
+
+MESH_SHARDS = int(os.environ.get("BENCH_MESH_SHARDS", 8))
+MESH_DOCS = int(os.environ.get("BENCH_MESH_DOCS", N_DOCS))
+
+
+def build_mesh_services():
+    """(jax service, numpy oracle service, aggregate body df)."""
+    from elasticsearch_tpu.cluster.indices import IndexService
+    from elasticsearch_tpu.index.segment import Segment, VectorField
+
+    rng = np.random.default_rng(SEED + 17)
+    per = max(MESH_DOCS // MESH_SHARDS, 1)
+    segs_jax, segs_np = [], []
+    df_total = np.zeros(VOCAB, np.int64)
+    for s in range(MESH_SHARDS):
+        lengths = rng.integers(AVG_LEN[0], AVG_LEN[1], size=per)
+        pf, df = build_postings(rng, VOCAB, lengths, n_docs=per)
+        df_total += df
+        vecs = rng.normal(size=(per, DIMS)).astype(np.float32)
+        vecs /= np.linalg.norm(vecs, axis=1, keepdims=True)
+        v16 = vecs.astype(np.float16)
+        ids = [f"{s}-{i}" for i in range(per)]
+        exists = np.ones(per, bool)
+
+        def seg_of(vmat):
+            return Segment(
+                num_docs=per,
+                doc_ids=ids,
+                sources=[None] * per,
+                postings={"body": pf},
+                numerics={},
+                ordinals={},
+                vectors={
+                    "vec": VectorField(
+                        vectors=vmat, exists=exists,
+                        similarity="cosine", unit_vectors=vmat,
+                    )
+                },
+            )
+
+        segs_jax.append(seg_of(v16))
+        segs_np.append(seg_of(v16.astype(np.float32)))
+
+    def svc_of(segs, backend):
+        svc = IndexService(
+            f"bench-mesh-{backend}",
+            settings={
+                "number_of_shards": MESH_SHARDS,
+                "search.backend": backend,
+            },
+            mappings_json={
+                "properties": {
+                    "body": {"type": "text"},
+                    "vec": {
+                        "type": "dense_vector",
+                        "dims": DIMS,
+                        "similarity": "cosine",
+                    },
+                }
+            },
+        )
+        for sid, eng in enumerate(svc.shards):
+            eng.segments = [segs[sid]]
+            eng.live_docs = [None]
+            eng.seg_versions = [np.ones(per, np.int64)]
+            eng.seg_seqnos = [np.arange(per, dtype=np.int64)]
+            eng.seg_names = [f"seg_{sid}_0"]
+            eng._next_seq = per
+            eng.change_generation += 1
+        return svc
+
+    return svc_of(segs_jax, "jax"), svc_of(segs_np, "numpy"), df_total
+
+
+def mesh_sweep(svc, svc_oracle, body_df):
+    """Scaling sweep over 1/2/4/8 devices: per-device QPS, scaling
+    efficiency vs the 1-device mesh, per-device MFU, the sequential
+    fan-out baseline, and recall/float-exactness gates."""
+    import jax
+
+    from elasticsearch_tpu.common.settings import peak_flops
+
+    n_avail = len(jax.devices())
+    dev_counts = [d for d in (1, 2, 4, 8) if d <= n_avail]
+    texts = make_query_texts(body_df, N_QUERIES_SECONDARY, seed=23)
+    match_bodies = [
+        {"query": {"match": {"body": t}}, "size": K} for t in texts
+    ]
+    rngq = np.random.default_rng(29)
+    qv = rngq.normal(size=(N_QUERIES_SECONDARY, DIMS)).astype(np.float32)
+    qv /= np.linalg.norm(qv, axis=1, keepdims=True)
+    knn_bodies = [
+        {
+            "knn": {
+                "field": "vec",
+                "query_vector": [float(x) for x in v],
+                "k": K,
+                "num_candidates": 100,
+            },
+            "size": K,
+        }
+        for v in qv
+    ]
+    mex = svc.mesh_executor()
+    batcher = svc._batcher
+
+    # sequential (per-shard fan-out) baseline on the SAME index
+    os.environ["ES_TPU_MESH"] = "off"
+    for b in match_bodies[:4] + knn_bodies[:4]:
+        svc.search(b)
+    seq_match_qps, seq_match_p50, _, _ = run_load(svc, match_bodies)
+    seq_knn_qps, seq_knn_p50, _, _ = run_load(svc, knn_bodies)
+    log(
+        f"[mesh] sequential fan-out ({MESH_SHARDS} shards): "
+        f"match={seq_match_qps:.1f} QPS p50={seq_match_p50:.2f}ms  "
+        f"knn={seq_knn_qps:.1f} QPS p50={seq_knn_p50:.2f}ms"
+    )
+
+    sweep = []
+    exact = True
+    try:
+        os.environ["ES_TPU_MESH"] = "force"
+        for nd in dev_counts:
+            os.environ["ES_TPU_MESH_DEVICES"] = str(nd)
+            mex.close()  # next search rebuilds the stack on nd devices
+            for b in match_bodies[:4] + knn_bodies[:4]:
+                svc.search(b)  # warm/compile the nd-device programs
+            routed0 = mex.stats["routed"]
+            dev0 = {r["id"]: r for r in batcher.device_stats()}
+            m_qps, m_p50, _, _ = run_load(svc, match_bodies)
+            k_qps, k_p50, _, _ = run_load(svc, knn_bodies)
+            per_device = []
+            for r in batcher.device_stats():
+                r0 = dev0.get(r["id"], {"device_busy_ms": 0.0, "flops": 0})
+                busy = r["device_busy_ms"] - r0["device_busy_ms"]
+                fl = r["flops"] - r0["flops"]
+                if busy <= 0 and fl <= 0:
+                    continue
+                per_device.append(
+                    {
+                        "id": r["id"],
+                        "device_busy_ms": round(busy, 1),
+                        "flops": int(fl),
+                        "mfu": float(
+                            f"{fl / ((busy / 1000.0) * peak_flops()):.4e}"
+                        )
+                        if busy > 0
+                        else 0.0,
+                    }
+                )
+            assert mex.stats["routed"] > routed0, "sweep did not mesh-route"
+            sweep.append(
+                {
+                    "devices": nd,
+                    "match_qps": round(m_qps, 1),
+                    "match_p50_ms": round(m_p50, 2),
+                    "knn_qps": round(k_qps, 1),
+                    "knn_p50_ms": round(k_p50, 2),
+                    "match_qps_per_device": round(m_qps / nd, 1),
+                    "knn_qps_per_device": round(k_qps / nd, 1),
+                    "per_device": per_device,
+                }
+            )
+            log(
+                f"[mesh] {nd} device(s): match={m_qps:.1f} QPS "
+                f"p50={m_p50:.2f}ms  knn={k_qps:.1f} QPS p50={k_p50:.2f}ms"
+            )
+            for row in per_device:
+                log(
+                    f"[mesh]   device {row['id']}: "
+                    f"busy={row['device_busy_ms']:.0f}ms "
+                    f"mfu={row['mfu']:.2e}"
+                )
+        base = sweep[0]
+        for entry in sweep:
+            entry["scaling_match"] = (
+                round(entry["match_qps"] / base["match_qps"], 3)
+                if base["match_qps"]
+                else None
+            )
+            entry["scaling_knn"] = (
+                round(entry["knn_qps"] / base["knn_qps"], 3)
+                if base["knn_qps"]
+                else None
+            )
+            entry["scaling_efficiency_match"] = round(
+                (entry["scaling_match"] or 0.0) / entry["devices"], 3
+            )
+            entry["scaling_efficiency_knn"] = round(
+                (entry["scaling_knn"] or 0.0) / entry["devices"], 3
+            )
+        # gates at the widest mesh: recall vs the CPU oracle and
+        # float-exactness vs the sequential path on the same service
+        recall_m, rel_m = recall_gate(svc, svc_oracle, match_bodies, n=8)
+        recall_k, rel_k = recall_gate(svc, svc_oracle, knn_bodies, n=6)
+        for b in match_bodies[:4] + knn_bodies[:2]:
+            rm = svc.search(b)
+            os.environ["ES_TPU_MESH"] = "off"
+            rs = svc.search(b)
+            os.environ["ES_TPU_MESH"] = "force"
+            if [(h["_id"], h["_score"]) for h in rm["hits"]["hits"]] != [
+                (h["_id"], h["_score"]) for h in rs["hits"]["hits"]
+            ]:
+                exact = False
+    finally:
+        os.environ["ES_TPU_MESH"] = "off"
+        os.environ.pop("ES_TPU_MESH_DEVICES", None)
+    top = sweep[-1]
+    log(
+        f"[mesh] scaling at {top['devices']} devices: "
+        f"match {top['scaling_match']}x knn {top['scaling_knn']}x "
+        f"(recall match={recall_m:.4f} knn={recall_k:.4f}, "
+        f"float_exact={exact})"
+    )
+    return {
+        "n_shards": MESH_SHARDS,
+        "n_docs": MESH_DOCS,
+        "devices_available": n_avail,
+        "sweep": sweep,
+        "seq_match_qps": round(seq_match_qps, 1),
+        "seq_knn_qps": round(seq_knn_qps, 1),
+        "speedup_vs_sequential_match": (
+            round(top["match_qps"] / seq_match_qps, 2)
+            if seq_match_qps
+            else None
+        ),
+        "speedup_vs_sequential_knn": (
+            round(top["knn_qps"] / seq_knn_qps, 2) if seq_knn_qps else None
+        ),
+        "recall_match": round(recall_m, 4),
+        "recall_knn": round(recall_k, 4),
+        "max_score_rel_delta_match": float(f"{rel_m:.3e}"),
+        "max_score_rel_delta_knn": float(f"{rel_k:.3e}"),
+        "float_exact_vs_sequential": exact,
+        "mesh_stats": mex.stats_snapshot(),
+    }
+
+
 def main():
     t0 = time.perf_counter()
     log(f"building {N_DOCS} doc corpus…")
@@ -699,10 +944,23 @@ def main():
     # MFU against ES_TPU_PEAK_FLOPS)
     pipeline_block = batcher.pipeline_stats()
     pipeline_block["mfu"] = float(f"{pipeline_block['mfu']:.4e}")
+    pipeline_block["devices"] = batcher.device_stats()
     log(f"[pipeline] depth={pipeline_block['depth']} "
         f"device_busy={pipeline_block['device_busy_ms']:.0f}ms "
         f"host_stall={pipeline_block['host_stall_ms']:.0f}ms "
         f"mfu={pipeline_block['mfu']:.2e}")
+    for row in pipeline_block["devices"]:
+        log(f"[pipeline]   device {row['id']}: "
+            f"busy={row['device_busy_ms']:.0f}ms flops={row['flops']:.3g} "
+            f"mfu={row['mfu']:.2e}")
+
+    # ---- mesh scaling sweep (its own multi-shard index) ----
+    mesh_block = None
+    if os.environ.get("BENCH_MESH", "1") != "0":
+        log(f"[mesh] building {MESH_DOCS}-doc corpus over "
+            f"{MESH_SHARDS} shards…")
+        svc_mesh, svc_mesh_np, mesh_df = build_mesh_services()
+        mesh_block = mesh_sweep(svc_mesh, svc_mesh_np, mesh_df)
 
     headline = max(configs["match"]["qps"], qps_wand)
     base = configs["match"]["cpu_oracle_qps"]
@@ -726,6 +984,7 @@ def main():
                 "cpu_oracle_qps_single_thread": round(o1_qps, 1),
                 "recall_at_1000": configs["match"]["recall"],
                 "pipeline": pipeline_block,
+                "mesh": mesh_block,
                 "configs": configs,
                 "baseline_kind": (
                     "measured NumPy oracle: dense vectorized scorer (no "

@@ -379,6 +379,10 @@ class IndexService:
         from ..search.batcher import QueryBatcher
 
         self._batcher = QueryBatcher()
+        # mesh-parallel serving engine (parallel/mesh_executor.py):
+        # created lazily — it imports jax, which numpy-backend indices
+        # never need
+        self._mesh = None
         # SearchStats (per-index totals; query_current omitted)
         self.search_stats = {
             "query_total": 0,
@@ -761,6 +765,8 @@ class IndexService:
         for s in self.shards:
             s.close()
         self._batcher.close()
+        if self._mesh is not None:
+            self._mesh.close()
         # drop this index's cache entries (and their ledger charges)
         from ..search.query_cache import filter_cache, request_cache
 
@@ -1701,6 +1707,144 @@ class IndexService:
                 except Exception:
                     pass  # best-effort (context TTL reaps it anyway)
 
+    # ---- mesh-parallel serving (parallel/mesh_executor.py): one SPMD
+    # program over every (shard, segment) entry replaces the per-shard
+    # fan-out for the hot flat-plan request shapes ----
+
+    # body keys the mesh fetch path can serve; anything else (aggs,
+    # sort, highlight, profile, timeout, …) takes the per-shard path
+    _MESH_BODY_KEYS = frozenset(
+        {
+            "query", "knn", "size", "from", "_source",
+            "track_total_hits", "allow_partial_search_results",
+        }
+    )
+
+    def mesh_executor(self):
+        mex = self._mesh
+        if mex is None:
+            with self._executor_lock:
+                if self._mesh is None:
+                    from ..parallel.mesh_executor import MeshExecutor
+
+                    self._mesh = MeshExecutor(self)
+                mex = self._mesh
+        return mex
+
+    def _mesh_search(self, body: dict, task=None) -> Optional[dict]:
+        """Whole-index SPMD execution of one request: B concurrent
+        same-plan requests × all shards run as ONE `shard_map` program
+        (batched through the QueryBatcher's mesh job kinds) — local
+        top-k per device, all_gather + k-way merge over the ICI, psum
+        totals — instead of S sequential kernel dispatches and S host
+        round trips. Returns the wire response, or None to fall through
+        to the per-shard coordinator (ineligible body, mesh off/degraded,
+        mid-flight failure). Results are float-exact vs the sequential
+        path — same scoring formula, same (score desc, shard asc,
+        segment asc, doc asc) merge order."""
+        mesh = self.mesh_executor()
+        if not mesh.available():
+            return None
+        if any(k not in self._MESH_BODY_KEYS for k in body):
+            return None
+        if deadline_from(body) is not None:
+            return None  # cooperative timeouts stay on the shard path
+        has_q = "query" in body
+        has_knn = "knn" in body
+        if has_q == has_knn:  # hybrid or match_all: shard path
+            return None
+        size = int(body.get("size", 10))
+        from_ = int(body.get("from", 0))
+        if size <= 0 or from_ < 0:
+            return None
+        tth = body.get("track_total_hits", 10_000)
+        from ..search.batcher import (
+            QueryBatcher,
+            extract_knn_plan,
+            extract_match_plan,
+            extract_serve_plan,
+        )
+
+        kind = None
+        if has_q:
+            query = dsl.parse_query(body["query"])  # parse errors are
+            # request-scoped: surface them exactly like the shard path
+            plan = extract_match_plan(query, self.mappings, self.analysis, tth)
+            kind = "mesh_match"
+            if plan is None:
+                plan = extract_serve_plan(query, self.mappings, self.analysis)
+                kind = "mesh_serve"
+        else:
+            knn_body = body["knn"]
+            knn = [
+                dsl.parse_knn(kb)
+                for kb in (knn_body if isinstance(knn_body, list) else [knn_body])
+            ]
+            plan = extract_knn_plan(knn, self.mappings)
+            kind = "mesh_knn"
+        if plan is None:
+            return None
+        from ..parallel.mesh_executor import MeshUnavailable
+        from ..tasks import TaskCancelledException
+
+        t0 = time.perf_counter()
+        try:
+            job = self._batcher.submit_nowait(
+                mesh, plan, from_ + size, kind=kind
+            )
+            td = QueryBatcher.wait(job)
+        except MeshUnavailable as e:
+            if e.budget:
+                mesh.note_degraded()
+            mesh.note_fallback()
+            return None
+        except BaseException as e:
+            if isinstance(e, TaskCancelledException) or _request_scoped_error(e):
+                raise
+            # anything else (injected fault, batcher closed, device
+            # error) degrades to the per-shard path, which carries the
+            # partial-results / retry semantics
+            mesh.note_fallback()
+            return None
+        from ..search.executor import filter_source
+
+        source_spec = body.get("_source", True)
+        snap = td.snapshot
+        out_hits = []
+        for h in td.hits[from_ : from_ + size]:
+            entry: dict = {
+                "_index": self.name,
+                "_id": h.doc_id,
+                "_score": float(h.score),
+            }
+            src = snap.readers[h.shard].segments[h.segment].sources[h.local_doc]
+            filtered = filter_source(src, source_spec)
+            if filtered is not None and source_spec is not False:
+                entry["_source"] = filtered
+            out_hits.append(entry)
+        hits_obj: dict = {"max_score": td.max_score, "hits": out_hits}
+        if tth is True:
+            hits_obj["total"] = {"value": td.total, "relation": "eq"}
+        elif tth is not False:
+            limit = int(tth)
+            hits_obj["total"] = {
+                "value": min(td.total, limit),
+                "relation": "gte" if td.total > limit else "eq",
+            }
+        took = int((time.perf_counter() - t0) * 1000)
+        self.search_stats["query_total"] += 1
+        self.search_stats["query_time_in_millis"] += took
+        self.search_stats["fetch_total"] += 1
+        mesh.note_routed()
+        n = self.num_shards
+        return {
+            "took": took,
+            "timed_out": False,
+            "_shards": {"total": n, "successful": n, "skipped": 0,
+                        "failed": 0},
+            "hits": hits_obj,
+        }
+
     def search(
         self,
         body: Optional[dict] = None,
@@ -1753,6 +1897,13 @@ class IndexService:
                 **body,
                 "query": {"bool": {"must": [inner], "filter": [extra_filter]}},
             }
+        # mesh-parallel fast path: whole-index SPMD launch for the hot
+        # flat-plan shapes (pinned contexts stay on the shard path — a
+        # point-in-time reader must not see a rebuilt stack)
+        if pinned_executors is None:
+            mesh_resp = self._mesh_search(body, task=task)
+            if mesh_resp is not None:
+                return mesh_resp, None, []
         t0 = time.perf_counter()
         size = int(body.get("size", 10))
         from_ = int(body.get("from", 0))

@@ -11,11 +11,16 @@ covers correctness without them) or trips the breaker.
 
 Categories in use: `postings`/`doc_values`/`vectors`/`norms`/`dense`
 (index-resident uploads), `query_cache` (device filter bitsets, own
-LRU budget), and `serving` — the serving pipeline's persistent padded
+LRU budget), `serving` — the serving pipeline's persistent padded
 staging slabs (executor_jax.staging_slab: fixed-size rings of reusable
 query-operand buffers, sized to workers × (pipeline_depth + 1), charged
-once at first use and released with the executor). Per-category bytes
-surface as child breakers in `_nodes/stats` (child_breakers())."""
+once at first use and released with the executor) — and `mesh`, the
+mesh-parallel serving stacks (parallel/mesh_executor.py: per-snapshot
+device views of an index's live (shard, segment) entries, charged at
+build and released on generation rebuild/close; a stack that cannot fit
+DEGRADES the request to the single-device path instead of tripping the
+breaker). Per-category bytes surface as child breakers in
+`_nodes/stats` (child_breakers())."""
 
 from __future__ import annotations
 

@@ -48,6 +48,65 @@ def pipeline_depth() -> int:
     return max(1, v)
 
 
+# ---- mesh-parallel serving knobs (parallel/mesh_executor.py) ----
+#
+# ES_TPU_MESH:          "auto" (default: engage when >= 2 devices and the
+#                       index has >= 2 shards), "force" (route every
+#                       eligible group to the mesh, even on 1 device —
+#                       bench sweeps use this), or "off".
+# ES_TPU_MESH_DEVICES:  cap on how many devices the serving mesh uses
+#                       (default: all visible devices).
+# ES_TPU_MESH_DATA:     size of the ``data`` (query-batch) mesh axis
+#                       (default 1 — all devices go to the shards axis).
+#                       Must divide the BPAD query batch; invalid values
+#                       fall back to 1.
+# ES_TPU_MESH_T_MAX:    per-(entry, query) tile-slot cap for one mesh
+#                       text launch; groups that overflow fall back to
+#                       the single-device path (default 4096).
+
+MESH_MODE_ENV = "ES_TPU_MESH"
+MESH_DEVICES_ENV = "ES_TPU_MESH_DEVICES"
+MESH_DATA_ENV = "ES_TPU_MESH_DATA"
+MESH_T_MAX_ENV = "ES_TPU_MESH_T_MAX"
+MESH_T_MAX_DEFAULT = 4096
+
+
+def mesh_mode() -> str:
+    """Serving-mesh routing mode: "auto" | "force" | "off"."""
+    v = os.environ.get(MESH_MODE_ENV, "auto").strip().lower()
+    return v if v in ("auto", "force", "off") else "auto"
+
+
+def mesh_devices_cap() -> int:
+    """Max devices the serving mesh may use (0 = all)."""
+    raw = os.environ.get(MESH_DEVICES_ENV, "")
+    try:
+        v = int(raw) if raw else 0
+    except ValueError:
+        v = 0
+    return max(0, v)
+
+
+def mesh_data_axis() -> int:
+    """Requested size of the mesh ``data`` axis (>= 1)."""
+    raw = os.environ.get(MESH_DATA_ENV, "")
+    try:
+        v = int(raw) if raw else 1
+    except ValueError:
+        v = 1
+    return max(1, v)
+
+
+def mesh_t_max() -> int:
+    """Tile-slot cap per (entry, query) for one mesh text launch."""
+    raw = os.environ.get(MESH_T_MAX_ENV, "")
+    try:
+        v = int(raw) if raw else MESH_T_MAX_DEFAULT
+    except ValueError:
+        v = MESH_T_MAX_DEFAULT
+    return max(64, v)
+
+
 def peak_flops() -> float:
     """Accelerator peak FLOP/s for MFU accounting."""
     raw = os.environ.get(PEAK_FLOPS_ENV, "")

@@ -169,16 +169,26 @@ def _chunk_add_cnt(doc_ids, tfs, inv_norm, acc, cnt, ti, tw, tv):
     return acc, cnt
 
 
+def bm25_tile_contrib(rows_d, rows_t, w, valid, inv_norm, n_docs):
+    """The ONE BM25 tile-contribution formula, shared by the chunked
+    serving kernel and the mesh SPMD step (parallel/sharded.py) so the
+    two paths are float-identical by construction: per posting slot,
+    contribution = w - w / (1 + tf · inv_norm[doc]); invalid slots score
+    exactly 0 and target the n_docs overflow row. Returns (tgt, s)."""
+    tgt = jnp.where(valid, rows_d, n_docs)  # padding → overflow slot
+    inv = inv_norm[jnp.clip(rows_d, 0, max(n_docs - 1, 0))]
+    s = w - w / (jnp.float32(1.0) + rows_t.astype(jnp.float32) * inv)
+    return tgt, jnp.where(valid, s, 0.0)
+
+
 def _chunk_scores(doc_ids, tfs, inv_norm, ti, tw, tv):
     n_docs = inv_norm.shape[0]
     rows_d = doc_ids[ti]  # [B, TC, 128]
     rows_t = tfs[ti]
     valid = (rows_d >= 0) & tv[:, :, None]
-    tgt = jnp.where(valid, rows_d, n_docs)  # padding → overflow slot
-    inv = inv_norm[jnp.clip(rows_d, 0, max(n_docs - 1, 0))]
-    w = tw[:, :, None]
-    s = w - w / (jnp.float32(1.0) + rows_t.astype(jnp.float32) * inv)
-    s = jnp.where(valid, s, 0.0)
+    tgt, s = bm25_tile_contrib(
+        rows_d, rows_t, tw[:, :, None], valid, inv_norm, n_docs
+    )
     return tgt, s, valid
 
 

@@ -13,6 +13,15 @@ Two mesh axes:
   - ``shards``: partitions of the document space (ES data parallelism);
   - ``data``:   concurrent query batches (the ES coordinator serving many
                 searches at once — replica/ARS throughput scaling).
+
+Layouts need not be square or even divisible: when there are FEWER
+devices than shards, multiple shards fold onto one device via a leading
+stacked axis (the stacked arrays are padded to ``axis_size * fold`` rows
+and each device scores its ``fold`` local shards with a vmap before the
+ICI merge — see parallel/sharded.py). ``make_mesh`` therefore never
+rejects a layout for having too few devices; it returns the widest
+``shards`` axis the device set supports and callers size the stack with
+``fold_factor``.
 """
 
 from __future__ import annotations
@@ -32,16 +41,36 @@ def make_mesh(
     n_data: int = 1,
     devices: Optional[Sequence[jax.Device]] = None,
 ) -> Mesh:
-    """Builds a (data, shards) mesh over ``n_data * n_shards`` devices."""
+    """Builds a (data, shards) mesh for ``n_shards`` shard stacks.
+
+    The ``shards`` axis gets ``min(n_shards, len(devices) // n_data)``
+    devices — non-power-of-two shard counts use exactly that many
+    devices, and when fewer devices than shards are available the axis
+    is simply narrower and shards fold onto devices (``fold_factor``
+    per device) instead of raising.
+    """
     devices = list(devices if devices is not None else jax.devices())
-    need = n_shards * n_data
-    if len(devices) < need:
+    if n_shards < 1 or n_data < 1:
         raise ValueError(
-            f"mesh needs {need} devices (data={n_data} x shards={n_shards}), "
+            f"mesh axes must be >= 1 (data={n_data} x shards={n_shards})"
+        )
+    if len(devices) < n_data:
+        raise ValueError(
+            f"mesh needs at least {n_data} devices for the data axis, "
             f"have {len(devices)}"
         )
-    grid = np.asarray(devices[:need]).reshape(n_data, n_shards)
+    g = min(n_shards, len(devices) // n_data)
+    grid = np.asarray(devices[: n_data * g]).reshape(n_data, g)
     return Mesh(grid, (DATA_AXIS, SHARD_AXIS))
+
+
+def fold_factor(mesh: Mesh, n_entries: int) -> int:
+    """Shards (stacked entries) per device on the ``shards`` axis: the
+    stacked arrays must carry ``mesh.shape[SHARD_AXIS] * fold_factor``
+    rows (trailing rows padded empty) so each device holds an equal
+    fold of the stack."""
+    g = mesh.shape[SHARD_AXIS]
+    return max(1, -(-max(n_entries, 1) // g))
 
 
 def single_device_mesh() -> Mesh:

@@ -1,0 +1,740 @@
+"""MeshExecutor — the serving backend that puts every chip behind `_search`.
+
+Round-5 verdict: the production `_search` path scored shards one device
+at a time while the 8-device `shard_map` pipeline existed only as a
+dryrun. This module promotes it: a `MeshExecutor` materializes a stacked
+device-resident view of an index's LIVE shards (every (shard, segment)
+pair is one entry on the ``shards`` mesh axis, folded when there are
+more entries than devices) and executes whole same-plan query groups as
+ONE SPMD program — per-entry scoring + local top-k on each device, an
+`all_gather` + k-way merge over the ICI, `psum` totals — replacing S
+sequential kernel dispatches and S host round-trips with one packed
+download.
+
+Design contract (float-exactness with the single-device path):
+
+  * entries are (shard, segment) pairs in (shard asc, segment asc)
+    order, so per-entry scoring is the SAME computation the sequential
+    ChunkedScorer/segment kernels run — same block-aligned tilings
+    (ops/wand.get_tiling), same shard-level BM25 weights
+    (JaxExecutor._segment_weights via BlockMaxIndex), same
+    `w - w/(1 + tf·inv)` accumulation in the same tile order, same
+    live-doc masking — and the device merge's (score desc, slot asc)
+    order equals the coordinator's (score desc, shard asc, segment asc,
+    doc asc) tie-break. Only the merge topology changes.
+  * no pruning on the mesh path: totals come out exact (relation "eq"),
+    which is the sequential path's behavior whenever its capped-total
+    proof does not fire.
+
+Lifecycle: the stacked view is rebuilt LAZILY when any shard's engine
+`change_generation` moves (refresh/merge/delete); stale snapshots keep
+serving in-flight launches until the references die. Every stacked
+upload charges the HBM ledger's ``mesh`` category up front and the
+build DEGRADES to the single-device path (`MeshUnavailable`) instead of
+tripping the breaker when the budget cannot fit it.
+
+Knobs (common/settings.py): ES_TPU_MESH (auto|force|off),
+ES_TPU_MESH_DEVICES, ES_TPU_MESH_DATA, ES_TPU_MESH_T_MAX.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, List, NamedTuple, Optional, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from ..common.settings import (
+    mesh_data_axis,
+    mesh_devices_cap,
+    mesh_mode,
+    mesh_t_max,
+)
+from ..index.segment import INVALID_DOC, TILE
+from ..ops import scoring
+from .mesh import DATA_AXIS, SHARD_AXIS, fold_factor, make_mesh
+from .sharded import build_mesh_knn_step, build_mesh_text_step
+
+BPAD = scoring.BPAD
+
+# Process-wide SPMD launch lock: two batcher workers enqueueing mesh
+# programs concurrently could interleave per-device enqueue order
+# (worker A lands first on device 0, worker B first on device 1),
+# inverting the collectives' rendezvous order across devices — a
+# deadlock on any backend. Holding the lock around the ENQUEUE (the
+# jitted step call, which returns before execution completes) keeps
+# every device's queue identically ordered; execution and the packed
+# downloads still overlap freely.
+_LAUNCH_LOCK = threading.Lock()
+
+
+class MeshUnavailable(Exception):
+    """The mesh path cannot serve this group (no devices, HBM budget
+    breach, slot overflow, unsupported plan shape). Callers degrade to
+    the single-device sequential path — never an error surface.
+    ``budget`` marks the HBM-ledger degrade specifically."""
+
+    def __init__(self, msg: str, budget: bool = False):
+        super().__init__(msg)
+        self.budget = budget
+
+
+class MeshHit(NamedTuple):
+    score: float
+    shard: int
+    segment: int
+    local_doc: int
+    doc_id: str
+
+
+class MeshTopDocs(NamedTuple):
+    """One query's globally merged mesh result. `snapshot` pins the
+    reader generation the hits were scored against so the fetch phase
+    reads the same point-in-time sources."""
+
+    total: int
+    relation: str
+    max_score: Optional[float]
+    hits: List[MeshHit]
+    snapshot: "_MeshSnapshot"
+
+
+class _MeshSnapshot:
+    """One generation's stacked device view of the index's shards."""
+
+    def __init__(self, mesh, fold, entries, readers, executors, gens):
+        self.mesh = mesh
+        self.fold = fold
+        self.entries = entries  # [(sid, si)] in (shard, segment) asc order
+        self.readers = readers  # sid -> ShardReader
+        self.executors = executors  # sid -> JaxExecutor
+        self.gens = gens
+        g = mesh.shape[SHARD_AXIS]
+        self.e_pad = g * fold
+        self.n_docs_max = max(
+            (readers[sid].segments[si].num_docs for sid, si in entries),
+            default=1,
+        )
+        self.charges: List[Tuple[str, int]] = []
+        self.live = None  # bool[E_pad, Nmax] device (live ∧ in-range)
+        self.text: Dict[str, dict] = {}  # field -> stacked text arrays
+        self.knn: Dict[str, dict] = {}  # field -> stacked vector arrays
+        self.steps: Dict[tuple, object] = {}
+        self.closed = False
+
+    @property
+    def device_ids(self) -> Tuple[int, ...]:
+        return tuple(
+            getattr(d, "id", i)
+            for i, d in enumerate(self.mesh.devices.ravel())
+        )
+
+    def charge(self, nbytes: int) -> None:
+        from ..common.memory import hbm_ledger
+
+        if not hbm_ledger.would_fit(nbytes):
+            hbm_ledger.note_degraded()
+            raise MeshUnavailable(
+                f"mesh stack of {nbytes} bytes exceeds the HBM budget",
+                budget=True,
+            )
+        hbm_ledger.add("mesh", nbytes, breaker=False)
+        self.charges.append(("mesh", nbytes))
+
+    def release(self) -> None:
+        from ..common.memory import hbm_ledger
+
+        self.closed = True
+        charges, self.charges = self.charges, []
+        for cat, nbytes in charges:
+            hbm_ledger.release(cat, nbytes)
+
+
+class MeshExecutor:
+    """Mesh-parallel serving engine of ONE index (owned by IndexService).
+
+    The QueryBatcher routes same-plan query groups here (job kinds
+    ``mesh_match`` / ``mesh_serve`` / ``mesh_knn``): `dispatch_*`
+    launches the SPMD step asynchronously, `collect_*` performs the one
+    packed download and finishes the waiters — the same dispatch/collect
+    split (and pipeline depth) as the single-device families.
+    """
+
+    def __init__(self, service):
+        self.service = service
+        self._lock = threading.RLock()
+        self._snapshot: Optional[_MeshSnapshot] = None
+        self.stats = {
+            "routed": 0,  # requests served start-to-finish by the mesh
+            "launches": 0,  # SPMD programs dispatched
+            "jobs": 0,  # queries carried by those programs
+            "rebuilds": 0,  # snapshot rebuilds on generation bumps
+            "degraded": 0,  # HBM-budget degrades to single-device
+            "fallbacks": 0,  # routed requests that fell back mid-flight
+        }
+
+    # ---- routing predicate ----
+
+    def available(self) -> bool:
+        mode = mesh_mode()
+        if mode == "off":
+            return False
+        svc = self.service
+        if svc.routing is not None:  # shards on other nodes: no stack
+            return False
+        if str(svc.settings.get("search.backend")) != "jax":
+            return False
+        if mode == "force":
+            return True
+        try:
+            n_dev = len(self._devices())
+        except Exception:  # pragma: no cover - no jax backend
+            return False
+        return n_dev >= 2 and svc.num_shards >= 2
+
+    def _devices(self):
+        devs = list(jax.devices())
+        cap = mesh_devices_cap()
+        return devs[:cap] if cap else devs
+
+    @property
+    def device_ids(self) -> Tuple[int, ...]:
+        snap = self._snapshot
+        if snap is not None and not snap.closed:
+            return snap.device_ids
+        return tuple(
+            getattr(d, "id", i) for i, d in enumerate(self._devices())
+        )
+
+    # ---- snapshot lifecycle ----
+
+    def _gens(self) -> tuple:
+        svc = self.service
+        return tuple(
+            (sid, svc.local_shard(sid).change_generation)
+            for sid in range(svc.num_shards)
+        )
+
+    def fresh(self) -> bool:
+        snap = self._snapshot
+        return snap is not None and not snap.closed and snap.gens == self._gens()
+
+    def ensure_snapshot(self) -> _MeshSnapshot:
+        gens = self._gens()
+        snap = self._snapshot
+        if snap is not None and not snap.closed and snap.gens == gens:
+            return snap
+        with self._lock:
+            snap = self._snapshot
+            gens = self._gens()
+            if snap is not None and not snap.closed and snap.gens == gens:
+                return snap
+            new = self._build_snapshot(gens)
+            old, self._snapshot = self._snapshot, new
+            if old is not None:
+                self.stats["rebuilds"] += 1
+                # in-flight launches hold their own snapshot reference;
+                # the ledger charge is released now, the arrays die with
+                # the last reference (same contract as executor close)
+                old.release()
+            return new
+
+    def _build_snapshot(self, gens) -> _MeshSnapshot:
+        svc = self.service
+        readers = {}
+        executors = {}
+        entries = []
+        for sid in range(svc.num_shards):
+            shard = svc.local_shard(sid)
+            ex = svc._executor(shard)
+            from ..search.executor import NumpyExecutor
+
+            if isinstance(ex, NumpyExecutor):
+                raise MeshUnavailable("numpy backend shard")
+            executors[sid] = ex
+            readers[sid] = ex.reader
+            for si, seg in enumerate(ex.reader.segments):
+                if seg.num_docs > 0:
+                    entries.append((sid, si))
+        if not entries:
+            raise MeshUnavailable("index has no live segments")
+        devices = self._devices()
+        if not devices:
+            raise MeshUnavailable("no devices")
+        n_data = mesh_data_axis()
+        if BPAD % n_data or n_data > len(devices):
+            n_data = 1
+        mesh = make_mesh(len(entries), n_data=n_data, devices=devices)
+        fold = fold_factor(mesh, len(entries))
+        snap = _MeshSnapshot(mesh, fold, entries, readers, executors, gens)
+        # live ∧ in-range mask, shared by every family
+        live = np.zeros((snap.e_pad, snap.n_docs_max), bool)
+        for e, (sid, si) in enumerate(entries):
+            n = readers[sid].segments[si].num_docs
+            l = readers[sid].live_docs[si]
+            live[e, :n] = True if l is None else l
+        snap.charge(live.nbytes)
+        snap.live = jax.device_put(
+            live, NamedSharding(mesh, P(SHARD_AXIS, None))
+        )
+        return snap
+
+    def close(self) -> None:
+        with self._lock:
+            snap, self._snapshot = self._snapshot, None
+            if snap is not None:
+                snap.release()
+
+    # ---- stacked field views (lazy, per snapshot) ----
+
+    def _text_view(self, snap: _MeshSnapshot, field: str) -> dict:
+        view = snap.text.get(field)
+        if view is not None:
+            return view
+        with self._lock:
+            view = snap.text.get(field)
+            if view is not None:
+                return view
+            bmxs = []
+            tilings = []
+            t_max = 1
+            for sid, si in snap.entries:
+                bmx = snap.executors[sid].block_index(si, field)
+                bmxs.append(bmx)
+                tilings.append(None if bmx is None else bmx.tiling)
+                if bmx is not None:
+                    t_max = max(t_max, int(bmx.tiling.doc_ids.shape[0]))
+            doc_ids = np.full(
+                (snap.e_pad, t_max, TILE), INVALID_DOC, np.int32
+            )
+            tfs = np.zeros((snap.e_pad, t_max, TILE), np.int32)
+            inv = np.zeros((snap.e_pad, snap.n_docs_max), np.float32)
+            for e, (sid, si) in enumerate(snap.entries):
+                tiling = tilings[e]
+                if tiling is not None:
+                    nt = int(tiling.doc_ids.shape[0])
+                    doc_ids[e, :nt] = np.asarray(tiling.doc_ids)
+                    tfs[e, :nt] = np.asarray(tiling.tfs)
+                n = snap.readers[sid].segments[si].num_docs
+                ex = snap.executors[sid]
+                inv[e, :n] = np.asarray(ex._inv_norm(si, field, n))
+            nbytes = doc_ids.nbytes + tfs.nbytes + inv.nbytes
+            snap.charge(nbytes)
+            sh3 = NamedSharding(snap.mesh, P(SHARD_AXIS, None, None))
+            sh2 = NamedSharding(snap.mesh, P(SHARD_AXIS, None))
+            view = {
+                "doc_ids": jax.device_put(doc_ids, sh3),
+                "tfs": jax.device_put(tfs, sh3),
+                "inv_norm": jax.device_put(inv, sh2),
+                "bmxs": bmxs,
+            }
+            snap.text[field] = view
+            return view
+
+    def _knn_view(self, snap: _MeshSnapshot, field: str) -> dict:
+        view = snap.knn.get(field)
+        if view is not None:
+            return view
+        with self._lock:
+            view = snap.knn.get(field)
+            if view is not None:
+                return view
+            mats = []
+            for sid, si in snap.entries:
+                vf = snap.readers[sid].segments[si].vectors.get(field)
+                if vf is None:
+                    mats.append(None)
+                    continue
+                mat = (
+                    vf.unit_vectors
+                    if vf.similarity == "cosine" and vf.unit_vectors is not None
+                    else vf.vectors
+                )
+                mats.append((mat, vf))
+            present = [m for m in mats if m is not None]
+            if not present:
+                raise MeshUnavailable(f"no entry has vector field [{field}]")
+            dims = int(present[0][0].shape[1])
+            similarity = present[0][1].similarity
+            dtype = np.result_type(*[m[0].dtype for m in present])
+            vectors = np.zeros((snap.e_pad, snap.n_docs_max, dims), dtype)
+            cand = np.zeros((snap.e_pad, snap.n_docs_max), bool)
+            n_per_entry = np.zeros(snap.e_pad, np.int64)
+            live_host = np.asarray(jax.device_get(snap.live))
+            for e, (sid, si) in enumerate(snap.entries):
+                got = mats[e]
+                if got is None:
+                    continue
+                mat, vf = got
+                if int(mat.shape[1]) != dims or vf.similarity != similarity:
+                    raise MeshUnavailable(
+                        f"vector field [{field}] has mixed dims/similarity"
+                    )
+                n = snap.readers[sid].segments[si].num_docs
+                vectors[e, :n] = mat
+                cand[e, :n] = vf.exists & live_host[e, :n]
+                n_per_entry[e] = n
+            snap.charge(vectors.nbytes + cand.nbytes)
+            sh3 = NamedSharding(snap.mesh, P(SHARD_AXIS, None, None))
+            sh2 = NamedSharding(snap.mesh, P(SHARD_AXIS, None))
+            view = {
+                "vectors": jax.device_put(vectors, sh3),
+                "cand": jax.device_put(cand, sh2),
+                "dims": dims,
+                "similarity": similarity,
+                "n_per_entry": n_per_entry,
+            }
+            snap.knn[field] = view
+            return view
+
+    # ---- compiled step cache ----
+
+    def _text_step(self, snap, fields, kb, t_shapes, with_cnt,
+                   count_signed, combine, tie):
+        key = ("text", fields, kb, t_shapes, with_cnt, count_signed,
+               combine, tie)
+        step = snap.steps.get(key)
+        if step is None:
+            with self._lock:
+                step = snap.steps.get(key)
+                if step is None:
+                    views = [self._text_view(snap, f) for f in fields]
+                    step = build_mesh_text_step(
+                        snap.mesh,
+                        [v["doc_ids"] for v in views],
+                        [v["tfs"] for v in views],
+                        [v["inv_norm"] for v in views],
+                        snap.live,
+                        kb,
+                        with_cnt=with_cnt,
+                        count_signed=count_signed,
+                        combine=combine,
+                        tie=tie,
+                    )
+                    snap.steps[key] = step
+        return step
+
+    def _knn_step(self, snap, field, kc):
+        key = ("knn", field, kc)
+        step = snap.steps.get(key)
+        if step is None:
+            with self._lock:
+                step = snap.steps.get(key)
+                if step is None:
+                    view = self._knn_view(snap, field)
+                    step = build_mesh_knn_step(
+                        snap.mesh,
+                        view["vectors"],
+                        view["cand"],
+                        view["similarity"],
+                        kc,
+                    )
+                    snap.steps[key] = step
+        return step
+
+    # ---- plan packing (host side; mirrors the sequential builders) ----
+
+    def _pack_match(self, snap, view, jobs, t_cap):
+        """Per-(entry, job) tile plans in EXACTLY the sequential
+        _run_group order: BlockMaxIndex.plan term order, all tiles
+        essential (no pruning on the mesh path)."""
+        e_pad = snap.e_pad
+        lists: List[List[Tuple[np.ndarray, np.ndarray]]] = []
+        t_max = 1
+        slots = 0
+        for e in range(len(snap.entries)):
+            bmx = view["bmxs"][e]
+            row = []
+            for j in jobs:
+                if bmx is None:
+                    row.append((None, None))
+                    continue
+                plans = bmx.plan(list(j.plan.terms), j.plan.boost)
+                tl = [
+                    np.arange(
+                        p.tile_start, p.tile_start + p.tile_count,
+                        dtype=np.int64,
+                    )
+                    for p in plans
+                ]
+                wl = [
+                    np.full(p.tile_count, p.weight, np.float32)
+                    for p in plans
+                ]
+                ti = np.concatenate(tl) if tl else np.empty(0, np.int64)
+                tw = np.concatenate(wl) if wl else np.empty(0, np.float32)
+                if len(ti) > t_cap:
+                    raise MeshUnavailable(
+                        f"match plan overflows mesh tile cap [{t_cap}]"
+                    )
+                t_max = max(t_max, len(ti))
+                slots += len(ti)
+                row.append((ti, tw))
+            lists.append(row)
+        T = scoring.next_bucket(t_max)
+        ti_a = np.zeros((e_pad, BPAD, T), np.int32)
+        tw_a = np.zeros((e_pad, BPAD, T), np.float32)
+        tv_a = np.zeros((e_pad, BPAD, T), bool)
+        for e, row in enumerate(lists):
+            for ji, (ti, tw) in enumerate(row):
+                if ti is None or not len(ti):
+                    continue
+                ti_a[e, ji, : len(ti)] = ti
+                tw_a[e, ji, : len(ti)] = tw
+                tv_a[e, ji, : len(ti)] = True
+        return ti_a, tw_a, tv_a, T, slots
+
+    def _pack_serve_field(self, snap, view, jobs, field, t_cap):
+        """One field's signed-weight tile plans (the MultiFusedScorer
+        weight-sign convention via JaxExecutor.fused_plan_field's float
+        path: w = weights[tid] * boost * term_boost, negated when the
+        term only scores)."""
+        e_pad = snap.e_pad
+        lists = []
+        t_max = 1
+        slots = 0
+        for e in range(len(snap.entries)):
+            bmx = view["bmxs"][e]
+            row = []
+            for j in jobs:
+                group = next(
+                    g for g in j.plan.groups if g.field == field
+                )
+                if bmx is None:
+                    row.append((None, None))
+                    continue
+                tiling = bmx.tiling
+                tl: List[np.ndarray] = []
+                wl: List[np.ndarray] = []
+                for t, tb, counted in group.terms:
+                    tid = bmx._term_index.get(t)
+                    if tid is None or not int(tiling.term_tile_count[tid]):
+                        continue
+                    w = float(bmx.weights[tid]) * j.plan.boost * tb
+                    if w < 0.0:
+                        raise MeshUnavailable("negative term weight")
+                    if w == 0.0:
+                        w = 1e-30
+                    if not counted:
+                        w = -w
+                    s0 = int(tiling.term_tile_start[tid])
+                    c = int(tiling.term_tile_count[tid])
+                    tl.append(np.arange(s0, s0 + c, dtype=np.int64))
+                    wl.append(np.full(c, w, np.float32))
+                ti = np.concatenate(tl) if tl else np.empty(0, np.int64)
+                tw = np.concatenate(wl) if wl else np.empty(0, np.float32)
+                if len(ti) > t_cap:
+                    raise MeshUnavailable(
+                        f"serve plan overflows mesh tile cap [{t_cap}]"
+                    )
+                t_max = max(t_max, len(ti))
+                slots += len(ti)
+                row.append((ti, tw))
+            lists.append(row)
+        T = scoring.next_bucket(t_max)
+        ti_a = np.zeros((e_pad, BPAD, T), np.int32)
+        tw_a = np.zeros((e_pad, BPAD, T), np.float32)
+        tv_a = np.zeros((e_pad, BPAD, T), bool)
+        for e, row in enumerate(lists):
+            for ji, (ti, tw) in enumerate(row):
+                if ti is None or not len(ti):
+                    continue
+                ti_a[e, ji, : len(ti)] = ti
+                tw_a[e, ji, : len(ti)] = tw
+                tv_a[e, ji, : len(ti)] = True
+        return ti_a, tw_a, tv_a, T, slots
+
+    # ---- dispatch / collect (batcher worker entry points) ----
+
+    def dispatch_match(self, jobs, kb: int):
+        snap = self.ensure_snapshot()
+        field = jobs[0].plan.field
+        view = self._text_view(snap, field)
+        ti, tw, tv, T, slots = self._pack_match(snap, view, jobs, mesh_t_max())
+        msm = np.ones(BPAD, np.int32)
+        msm[: len(jobs)] = [j.plan.msm for j in jobs]
+        with_cnt = any(j.plan.msm > 1 for j in jobs)
+        step = self._text_step(
+            snap, (field,), kb, (T,), with_cnt, False, "sum", 0.0
+        )
+        with _LAUNCH_LOCK:
+            out = step((ti,), (tw,), (tv,), msm)
+        with self._lock:
+            self.stats["launches"] += 1
+            self.stats["jobs"] += len(jobs)
+        flops = scoring.text_plan_flops(slots, 0, 0)
+        return {"snap": snap, "out": out, "flops": flops}
+
+    def dispatch_serve(self, jobs, kb: int):
+        snap = self.ensure_snapshot()
+        plan0 = jobs[0].plan
+        fields = plan0.fields
+        t_cap = mesh_t_max()
+        ti_f, tw_f, tv_f, t_shapes = [], [], [], []
+        slots = 0
+        for f in fields:
+            view = self._text_view(snap, f)
+            ti, tw, tv, T, s = self._pack_serve_field(
+                snap, view, jobs, f, t_cap
+            )
+            ti_f.append(ti)
+            tw_f.append(tw)
+            tv_f.append(tv)
+            t_shapes.append(T)
+            slots += s
+        msm = np.ones(BPAD, np.int32)
+        msm[: len(jobs)] = [j.plan.msm for j in jobs]
+        step = self._text_step(
+            snap, fields, kb, tuple(t_shapes), True, True,
+            plan0.combine, float(plan0.tie),
+        )
+        with _LAUNCH_LOCK:
+            out = step(tuple(ti_f), tuple(tw_f), tuple(tv_f), msm)
+        with self._lock:
+            self.stats["launches"] += 1
+            self.stats["jobs"] += len(jobs)
+        flops = scoring.text_plan_flops(slots, 0, 0)
+        return {"snap": snap, "out": out, "flops": flops}
+
+    def collect_match(self, jobs, pend):
+        self._collect_text(jobs, pend)
+
+    collect_serve = collect_match
+
+    def _collect_text(self, jobs, pend):
+        snap = pend["snap"]
+        ms, me, md, tot = jax.device_get(pend["out"])
+        for ji, j in enumerate(jobs):
+            finite = np.isfinite(ms[ji])
+            hits = [
+                self._hit(snap, float(s), int(e), int(d))
+                for s, e, d in zip(
+                    ms[ji][finite][: j.k],
+                    me[ji][finite][: j.k],
+                    md[ji][finite][: j.k],
+                )
+            ]
+            j.result = MeshTopDocs(
+                total=int(tot[ji]),
+                relation="eq",
+                max_score=hits[0].score if hits else None,
+                hits=hits,
+                snapshot=snap,
+            )
+            j.event.set()
+
+    def dispatch_knn(self, jobs, kb: int):
+        snap = self.ensure_snapshot()
+        field = jobs[0].plan.field
+        if any(j.plan.boost <= 0.0 for j in jobs):
+            # a zero/negative boost would reorder under the
+            # post-selection multiply — same host-merge rule as the
+            # sequential collect
+            raise MeshUnavailable("non-positive knn boost")
+        view = self._knn_view(snap, field)
+        dims = view["dims"]
+        n_max = snap.n_docs_max
+        q = np.zeros((BPAD, dims), np.float32)
+        nc = np.zeros((snap.e_pad, BPAD), np.int32)
+        max_nc = 1
+        for ji, j in enumerate(jobs):
+            if len(j.plan.vector) != dims:
+                raise MeshUnavailable("query vector dims mismatch")
+            q[ji] = np.asarray(j.plan.vector, np.float32)
+            for e in range(len(snap.entries)):
+                n = int(view["n_per_entry"][e])
+                if n:
+                    nc[e, ji] = min(j.plan.num_candidates, n)
+            max_nc = max(max_nc, min(j.plan.num_candidates, n_max))
+        kc = min(max(scoring.next_bucket(max_nc, 16), 16), n_max)
+        step = self._knn_step(snap, field, kc)
+        with _LAUNCH_LOCK:
+            out = step(q, nc)
+        with self._lock:
+            self.stats["launches"] += 1
+            self.stats["jobs"] += len(jobs)
+        total_docs = int(view["n_per_entry"].sum())
+        flops = scoring.knn_flops(len(jobs), total_docs, dims)
+        return {"snap": snap, "out": out, "flops": flops}
+
+    def collect_knn(self, jobs, pend):
+        from ..common.faults import faults
+
+        faults.check("knn.collect", jobs=len(jobs), mesh=1)
+        snap = pend["snap"]
+        ms, me, md, counts = jax.device_get(pend["out"])
+        shard_of = [sid for sid, _si in snap.entries]
+        n_entries = len(shard_of)
+        for ji, j in enumerate(jobs):
+            boost = j.plan.boost
+            # the sequential path cuts at k PER SHARD (each shard's
+            # page is its top min(plan.k, size) after the nc rank cut)
+            # before the coordinator's global page: walk the ordered
+            # stream applying the same per-shard caps
+            cap_shard = min(j.plan.k, j.k)
+            taken: Dict[int, int] = {}
+            hits: List[MeshHit] = []
+            row_s, row_e, row_d = ms[ji], me[ji], md[ji]
+            for pos in range(len(row_s)):
+                s = row_s[pos]
+                if not np.isfinite(s):
+                    break  # score-desc stream: only -inf padding left
+                e = int(row_e[pos])
+                if e >= n_entries:  # pragma: no cover - padded entry
+                    continue
+                sid = shard_of[e]
+                got = taken.get(sid, 0)
+                if got >= cap_shard:
+                    continue
+                taken[sid] = got + 1
+                hits.append(
+                    self._hit(snap, float(s) * boost, e, int(row_d[pos]))
+                )
+                if len(hits) >= j.k:
+                    break
+            # the sequential coordinator's total is Σ per-shard totals,
+            # each capped at k — reproduce it from the per-entry counts
+            per_shard: Dict[int, int] = {}
+            for e, sid in enumerate(shard_of):
+                per_shard[sid] = per_shard.get(sid, 0) + int(counts[ji, e])
+            total = sum(min(c, j.plan.k) for c in per_shard.values())
+            j.result = MeshTopDocs(
+                total=total,
+                relation="eq",
+                max_score=hits[0].score if hits else None,
+                hits=hits,
+                snapshot=snap,
+            )
+            j.event.set()
+
+    def _hit(self, snap, score, entry, doc) -> MeshHit:
+        sid, si = snap.entries[entry]
+        return MeshHit(
+            score=score,
+            shard=sid,
+            segment=si,
+            local_doc=doc,
+            doc_id=snap.readers[sid].segments[si].doc_ids[doc],
+        )
+
+    def note_routed(self) -> None:
+        with self._lock:
+            self.stats["routed"] += 1
+
+    def note_fallback(self) -> None:
+        with self._lock:
+            self.stats["fallbacks"] += 1
+
+    def note_degraded(self) -> None:
+        with self._lock:
+            self.stats["degraded"] += 1
+
+    def stats_snapshot(self) -> dict:
+        with self._lock:
+            out = dict(self.stats)
+        snap = self._snapshot
+        out["entries"] = len(snap.entries) if snap and not snap.closed else 0
+        out["devices"] = len(self.device_ids)
+        return out

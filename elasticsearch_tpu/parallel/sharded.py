@@ -22,10 +22,28 @@ results already doc-ascending among ties, so the merged ordering matches.
 
 Totals (`hits.total.value`) reduce with a `psum` over ``shards`` — the
 analog of summing each shard's `QuerySearchResult.totalHits`.
+
+Shard folding: the stacked axis may carry MORE entries than the mesh's
+``shards`` axis has devices — entries are padded to ``axis * fold`` rows
+and each device vmaps over its ``fold`` local entries before the ICI
+merge, so non-power-of-two layouts and fewer-devices-than-shards both
+work (parallel/mesh.py fold_factor).
+
+Two families of step builders live here:
+
+  * ``build_sharded_bm25_step`` / ``build_sharded_knn_step`` — the
+    original ShardedIndex demo steps (driver dryrun, tests);
+  * ``build_mesh_text_step`` / ``build_mesh_knn_step`` — the SERVING
+    steps behind `parallel/mesh_executor.MeshExecutor`: stacked entries
+    are (shard, segment) pairs so per-entry scoring reproduces the
+    sequential per-segment kernels float-exactly (same tile plans, same
+    scatter order, same live-mask semantics), and only the merge moves
+    from the host to the ICI.
 """
 
 from __future__ import annotations
 
+import functools
 from dataclasses import dataclass
 from typing import Dict, List, NamedTuple, Optional, Sequence, Tuple
 
@@ -36,8 +54,8 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..index.segment import INVALID_DOC, TILE, Segment
 from ..models import bm25
-from ..ops.scoring import _score_tiles_inner, next_bucket
-from .mesh import DATA_AXIS, SHARD_AXIS
+from ..ops.scoring import _score_tiles_inner, bm25_tile_contrib, next_bucket
+from .mesh import DATA_AXIS, SHARD_AXIS, fold_factor
 
 if hasattr(jax, "shard_map"):
     shard_map = jax.shard_map
@@ -76,7 +94,9 @@ class ShardedIndex:
     distinct chips"). Each shard is an independent Segment (its own term
     dictionary, norms, stats — exactly like an ES shard is a full Lucene
     index); this class pads them to a common dense shape and lays the
-    stack out over the ``shards`` mesh axis.
+    stack out over the ``shards`` mesh axis. With fewer devices than
+    shards the stack is padded to ``axis * fold`` rows and each device
+    scores its fold of shards (mesh.py fold_factor).
     """
 
     def __init__(
@@ -88,15 +108,19 @@ class ShardedIndex:
         b: float = bm25.DEFAULT_B,
         vector_field: Optional[str] = None,
     ):
-        if mesh.shape[SHARD_AXIS] != len(segments):
+        g = mesh.shape[SHARD_AXIS]
+        self.fold = fold_factor(mesh, len(segments))
+        if g * self.fold < len(segments):
             raise ValueError(
                 f"{len(segments)} shards but mesh '{SHARD_AXIS}' axis is "
-                f"{mesh.shape[SHARD_AXIS]}"
+                f"{g} (fold {self.fold})"
             )
         self.mesh = mesh
         self.segments = list(segments)
         self.field = field
         self.n_shards = len(segments)
+        # stacked rows: shards padded to an equal fold per device
+        self.n_stack = g * self.fold
         self.k1 = k1
         self.b = b
 
@@ -130,7 +154,7 @@ class ShardedIndex:
         self.n_tiles_max = n_tiles_max
 
         # ---- stacked, padded device arrays sharded over 'shards' ----
-        S = self.n_shards
+        S = self.n_stack
         doc_ids = np.full((S, n_tiles_max, TILE), INVALID_DOC, np.int32)
         tfs = np.zeros((S, n_tiles_max, TILE), np.int32)
         inv_norm = np.zeros((S, n_docs_max), np.float32)
@@ -195,7 +219,8 @@ class ShardedIndex:
         Returns (tile_idx[S,B,T], tile_w[S,B,T], tile_v[S,B,T], msm[B]).
         Each shard resolves the same terms against its own dictionary and
         stats — the analog of per-shard `Weight` creation in
-        `SearchService.executeQueryPhase`.
+        `SearchService.executeQueryPhase`. S is the padded stack size
+        (folded layouts score all-invalid padding rows to -inf).
         """
         B = len(term_lists)
         plans: List[List[Tuple[List[int], List[float]]]] = []
@@ -220,11 +245,11 @@ class ShardedIndex:
                 shard_plans.append((idxs, ws))
             plans.append(shard_plans)
         T = bucket or next_bucket(t_max)
-        S = self.n_shards
+        S = self.n_stack
         tile_idx = np.zeros((S, B, T), np.int32)
         tile_w = np.zeros((S, B, T), np.float32)
         tile_v = np.zeros((S, B, T), bool)
-        for si in range(S):
+        for si in range(self.n_shards):
             for bi, (idxs, ws) in enumerate(plans[si]):
                 t = len(idxs)
                 tile_idx[si, bi, :t] = idxs
@@ -238,47 +263,61 @@ class ShardedIndex:
         return tile_idx, tile_w, tile_v, msm
 
 
+def _merge_gathered(gs, gd, k: int):
+    """ICI merge epilogue shared by every step: gathered per-entry pages
+    [G, F, Bd, kk] → (scores[Bd, K], entry[Bd, K], doc[Bd, K]). Slots
+    are laid out entry-major (shard/segment asc) with per-entry ranks
+    already doc-ascending among ties, and lax.top_k keeps the lowest
+    slot among equals — the coordinator's (score desc, shard asc, rank
+    asc) merge order, on device."""
+    G, F, Bd, kk = gs.shape
+    slots = G * F * kk
+    gs2 = jnp.transpose(gs, (2, 0, 1, 3)).reshape(Bd, slots)
+    gd2 = jnp.transpose(gd, (2, 0, 1, 3)).reshape(Bd, slots)
+    K = min(k, slots)
+    ms, mi = jax.lax.top_k(gs2, K)
+    entry_of_slot = jnp.arange(slots, dtype=jnp.int32) // kk
+    me = entry_of_slot[mi]
+    md = jnp.take_along_axis(gd2, mi, axis=1)
+    return ms, me, md
+
+
 def build_sharded_bm25_step(index: ShardedIndex, k: int):
     """Jitted SPMD search step: per-shard score+top-k, ICI merge.
 
     fn(tile_idx[S,B,T], tile_w, tile_v, msm[B]) -> ShardedTopK with the
     query batch B sharded over the ``data`` axis and postings over
     ``shards``; the returned top-k is replicated over ``shards`` and
-    sharded over ``data``.
+    sharded over ``data``. S is the padded stack (fold per device).
     """
     mesh = index.mesh
     n_docs = index.n_docs_max
 
     def body(doc_ids, tfs, inv_norm, doc_base, tile_idx, tile_w, tile_v, msm):
-        # block shapes: doc_ids[1,T_all,128], tile_idx[1,Bd,T], msm[Bd]
-        doc_ids = doc_ids[0]
-        tfs = tfs[0]
-        inv_norm = inv_norm[0]
-        base = doc_base[0]
-        rows_doc = doc_ids[tile_idx[0]]  # [Bd, T, 128]
-        rows_tf = tfs[tile_idx[0]]
+        # block shapes: doc_ids[F,T_all,128], tile_idx[F,Bd,T], msm[Bd]
+        def entry(doc_ids_e, tfs_e, inv_e, base_e, ti_e, tw_e, tv_e):
+            rows_doc = doc_ids_e[ti_e]  # [Bd, T, 128]
+            rows_tf = tfs_e[ti_e]
 
-        def one(rd, rt, w, v, m):
-            scores, cnt = _score_tiles_inner(rd, rt, w, v, inv_norm, n_docs)
-            mask = cnt >= jnp.maximum(m, 1)
-            masked = jnp.where(mask, scores, -jnp.inf)
-            s, d = jax.lax.top_k(masked, min(k, n_docs))
-            return s, d, mask.sum().astype(jnp.int32)
+            def one(rd, rt, w, v, m):
+                scores, cnt = _score_tiles_inner(rd, rt, w, v, inv_e, n_docs)
+                mask = cnt >= jnp.maximum(m, 1)
+                masked = jnp.where(mask, scores, -jnp.inf)
+                s, d = jax.lax.top_k(masked, min(k, n_docs))
+                return s, d, mask.sum().astype(jnp.int32)
 
-        s, d, t = jax.vmap(one)(
-            rows_doc, rows_tf, tile_w[0], tile_v[0], msm
-        )  # [Bd,k'] [Bd,k'] [Bd]
-        kk = s.shape[1]
-        gdoc = jnp.where(s > -jnp.inf, d + base, -1)
+            s, d, t = jax.vmap(one)(rows_doc, rows_tf, tw_e, tv_e, msm)
+            gdoc = jnp.where(s > -jnp.inf, d + base_e, -1)
+            return s, gdoc, t
+
+        s, gdoc, t = jax.vmap(entry)(
+            doc_ids, tfs, inv_norm, doc_base, tile_idx, tile_w, tile_v
+        )  # [F,Bd,k'] [F,Bd,k'] [F,Bd]
         # ---- shard merge over ICI (the coordinator reduce) ----
-        gs = jax.lax.all_gather(s, SHARD_AXIS)  # [S, Bd, k']
+        gs = jax.lax.all_gather(s, SHARD_AXIS)  # [G, F, Bd, k']
         gd = jax.lax.all_gather(gdoc, SHARD_AXIS)
-        S_ = gs.shape[0]
-        gs = jnp.transpose(gs, (1, 0, 2)).reshape(-1, S_ * kk)  # [Bd, S*k']
-        gd = jnp.transpose(gd, (1, 0, 2)).reshape(-1, S_ * kk)
-        ms, mi = jax.lax.top_k(gs, min(k, S_ * kk))
-        md = jnp.take_along_axis(gd, mi, axis=1)
-        totals = jax.lax.psum(t, SHARD_AXIS)
+        ms, _, md = _merge_gathered(gs, gd, k)
+        totals = jax.lax.psum(t.sum(axis=0), SHARD_AXIS)
         return ms, md, totals
 
     p_post3 = P(SHARD_AXIS, None, None)
@@ -324,39 +363,39 @@ def build_sharded_knn_step(index: ShardedIndex, k: int, similarity: str = "cosin
     mesh = index.mesh
 
     def body(vectors, exists, doc_base, queries):
-        vectors = vectors[0]  # [N, dims]
-        exists = exists[0]
-        base = doc_base[0]
         q = queries
         if similarity == "cosine":
             qn = jnp.linalg.norm(q, axis=1, keepdims=True)
             q = q / jnp.where(qn == 0, 1.0, qn)
-        dots = q @ vectors.T  # [Bd, N] — MXU
-        if similarity in ("cosine", "dot_product"):
-            scores = (1.0 + dots) / 2.0
-        elif similarity == "l2_norm":
-            q2 = jnp.sum(q * q, axis=1, keepdims=True)
-            v2 = jnp.sum(vectors * vectors, axis=1)[None, :]
-            scores = 1.0 / (1.0 + jnp.maximum(q2 + v2 - 2.0 * dots, 0.0))
-        elif similarity == "max_inner_product":
-            scores = jnp.where(dots < 0, 1.0 / (1.0 - dots), dots + 1.0)
-        else:
-            raise ValueError(f"unknown similarity [{similarity}]")
-        scores = jnp.where(exists[None, :], scores.astype(jnp.float32), -jnp.inf)
-        kk = min(k, scores.shape[1])
-        s, d = jax.lax.top_k(scores, kk)
-        gdoc = jnp.where(s > -jnp.inf, d + base, -1)
+
+        def entry(vectors_e, exists_e, base_e):
+            dots = q @ vectors_e.T  # [Bd, N] — MXU
+            if similarity in ("cosine", "dot_product"):
+                scores = (1.0 + dots) / 2.0
+            elif similarity == "l2_norm":
+                q2 = jnp.sum(q * q, axis=1, keepdims=True)
+                v2 = jnp.sum(vectors_e * vectors_e, axis=1)[None, :]
+                scores = 1.0 / (1.0 + jnp.maximum(q2 + v2 - 2.0 * dots, 0.0))
+            elif similarity == "max_inner_product":
+                scores = jnp.where(dots < 0, 1.0 / (1.0 - dots), dots + 1.0)
+            else:
+                raise ValueError(f"unknown similarity [{similarity}]")
+            scores = jnp.where(
+                exists_e[None, :], scores.astype(jnp.float32), -jnp.inf
+            )
+            kk = min(k, scores.shape[1])
+            s, d = jax.lax.top_k(scores, kk)
+            gdoc = jnp.where(s > -jnp.inf, d + base_e, -1)
+            t = jnp.sum(exists_e).astype(jnp.int32) * jnp.ones(
+                s.shape[0], jnp.int32
+            )
+            return s, gdoc, t
+
+        s, gdoc, t = jax.vmap(entry)(vectors, exists, doc_base)
         gs = jax.lax.all_gather(s, SHARD_AXIS)
         gd = jax.lax.all_gather(gdoc, SHARD_AXIS)
-        S_ = gs.shape[0]
-        gs = jnp.transpose(gs, (1, 0, 2)).reshape(-1, S_ * kk)
-        gd = jnp.transpose(gd, (1, 0, 2)).reshape(-1, S_ * kk)
-        ms, mi = jax.lax.top_k(gs, min(k, S_ * kk))
-        md = jnp.take_along_axis(gd, mi, axis=1)
-        totals = jax.lax.psum(
-            jnp.sum(exists).astype(jnp.int32) * jnp.ones(s.shape[0], jnp.int32),
-            SHARD_AXIS,
-        )
+        ms, _, md = _merge_gathered(gs, gd, k)
+        totals = jax.lax.psum(t.sum(axis=0), SHARD_AXIS)
         return ms, md, totals
 
     fn = shard_map(
@@ -376,6 +415,251 @@ def build_sharded_knn_step(index: ShardedIndex, k: int, similarity: str = "cosin
     def step(queries):
         s, d, t = fn(index.vectors, index.vec_exists, index.doc_base, queries)
         return ShardedTopK(s, d, t)
+
+    return step
+
+
+# ---------------------------------------------------------------------------
+# Serving SPMD steps — the production mesh path (MeshExecutor).
+#
+# The stacked axis carries (shard, segment) ENTRIES, not whole shards:
+# the sequential serving path scores per segment (ChunkedScorer /
+# FusedScorer accumulate one segment's doc space), so keeping the
+# per-entry granularity makes the mesh program reproduce the sequential
+# kernels value-for-value — same tile plans in the same scatter order,
+# same `w - w/(1 + tf·inv)` BM25 formula, same live/count masking — and
+# only the cross-segment + cross-shard merge moves from S host round
+# trips to one all_gather + top_k on the ICI. Entry order is (shard asc,
+# segment asc), so the device merge's (score desc, slot asc) ordering is
+# exactly the coordinator's (score desc, shard asc, segment asc, doc
+# asc) tie-break.
+# ---------------------------------------------------------------------------
+
+
+def build_mesh_text_step(
+    mesh: Mesh,
+    doc_ids_f: Sequence[jax.Array],  # per field: [E, Tmax_f, TILE] stacked
+    tfs_f: Sequence[jax.Array],
+    inv_norm_f: Sequence[jax.Array],  # per field: [E, Nmax]
+    live: jax.Array,  # bool[E, Nmax] (live docs ∧ in-range padding mask)
+    k: int,
+    *,
+    with_cnt: bool,
+    count_signed: bool,
+    combine: str = "sum",
+    tie: float = 0.0,
+):
+    """One SPMD text-scoring step over stacked (shard, segment) entries.
+
+    fn(ti_f..., tw_f..., tv_f..., msm[B]) →
+        (scores[B, K], entry[B, K], doc[B, K], totals[B])
+    with per-field plans ti/tw/tv of shape [E, B, T_f] sharded
+    (shards, data, None) and the outputs sharded over ``data`` only.
+
+    * ``count_signed`` (the ServePlan families): |w| scores, w > 0
+      counts toward msm — the MultiFusedScorer weight-sign convention.
+    * ``with_cnt`` False (pure-disjunction match groups): the match mask
+      is ``acc > 0`` exactly like ops/scoring._finalize with cnt=None.
+    * ``combine``: "sum" (bool / most_fields) or "max_tie"
+      (best_fields: max + tie·(sum − max)).
+    """
+    F_fields = len(doc_ids_f)
+    n_docs = int(inv_norm_f[0].shape[1])
+    tie_f = jnp.float32(tie)
+
+    def body(*args):
+        it = iter(args)
+        d_f = [next(it) for _ in range(F_fields)]  # [F, Tmax, TILE] blocks
+        t_f = [next(it) for _ in range(F_fields)]
+        i_f = [next(it) for _ in range(F_fields)]
+        live_b = next(it)  # [F, Nmax]
+        ti_f = [next(it) for _ in range(F_fields)]  # [F, Bd, T]
+        tw_f = [next(it) for _ in range(F_fields)]
+        tv_f = [next(it) for _ in range(F_fields)]
+        msm = next(it)  # [Bd]
+
+        def entry(per_field, live_e):
+            Bd = per_field[0][3].shape[0]
+            cnt = (
+                jnp.zeros((Bd, n_docs + 1), jnp.int32) if with_cnt else None
+            )
+            accs = []
+            for dids, tfs_, inv, ti, tw, tv in per_field:
+                nt = dids.shape[0]
+                rows_d = dids[jnp.clip(ti, 0, nt - 1)]  # [Bd, T, 128]
+                rows_t = tfs_[jnp.clip(ti, 0, nt - 1)]
+                valid = (rows_d >= 0) & tv[:, :, None]
+                w = (jnp.abs(tw) if count_signed else tw)[:, :, None]
+                tgt, s = bm25_tile_contrib(
+                    rows_d, rows_t, w, valid, inv, n_docs
+                )
+                acc = jnp.zeros((Bd, n_docs + 1), jnp.float32)
+                acc = jax.vmap(
+                    lambda a, d, v: a.at[d.ravel()].add(v.ravel())
+                )(acc, tgt, s)
+                accs.append(acc[:, :n_docs])
+                if with_cnt:
+                    counted = (
+                        valid & (tw > 0)[:, :, None] if count_signed else valid
+                    )
+                    cnt = jax.vmap(
+                        lambda c, d, v: c.at[d.ravel()].add(
+                            v.ravel().astype(jnp.int32)
+                        )
+                    )(cnt, tgt, counted)
+            if len(accs) == 1:
+                combined = accs[0]
+            elif combine == "sum":
+                combined = accs[0]
+                for a in accs[1:]:
+                    combined = combined + a
+            else:  # max_tie (DisjunctionMaxQuery)
+                stack = jnp.stack(accs)
+                best = stack.max(axis=0)
+                combined = best + tie_f * (stack.sum(axis=0) - best)
+            if with_cnt:
+                mask = cnt[:, :n_docs] >= jnp.maximum(msm, 1)[:, None]
+            else:
+                mask = combined > 0
+            mask = mask & live_e[None, :]
+            masked = jnp.where(mask, combined, -jnp.inf)
+            kk = min(k, n_docs)
+            s, d = jax.lax.top_k(masked, kk)
+            return s, d, mask.sum(axis=1, dtype=jnp.int32)
+
+        per_entry = tuple(
+            tuple(x[fi] for x in (d_f, t_f, i_f, ti_f, tw_f, tv_f))
+            for fi in range(F_fields)
+        )
+        s, d, t = jax.vmap(
+            lambda pf, le: entry(pf, le)
+        )(per_entry, live_b)  # [F, Bd, kk] ×2, [F, Bd]
+        gs = jax.lax.all_gather(s, SHARD_AXIS)  # [G, F, Bd, kk]
+        gd = jax.lax.all_gather(d, SHARD_AXIS)
+        ms, me, md = _merge_gathered(gs, gd, k)
+        totals = jax.lax.psum(t.sum(axis=0), SHARD_AXIS)
+        return ms, me, md, totals
+
+    p3 = P(SHARD_AXIS, None, None)
+    p2 = P(SHARD_AXIS, None)
+    p_plan = P(SHARD_AXIS, DATA_AXIS, None)
+    p_out = P(DATA_AXIS, None)
+    in_specs = (
+        tuple(p3 for _ in range(2 * F_fields))
+        + tuple(p2 for _ in range(F_fields))
+        + (p2,)
+        + tuple(p_plan for _ in range(3 * F_fields))
+        + (P(DATA_AXIS),)
+    )
+    fn = shard_map(
+        body,
+        mesh=mesh,
+        in_specs=in_specs,
+        out_specs=(p_out, p_out, p_out, P(DATA_AXIS)),
+        check_vma=False,
+    )
+
+    @jax.jit
+    def step(ti_f, tw_f, tv_f, msm):
+        args = (
+            tuple(doc_ids_f) + tuple(tfs_f) + tuple(inv_norm_f) + (live,)
+            + tuple(ti_f) + tuple(tw_f) + tuple(tv_f) + (msm,)
+        )
+        return fn(*args)
+
+    return step
+
+
+def build_mesh_knn_step(
+    mesh: Mesh,
+    vectors: jax.Array,  # [E, Nmax, dims] stacked (original dtype)
+    cand: jax.Array,  # bool[E, Nmax] exists ∧ live ∧ in-range
+    similarity: str,
+    kc: int,  # per-entry candidate page (≥ every job's num_candidates)
+):
+    """One SPMD brute-force kNN step over stacked (shard, segment)
+    entries with the sequential path's per-(job, entry) num_candidates
+    rank cut applied on device.
+
+    fn(queries[B, d], nc[E, B]) →
+        (scores[B, slots], entry[B, slots], doc[B, slots], counts[B, E])
+    The merged stream comes back FULLY ordered (score desc, slot asc —
+    slots = E_pad · kk) rather than cut at a global k, because the
+    sequential coordinator's knn semantics cut at k PER SHARD before
+    the global page: the collector walks the ordered stream applying
+    per-shard rank caps, which a global top-k on device could starve
+    (one dominant shard would evict other shards' in-page ranks).
+    counts = surviving candidates PER ENTRY, for the per-shard totals
+    (Σ_shards min(Σ_{entries∈shard} count, k)) of
+    ops/scoring.knn_merge_segment_topk.
+    """
+    n_docs = int(vectors.shape[1])
+    kk = min(kc, n_docs)
+
+    def body(vectors_b, cand_b, queries, nc_b):
+        q = queries
+        if similarity == "cosine":
+            qn = jnp.linalg.norm(q, axis=1, keepdims=True)
+            q = q / jnp.where(qn == 0, 1.0, qn)
+
+        def entry(vectors_e, cand_e):
+            dots = q @ vectors_e.T  # [Bd, N] — MXU
+            if similarity in ("cosine", "dot_product"):
+                scores = (1.0 + dots) / 2.0
+            elif similarity == "l2_norm":
+                q2 = jnp.sum(q * q, axis=1, keepdims=True)
+                v2 = jnp.sum(vectors_e * vectors_e, axis=1)[None, :]
+                scores = 1.0 / (1.0 + jnp.maximum(q2 + v2 - 2.0 * dots, 0.0))
+            elif similarity == "max_inner_product":
+                scores = jnp.where(dots < 0, 1.0 / (1.0 - dots), dots + 1.0)
+            else:
+                raise ValueError(f"unknown similarity [{similarity}]")
+            scores = jnp.where(
+                cand_e[None, :], scores.astype(jnp.float32), -jnp.inf
+            )
+            return jax.lax.top_k(scores, kk)
+
+        s, d = jax.vmap(entry)(vectors_b, cand_b)  # [F, Bd, kk] ×2
+        gs = jax.lax.all_gather(s, SHARD_AXIS)  # [G, F, Bd, kk]
+        gd = jax.lax.all_gather(d, SHARD_AXIS)
+        gn = jax.lax.all_gather(nc_b, SHARD_AXIS)  # [G, F, Bd]
+        G, F, Bd, _ = gs.shape
+        slots = G * F * kk
+        gs2 = jnp.transpose(gs, (2, 0, 1, 3)).reshape(Bd, slots)
+        gd2 = jnp.transpose(gd, (2, 0, 1, 3)).reshape(Bd, slots)
+        nc2 = jnp.transpose(gn, (2, 0, 1)).reshape(Bd, G * F)
+        entry_of_slot = jnp.arange(slots, dtype=jnp.int32) // kk
+        rank_of_slot = jnp.arange(slots, dtype=jnp.int32) % kk
+        nc_slot = jnp.take(nc2, entry_of_slot, axis=1)  # [Bd, slots]
+        valid = jnp.isfinite(gs2) & (rank_of_slot[None, :] < nc_slot)
+        masked = jnp.where(valid, gs2, -jnp.inf)
+        ms, mi = jax.lax.top_k(masked, slots)
+        me = entry_of_slot[mi]
+        md = jnp.take_along_axis(gd2, mi, axis=1)
+        counts = valid.reshape(Bd, G * F, kk).sum(axis=2, dtype=jnp.int32)
+        return ms, me, md, counts
+
+    fn = shard_map(
+        body,
+        mesh=mesh,
+        in_specs=(
+            P(SHARD_AXIS, None, None),
+            P(SHARD_AXIS, None),
+            P(DATA_AXIS, None),
+            P(SHARD_AXIS, DATA_AXIS),
+        ),
+        out_specs=(
+            P(DATA_AXIS, None),
+            P(DATA_AXIS, None),
+            P(DATA_AXIS, None),
+            P(DATA_AXIS, None),
+        ),
+        check_vma=False,
+    )
+
+    @jax.jit
+    def step(queries, nc):
+        return fn(vectors, cand, queries, nc)
 
     return step
 

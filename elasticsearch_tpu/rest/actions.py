@@ -563,6 +563,13 @@ class RestActions:
             "host_stall_ms": 0.0, "flops": 0, "mfu": 0.0,
         }
         queue_capacity = 0
+        # per-device roofline rows (straggler visibility): busy time and
+        # flops merged by device id across every index's batcher
+        dev_agg: dict = {}
+        mesh_stats = {
+            "routed": 0, "launches": 0, "jobs": 0, "rebuilds": 0,
+            "degraded": 0, "fallbacks": 0,
+        }
         for idx in self.cluster.indices.values():
             b = getattr(idx, "_batcher", None)
             if b is not None:
@@ -575,6 +582,17 @@ class RestActions:
                 pipeline["device_busy_ms"] += ps["device_busy_ms"]
                 pipeline["host_stall_ms"] += ps["host_stall_ms"]
                 pipeline["flops"] += ps["flops"]
+                for row in b.device_stats():
+                    d = dev_agg.setdefault(
+                        row["id"], {"id": row["id"],
+                                    "device_busy_ms": 0.0, "flops": 0}
+                    )
+                    d["device_busy_ms"] += row["device_busy_ms"]
+                    d["flops"] += row["flops"]
+            mex = getattr(idx, "_mesh", None)
+            if mex is not None:
+                for k in mesh_stats:
+                    mesh_stats[k] += mex.stats.get(k, 0)
         if pipeline["depth"] == 0:
             from ..common.settings import pipeline_depth
 
@@ -587,6 +605,22 @@ class RestActions:
             )
         pipeline["device_busy_ms"] = round(pipeline["device_busy_ms"], 3)
         pipeline["host_stall_ms"] = round(pipeline["host_stall_ms"], 3)
+        from ..common.settings import peak_flops as _peak
+
+        pipeline["devices"] = [
+            {
+                "id": d["id"],
+                "device_busy_ms": round(d["device_busy_ms"], 3),
+                "flops": int(d["flops"]),
+                "mfu": (
+                    d["flops"] / ((d["device_busy_ms"] / 1000.0) * _peak())
+                    if d["device_busy_ms"] > 0
+                    else 0.0
+                ),
+            }
+            for d in sorted(dev_agg.values(), key=lambda r: r["id"])
+        ]
+        pipeline["mesh"] = mesh_stats
         if queue_capacity == 0:
             from ..search.batcher import QUEUE_CAPACITY
 

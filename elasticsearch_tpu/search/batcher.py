@@ -396,7 +396,7 @@ class _BatchCtx:
 
     def __init__(self, batch: List[_Job]):
         self.batch = batch
-        self.pending: List[Tuple] = []  # (key, jobs, fam, pend)
+        self.pending: List[Tuple] = []  # (key, jobs, fam, pend, dev_ids)
 
 
 WORKERS = 6  # parallel dispatcher pipelines (the device tunnel overlaps
@@ -470,6 +470,11 @@ class QueryBatcher:
         # family → groups currently dispatched-but-not-collected,
         # across ALL workers (guarded by self._lock)
         self._inflight = {"text": 0, "knn": 0}
+        # per-device roofline accounting (straggler visibility): device
+        # id → [inflight_groups, busy_t0, busy_s, flops]; single-device
+        # groups attribute to device 0, mesh groups to every device in
+        # the mesh (guarded by self._lock)
+        self._devs: Dict[int, list] = {}
 
     def _ensure_thread(self):
         with self._lock:
@@ -600,8 +605,9 @@ class QueryBatcher:
             err = RuntimeError("query batcher closed")
             while inflight:
                 ctx = inflight.popleft()
-                for _, jobs, fam, _ in ctx.pending:
+                for _, jobs, fam, _, dev_ids in ctx.pending:
                     self._exit_kind(fam)
+                    self._dev_exit(dev_ids)
                 for j in ctx.batch:
                     if not j.event.is_set():
                         j.error = err
@@ -633,7 +639,9 @@ class QueryBatcher:
                     self.stats["max_batch_seen"], len(batch)
                 )
             # group jobs that can share launches (same reader
-            # generation, plan family, and top-k compile bucket)
+            # generation, plan family, and top-k compile bucket);
+            # mesh_* families group whole-index query batches on the
+            # MeshExecutor (B queries × all shards in one SPMD program)
             groups: Dict[Tuple, List[_Job]] = {}
             for j in batch:
                 kb = 16 if j.k <= 16 else scoring.next_bucket(j.k, 16)
@@ -644,6 +652,15 @@ class QueryBatcher:
                         id(j.executor), "s", j.plan.fields,
                         j.plan.combine, j.plan.tie, kb,
                     )
+                elif j.kind == "mesh_match":
+                    key = (id(j.executor), "Mm", j.plan.field, kb)
+                elif j.kind == "mesh_serve":
+                    key = (
+                        id(j.executor), "Ms", j.plan.fields,
+                        j.plan.combine, j.plan.tie, kb,
+                    )
+                elif j.kind == "mesh_knn":
+                    key = (id(j.executor), "Mk", j.plan.field, kb)
                 else:  # knn
                     key = (id(j.executor), "k", j.plan.field, kb)
                 groups.setdefault(key, []).append(j)
@@ -652,28 +669,55 @@ class QueryBatcher:
             )
             for key, jobs in ordered:
                 kind, kb = key[1], key[-1]
-                fam = "knn" if kind == "k" else "text"
+                mesh = kind in ("Mm", "Ms", "Mk")
+                fam = "knn" if kind in ("k", "Mk") else "text"
+                dev_ids: Tuple[int, ...] = (0,)
+                dev_entered = False
                 self._enter_kind(fam)
                 dispatched = False
                 try:
+                    if not mesh:
+                        self._dev_enter(dev_ids)
+                        dev_entered = True
                     # fault site: an injected dispatch failure surfaces
                     # to exactly this group's waiters, not the batch
                     faults.check(
-                        "batcher.dispatch", family=fam, jobs=len(jobs)
+                        "batcher.dispatch", family=fam, jobs=len(jobs),
+                        mesh=int(mesh),
                     )
                     if kind == "m":
                         self._run_group(jobs, key[2], kb)
                     elif kind == "s":
                         ctx.pending.append(
                             (key, jobs, fam,
-                             self._dispatch_serve_group(jobs, kb))
+                             self._dispatch_serve_group(jobs, kb),
+                             dev_ids)
+                        )
+                        dispatched = True
+                    elif kind == "k":
+                        ctx.pending.append(
+                            (key, jobs, fam,
+                             self._dispatch_knn_group(jobs), dev_ids)
                         )
                         dispatched = True
                     else:
-                        ctx.pending.append(
-                            (key, jobs, fam,
-                             self._dispatch_knn_group(jobs))
-                        )
+                        mex = jobs[0].executor
+                        if kind == "Mm":
+                            pend = mex.dispatch_match(jobs, kb)
+                        elif kind == "Ms":
+                            pend = mex.dispatch_serve(jobs, kb)
+                        else:
+                            pend = mex.dispatch_knn(jobs, kb)
+                        # the busy window opens on the devices the
+                        # snapshot actually spans
+                        dev_ids = mex.device_ids
+                        self._dev_enter(dev_ids)
+                        dev_entered = True
+                        with self._lock:
+                            self.stats["launches"] += 1
+                            self.stats["fused_jobs"] += len(jobs)
+                        self._add_flops(pend["flops"], dev_ids)
+                        ctx.pending.append((key, jobs, fam, pend, dev_ids))
                         dispatched = True
                 except BaseException as e:  # surface to waiters
                     for j in jobs:
@@ -683,6 +727,8 @@ class QueryBatcher:
                 finally:
                     if not dispatched:
                         self._exit_kind(fam)
+                        if dev_entered:
+                            self._dev_exit(dev_ids)
         except BaseException as e:
             # stats/grouping crash between dequeue and the per-group
             # guard: already-dequeued jobs are not in the queue, so the
@@ -699,15 +745,27 @@ class QueryBatcher:
         """Host side of one dispatched batch: transfer the merged device
         results and finish the waiters. Never raises."""
         try:
-            for key, jobs, fam, pend in ctx.pending:
+            for key, jobs, fam, pend, dev_ids in ctx.pending:
+                kind = key[1]
                 try:
                     # fault site: a collect-phase failure (device→host
                     # transfer) fails this group's waiters only
                     faults.check(
-                        "batcher.collect", family=fam, jobs=len(jobs)
+                        "batcher.collect", family=fam, jobs=len(jobs),
+                        mesh=int(kind in ("Mm", "Ms", "Mk")),
                     )
-                    if key[1] == "s":
+                    if kind == "s":
                         self._collect_serve_group(jobs, key[-1], pend)
+                    elif kind == "k":
+                        self._collect_knn_group(jobs, pend)
+                    elif kind in ("Mm", "Ms"):
+                        t0 = time.perf_counter()
+                        jobs[0].executor.collect_match(jobs, pend)
+                        self._add_stall(time.perf_counter() - t0)
+                    elif kind == "Mk":
+                        t0 = time.perf_counter()
+                        jobs[0].executor.collect_knn(jobs, pend)
+                        self._add_stall(time.perf_counter() - t0)
                     else:
                         self._collect_knn_group(jobs, pend)
                 except BaseException as e:
@@ -717,6 +775,7 @@ class QueryBatcher:
                             j.event.set()
                 finally:
                     self._exit_kind(fam)
+                    self._dev_exit(dev_ids)
         finally:
             ctx.pending = []
             self._ring_exit()
@@ -735,13 +794,67 @@ class QueryBatcher:
             if self._ring_inflight == 0:
                 self._device_busy_s += time.perf_counter() - self._busy_t0
 
-    def _add_flops(self, n: int):
+    def _add_flops(self, n: int, dev_ids: Tuple[int, ...] = (0,)):
+        n = int(n)
         with self._lock:
-            self._flops += int(n)
+            self._flops += n
+            if dev_ids:
+                share = n // len(dev_ids)
+                for i, did in enumerate(dev_ids):
+                    d = self._devs.setdefault(did, [0, 0.0, 0.0, 0])
+                    d[3] += share + (n - share * len(dev_ids) if i == 0 else 0)
 
     def _add_stall(self, seconds: float):
         with self._lock:
             self._host_stall_s += seconds
+
+    # ---- per-device busy windows (straggler visibility) ----
+
+    def _dev_enter(self, dev_ids: Tuple[int, ...]):
+        now = time.perf_counter()
+        with self._lock:
+            for did in dev_ids:
+                d = self._devs.setdefault(did, [0, 0.0, 0.0, 0])
+                d[0] += 1
+                if d[0] == 1:
+                    d[1] = now
+
+    def _dev_exit(self, dev_ids: Tuple[int, ...]):
+        now = time.perf_counter()
+        with self._lock:
+            for did in dev_ids:
+                d = self._devs.get(did)
+                if d is None:
+                    continue
+                d[0] -= 1
+                if d[0] == 0:
+                    d[2] += now - d[1]
+
+    def device_stats(self) -> list:
+        """Per-device roofline rows [{id, device_busy_ms, flops, mfu}]
+        so one straggler chip is visible next to the aggregate MFU.
+        Busy time is the union of this device's group dispatch→collect
+        windows; flops split evenly across a mesh group's devices."""
+        from ..common.settings import peak_flops
+
+        now = time.perf_counter()
+        out = []
+        with self._lock:
+            for did in sorted(self._devs):
+                inflight, t0, busy, flops = self._devs[did]
+                if inflight > 0:
+                    busy += now - t0
+                out.append(
+                    {
+                        "id": did,
+                        "device_busy_ms": round(busy * 1000.0, 3),
+                        "flops": int(flops),
+                        "mfu": (
+                            flops / (busy * peak_flops()) if busy > 0 else 0.0
+                        ),
+                    }
+                )
+        return out
 
     def pipeline_stats(self) -> dict:
         """Snapshot of the serving-pipeline roofline counters.

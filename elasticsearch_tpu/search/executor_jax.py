@@ -843,7 +843,13 @@ class JaxExecutor:
 
     def block_index(self, si: int, field: str):
         """Cached BlockMaxIndex (shard-level stats over the segment's
-        block-aligned tiling) — None when the field has no postings."""
+        block-aligned tiling) — None when the field has no postings.
+
+        Also the source of truth for the mesh serving stack
+        (parallel/mesh_executor.MeshExecutor builds its per-entry tile
+        plans and weights from this index, and its norm operands from
+        `_inv_norm`), which is what keeps the SPMD path's scoring
+        inputs identical to the sequential kernels'."""
         key = (si, field)
         if key in self._block_indexes:
             return self._block_indexes[key]

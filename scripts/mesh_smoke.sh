@@ -1,0 +1,75 @@
+#!/usr/bin/env bash
+# Mesh-parallel serving smoke: tiny-corpus scaling sweep on the forced
+# 8-virtual-device CPU platform. Gates:
+#   * recall >= 0.99 on both mesh configs (match, knn) vs the CPU oracle
+#   * float-exact parity with the sequential per-shard path
+#   * >= 2.5x QPS at 8 devices vs the 1-device mesh on at least one of
+#     {match, knn} — only enforced when the host has >= 8 cores, since
+#     8 virtual XLA devices on fewer cores time-share and cannot show
+#     parallel speedup (the gate still MEASURES and prints either way;
+#     the real scaling number comes from the TPU bench run).
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+export JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}"
+if [[ "${XLA_FLAGS:-}" != *xla_force_host_platform_device_count* ]]; then
+    export XLA_FLAGS="${XLA_FLAGS:-} --xla_force_host_platform_device_count=8"
+fi
+export BENCH_N_DOCS="${BENCH_N_DOCS:-20000}"
+export BENCH_VOCAB="${BENCH_VOCAB:-8000}"
+export BENCH_DIMS="${BENCH_DIMS:-64}"
+export BENCH_N_QUERIES="${BENCH_N_QUERIES:-96}"
+export BENCH_THREADS="${BENCH_THREADS:-16}"
+export BENCH_MESH_DOCS="${BENCH_MESH_DOCS:-$BENCH_N_DOCS}"
+
+log="${TMPDIR:-/tmp}/mesh_smoke.log"
+json_out="${TMPDIR:-/tmp}/mesh_smoke.json"
+if ! python bench.py >"$json_out" 2>"$log"; then
+    echo "bench.py failed; last stderr lines:" >&2
+    tail -40 "$log" >&2
+    exit 1
+fi
+
+ENFORCE_SCALING=$([ "$(nproc)" -ge 8 ] && echo 1 || echo 0) \
+python - "$json_out" <<'PY'
+import json
+import os
+import sys
+
+with open(sys.argv[1]) as f:
+    r = json.load(f)
+m = r.get("mesh")
+assert m, "bench JSON has no mesh block (BENCH_MESH=0?)"
+assert m["recall_match"] >= 0.99, f"mesh match recall {m['recall_match']}"
+assert m["recall_knn"] >= 0.99, f"mesh knn recall {m['recall_knn']}"
+assert m["float_exact_vs_sequential"], "mesh path not float-exact"
+
+print(f"mesh sweep: {m['n_shards']} shards, {m['n_docs']} docs, "
+      f"{m['devices_available']} devices")
+for e in m["sweep"]:
+    print(
+        f"  {e['devices']}d  match={e['match_qps']:<8} "
+        f"({e['match_qps_per_device']}/dev, {e['scaling_match']}x)  "
+        f"knn={e['knn_qps']:<8} ({e['knn_qps_per_device']}/dev, "
+        f"{e['scaling_knn']}x)"
+    )
+print(f"sequential baseline: match={m['seq_match_qps']} "
+      f"knn={m['seq_knn_qps']}  →  mesh speedup "
+      f"match={m['speedup_vs_sequential_match']}x "
+      f"knn={m['speedup_vs_sequential_knn']}x")
+
+top = m["sweep"][-1]
+best = max(top.get("scaling_match") or 0.0, top.get("scaling_knn") or 0.0)
+if top["devices"] < 8:
+    print(f"scaling gate SKIPPED: only {top['devices']} devices visible")
+elif os.environ.get("ENFORCE_SCALING") != "1":
+    print(f"scaling at 8 devices: {best}x — gate SKIPPED "
+          f"(host has < 8 cores; virtual devices time-share)")
+else:
+    assert best >= 2.5, (
+        f"scaling gate: {best}x at 8 devices < 2.5x "
+        f"(match {top['scaling_match']}x, knn {top['scaling_knn']}x)"
+    )
+    print(f"scaling gate OK: {best}x at 8 devices (>= 2.5x)")
+print("MESH SMOKE OK")
+PY

@@ -1,4 +1,19 @@
 #!/usr/bin/env bash
 # Tier-1 verify — the ONE blessed entry point for builders and CI.
 # The command below is the ROADMAP.md "Tier-1 verify" line, verbatim.
-set -o pipefail; rm -f /tmp/_t1.log; timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' --continue-on-collection-errors -p no:cacheprovider -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log; rc=${PIPESTATUS[0]}; echo DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' /tmp/_t1.log | tr -cd . | wc -c); exit $rc
+#
+# T1_MESH=1 additionally re-runs the mesh-marked tests alone under the
+# forced 8-device CPU host platform (they also run inside the main
+# suite; the re-run isolates the mesh-parallel serving path for quick
+# iteration). The combined exit code fails if either run fails.
+set -o pipefail; rm -f /tmp/_t1.log; timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' --continue-on-collection-errors -p no:cacheprovider -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log; rc=${PIPESTATUS[0]}; echo DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' /tmp/_t1.log | tr -cd . | wc -c)
+if [ "${T1_MESH:-0}" = "1" ]; then
+    echo "--- T1_MESH: mesh-marked tests on the forced 8-device host platform ---"
+    timeout -k 10 600 env JAX_PLATFORMS=cpu \
+        XLA_FLAGS="--xla_force_host_platform_device_count=8" \
+        python -m pytest tests/ -q -m mesh -p no:cacheprovider \
+        -p no:xdist -p no:randomly
+    mesh_rc=$?
+    [ "$rc" -eq 0 ] && rc=$mesh_rc
+fi
+exit $rc

@@ -34,6 +34,11 @@ def pytest_configure(config):
     config.addinivalue_line(
         "markers", "slow: long-running tests excluded from tier-1"
     )
+    config.addinivalue_line(
+        "markers",
+        "mesh: mesh-parallel serving tests (run in tier-1 on the forced "
+        "8-device CPU platform; re-runnable alone via T1_MESH=1 t1.sh)",
+    )
 
 
 @pytest.fixture(autouse=True)

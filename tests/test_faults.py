@@ -7,6 +7,7 @@ allow_partial_search_results (TransportSearchAction), AsyncSearchContext
 retry-on-next-copy, and MockTransportService-style disruption schemes.
 """
 
+import os
 import threading
 import time
 
@@ -21,6 +22,20 @@ from elasticsearch_tpu.common.faults import InjectedFault, faults
 from elasticsearch_tpu.utils.murmur3 import shard_id as route_shard_id
 
 pytestmark = pytest.mark.faults
+
+
+@pytest.fixture(autouse=True)
+def _sequential_path():
+    """These tests target the per-shard fan-out's failure isolation; the
+    whole-index mesh path (which would absorb a faulted group by falling
+    back) has its own fault tests in test_mesh.py."""
+    old = os.environ.get("ES_TPU_MESH")
+    os.environ["ES_TPU_MESH"] = "off"
+    yield
+    if old is None:
+        os.environ.pop("ES_TPU_MESH", None)
+    else:
+        os.environ["ES_TPU_MESH"] = old
 
 MAPPINGS = {
     "properties": {
