@@ -437,6 +437,93 @@ def run_load(svc, bodies, threads=THREADS):
     )
 
 
+def run_open_loop(
+    svc, bodies, rate_qps, duration_s, slo_ms, seed=101, max_workers=256
+):
+    """Open-loop load: Poisson arrivals at `rate_qps` for `duration_s`,
+    independent of completions — the traffic shape closed-loop QPS
+    numbers hide. Under overload a closed loop politely slows its own
+    generator; an open loop keeps arriving, so collapse shows up as
+    unbounded queueing unless the node sheds. Returns offered/completed/
+    shed counts, goodput (completed-within-SLO per second), and
+    accepted-request latency percentiles."""
+    from concurrent.futures import ThreadPoolExecutor
+
+    from elasticsearch_tpu.common.memory import CircuitBreakingException
+    from elasticsearch_tpu.search.admission import EsOverloadedError
+    from elasticsearch_tpu.search.batcher import EsRejectedExecutionError
+
+    rng = np.random.default_rng(seed)
+    results = []
+    rlock = threading.Lock()
+
+    def one(body):
+        t0 = time.perf_counter()
+        try:
+            r = svc.search(body)
+            ok = "hits" in r
+            shed = False
+        except (
+            EsOverloadedError, EsRejectedExecutionError,
+            CircuitBreakingException,
+        ):
+            ok, shed = False, True
+        dt_ms = (time.perf_counter() - t0) * 1000.0
+        with rlock:
+            results.append((ok, shed, dt_ms))
+
+    pool = ThreadPoolExecutor(
+        max_workers=max_workers, thread_name_prefix="open-loop"
+    )
+    # the in-process arrival generator competes for the GIL with every
+    # worker thread; a finer switch interval keeps the offered rate
+    # honest under load (restored afterwards)
+    import sys
+
+    prev_switch = sys.getswitchinterval()
+    sys.setswitchinterval(0.001)
+    offered = 0
+    t_start = time.perf_counter()
+    next_t = 0.0
+    try:
+        while True:
+            now = time.perf_counter() - t_start
+            if now >= duration_s:
+                break
+            if now < next_t:
+                time.sleep(min(next_t - now, 0.01))
+                continue
+            pool.submit(one, bodies[offered % len(bodies)])
+            offered += 1
+            next_t += float(rng.exponential(1.0 / rate_qps))
+    finally:
+        sys.setswitchinterval(prev_switch)
+    pool.shutdown(wait=True)
+    wall = time.perf_counter() - t_start
+    ok_lat = np.asarray([dt for ok, _, dt in results if ok])
+    shed = sum(1 for _, s, _ in results if s)
+    errors = len(results) - len(ok_lat) - shed
+    within_slo = int((ok_lat <= slo_ms).sum()) if len(ok_lat) else 0
+    return {
+        "offered": offered,
+        "offered_qps": round(offered / wall, 1),
+        "completed": int(len(ok_lat)),
+        "completed_qps": round(len(ok_lat) / wall, 1),
+        "shed_429": int(shed),
+        "errors": int(errors),
+        "within_slo": within_slo,
+        "goodput_qps": round(within_slo / wall, 1),
+        "slo_ms": float(slo_ms),
+        "accepted_p50_ms": (
+            round(float(np.percentile(ok_lat, 50)), 2) if len(ok_lat) else None
+        ),
+        "accepted_p99_ms": (
+            round(float(np.percentile(ok_lat, 99)), 2) if len(ok_lat) else None
+        ),
+        "wall_s": round(wall, 2),
+    }
+
+
 def batch1_p50(svc, bodies, n=32):
     """Single-inflight latency (bench honesty: pipelining gains must not
     hide latency regressions behind batching) — p50 over n sequential
@@ -741,6 +828,12 @@ def mesh_sweep(svc, svc_oracle, body_df):
 
 def main():
     t0 = time.perf_counter()
+    # closed-loop sections measure RAW serving capacity: the admission
+    # gate stays off so the numbers remain comparable across rounds;
+    # the open-loop section below re-arms it to measure protection
+    from elasticsearch_tpu.search.admission import admission
+
+    admission.configure(enabled=False)
     log(f"building {N_DOCS} doc corpus…")
     seg_jax, seg_np, body_df, title_df = build_corpus()
     log(f"index built ({time.perf_counter()-t0:.1f}s); starting services…")
@@ -939,6 +1032,64 @@ def main():
     o1_qps, _, _, _ = run_load(svc_np, bodies["match"][:24], threads=1)
     log(f"[match] cpu oracle single-thread: {o1_qps:.1f} QPS")
 
+    # ---- open-loop overload mode: Poisson arrivals at 2× the measured
+    # closed-loop peak, admission gate ARMED. The protection claim is a
+    # goodput claim: the node sheds with 429+Retry-After and keeps
+    # completed-within-SLO throughput near the closed-loop peak instead
+    # of collapsing into unbounded queueing. ----
+    open_block = None
+    if os.environ.get("BENCH_OPEN_LOOP", "1") != "0":
+        closed_qps = configs["match"]["qps"]
+        slo_ms = float(
+            os.environ.get(
+                "BENCH_SLO_MS",
+                max(4.0 * configs["match"]["p50_ms"], 250.0),
+            )
+        )
+        rate_factor = float(os.environ.get("BENCH_OPEN_FACTOR", 2.0))
+        dur = float(os.environ.get("BENCH_OPEN_SECONDS", 20.0))
+        log(
+            f"[open_loop] Poisson arrivals at {rate_factor}x closed-loop "
+            f"peak ({rate_factor * closed_qps:.0f}/s) for {dur:.0f}s, "
+            f"SLO {slo_ms:.0f}ms…"
+        )
+        admission.reset()
+        admission.configure(enabled=True)
+        try:
+            open_block = run_open_loop(
+                svc_jax, bodies["match"],
+                rate_qps=rate_factor * closed_qps,
+                duration_s=dur, slo_ms=slo_ms,
+            )
+        finally:
+            adm_stats = admission.stats()
+            admission.reset()
+            admission.configure(enabled=False)
+        open_block["rate_factor"] = rate_factor
+        open_block["closed_loop_qps"] = closed_qps
+        open_block["goodput_vs_closed_loop"] = (
+            round(open_block["goodput_qps"] / closed_qps, 3)
+            if closed_qps
+            else None
+        )
+        open_block["admission"] = {
+            k: adm_stats[k]
+            for k in (
+                "limit", "queue_delay_ewma_ms", "pressure_tier",
+                "admitted", "queued_total", "shed_queue_full",
+                "shed_deadline", "shed_rejected", "brownouts",
+                "limit_decreases", "limit_increases",
+            )
+        }
+        log(
+            f"[open_loop] offered={open_block['offered_qps']}/s "
+            f"goodput={open_block['goodput_qps']}/s "
+            f"({open_block['goodput_vs_closed_loop']}x closed-loop) "
+            f"shed={open_block['shed_429']} "
+            f"accepted_p99={open_block['accepted_p99_ms']}ms "
+            f"limit={open_block['admission']['limit']}"
+        )
+
     # cumulative serving-pipeline roofline block (the "23× vs oracle"
     # headline finally gets a denominator: flops, device-busy time,
     # MFU against ES_TPU_PEAK_FLOPS)
@@ -985,6 +1136,7 @@ def main():
                 "recall_at_1000": configs["match"]["recall"],
                 "pipeline": pipeline_block,
                 "mesh": mesh_block,
+                "open_loop": open_block,
                 "configs": configs,
                 "baseline_kind": (
                     "measured NumPy oracle: dense vectorized scorer (no "

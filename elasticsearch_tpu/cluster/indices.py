@@ -35,11 +35,18 @@ from ..common.faults import faults
 from ..index.engine import OpResult, ShardEngine, VersionConflictError
 from ..index.mapping import Mappings
 from ..search import dsl
+from ..search.admission import (
+    EsOverloadedError,
+    RequestCacheOnlyMiss,
+    admission,
+    apply_brownout,
+)
 from ..search.coordinator import _col_key
 from ..search.executor import NumpyExecutor, ShardReader
 from ..search.failures import (
     SearchTimeoutError,
     deadline_from,
+    failure_type,
     parse_allow_partial,
     shard_failure,
 )
@@ -88,7 +95,7 @@ def _request_scoped_error(e: BaseException) -> bool:
 
     if isinstance(
         e, (dsl.QueryParseError, EsRejectedExecutionError,
-            CircuitBreakingException),
+            CircuitBreakingException, EsOverloadedError),
     ):
         return True
     try:
@@ -829,8 +836,51 @@ class IndexService:
             old[1].close()
         return ex
 
+    def _wait_batched(self, job, sid: int, shard_deadline, task):
+        """Collects a batcher future under the shard's timeout budget
+        and the request task's cancellation. An expired budget raises
+        SearchTimeoutError (the worker sheds the queued job at dequeue
+        too); a cancel landing while the job is still queued cancels it
+        in place — dropped from the queue, never launched — and
+        propagates task_cancelled_exception to the coordinator."""
+        from ..search.batcher import QueryBatcher
+        from ..tasks import TaskCancelledException
+
+        step = 0.02 if (task is not None and task.cancellable) else None
+        while True:
+            if task is not None:
+                try:
+                    task.check_cancelled()
+                except TaskCancelledException:
+                    self._batcher.cancel(job)
+                    raise
+            wait_s = step
+            if shard_deadline is not None:
+                remaining = shard_deadline - time.monotonic()
+                if remaining <= 0 and not job.done():
+                    raise SearchTimeoutError(
+                        f"shard [{self.name}][{sid}] batched query "
+                        "exceeded the search timeout budget"
+                    )
+                wait_s = (
+                    remaining if wait_s is None
+                    else min(wait_s, max(remaining, 0.0))
+                )
+            try:
+                return QueryBatcher.wait(job, timeout=wait_s)
+            except TimeoutError:
+                if shard_deadline is None or (
+                    time.monotonic() < shard_deadline
+                ):
+                    continue  # poll tick; budget not spent yet
+                raise SearchTimeoutError(
+                    f"shard [{self.name}][{sid}] batched query "
+                    "exceeded the search timeout budget"
+                )
+
     def shard_search_local(
-        self, sid: int, body: Optional[dict], pinned_executor=None
+        self, sid: int, body: Optional[dict], pinned_executor=None,
+        task=None,
     ) -> dict:
         """Full per-shard query phase + folded fetch for ONE locally-held
         shard. Returns a wire-shaped dict:
@@ -878,7 +928,8 @@ class IndexService:
                 if rc_flag is not None
                 else bool(self.settings.get("requests.cache.enable", True))
             )
-            if rc_enabled and request_cacheable_body(body):
+            cache_only = bool(body.get("_cache_only"))
+            if (rc_enabled or cache_only) and request_cacheable_body(body):
                 rc_key = (
                     f"{self.uuid}[{sid}]",
                     self.local_shard(sid).change_generation,
@@ -887,6 +938,12 @@ class IndexService:
                 hit = request_cache.get(*rc_key)
                 if hit is not None:
                     return hit
+            if cache_only:
+                # tier-3 brownout (cache_only): an agg body that missed
+                # the shard request cache is shed instead of computed
+                raise RequestCacheOnlyMiss(
+                    self.name, sid, retry_after_s=admission.retry_after_s()
+                )
         k = int(body.get("size", 10))
         min_score = body.get("min_score")
         source_spec = body.get("_source", True)
@@ -992,26 +1049,17 @@ class IndexService:
                 if plan is not None:
                     try:
                         job = self._batcher.submit_nowait(
-                            ex, plan, k, kind=kind, query=query
+                            ex, plan, k, kind=kind, query=query,
+                            deadline=shard_deadline,
                         )
                         # the batcher future honors the shard's timeout
                         # budget: an expired wait abandons the job (the
-                        # worker completes it into the void) and reports
-                        # this shard timed-out instead of blocking
-                        wait_s = (
-                            None
-                            if shard_deadline is None
-                            else max(shard_deadline - time.monotonic(), 0.0)
-                        )
-                        try:
-                            from ..search.batcher import QueryBatcher
-
-                            td = QueryBatcher.wait(job, timeout=wait_s)
-                        except TimeoutError:
-                            raise SearchTimeoutError(
-                                f"shard [{self.name}][{sid}] batched query "
-                                "exceeded the search timeout budget"
-                            )
+                        # worker sheds it at dequeue) and reports this
+                        # shard timed-out instead of blocking; with a
+                        # cancellable task the wait polls, so a cancel
+                        # landing before dispatch drops the job from
+                        # the queue — it never launches
+                        td = self._wait_batched(job, sid, shard_deadline, task)
                     except RuntimeError:
                         td = None  # batcher closed mid-request → unbatched
                 if td is None and plan is None and query is not None and knn is None:
@@ -1545,7 +1593,9 @@ class IndexService:
                 node=owner if owner is not None else (self.local_node or "local"),
             )
             if owner is None or owner == self.local_node:
-                return self.shard_search_local(sid, body, pinned_executor=pin)
+                return self.shard_search_local(
+                    sid, body, pinned_executor=pin, task=task
+                )
             return self.remote_call(
                 owner,
                 ACTION_SHARD_SEARCH,
@@ -1611,8 +1661,28 @@ class IndexService:
                 if _request_scoped_error(e):
                     raise
                 self._note_shard_failed(sid, owner)
+                # a slow-then-failed primary must not overshoot the
+                # request's `timeout` budget by a whole second attempt:
+                # when the deadline is already spent, the failure is
+                # reported as a timed-out shard instead of retried
+                if deadline is not None and time.monotonic() >= deadline:
+                    return "timeout", shard_failure(
+                        self.name, sid, owner,
+                        SearchTimeoutError(
+                            f"shard [{self.name}][{sid}] failed "
+                            f"({failure_type(e)}) with the request "
+                            "budget spent; replica retry skipped"
+                        ),
+                    )
                 alt = self._retry_copy(sid, exclude={owner})
                 if alt is not None:
+                    # node-wide retry budget (token bucket fed by live
+                    # admitted traffic): during an incident, replica
+                    # retries cannot amplify a brownout into a storm
+                    if not admission.retry_allowed():
+                        return "fail", shard_failure(
+                            self.name, sid, owner, e
+                        )
                     try:
                         return "ok", attempt(sid, alt, pin)
                     except SearchTimeoutError as e2:
@@ -1717,6 +1787,7 @@ class IndexService:
         {
             "query", "knn", "size", "from", "_source",
             "track_total_hits", "allow_partial_search_results",
+            "allow_degraded",
         }
     )
 
@@ -1846,6 +1917,41 @@ class IndexService:
         }
 
     def search(
+        self,
+        body: Optional[dict] = None,
+        pinned_executors: Optional[List] = None,
+        task=None,
+    ) -> dict:
+        body = body or {}
+        if pinned_executors is not None:
+            # scroll/PIT continuations were admitted when the context
+            # opened; re-gating every page would double-charge them
+            return self._search_reduced(body, pinned_executors, task)
+        # ---- per-node admission gate (search/admission.py): weighted
+        # fair queueing across indices, AIMD concurrency limit, deadline
+        # shedding, brownout degraded modes. Raises EsOverloadedError
+        # (429 + Retry-After) when this request is shed. ----
+        ticket = admission.acquire(
+            self.name,
+            weight=float(self.settings.get("search.admission.weight", 1.0)),
+            deadline=deadline_from(body),
+        )
+        try:
+            degraded, actions = apply_brownout(body, ticket.tier)
+            resp = self._search_reduced(degraded, None, task)
+            if ticket.tier > 0:
+                # brownout visibility: every degraded response says
+                # which tier served it and what was shed
+                resp["_overload"] = {
+                    "pressure_tier": ticket.tier,
+                    "pressure_mode": ticket.mode,
+                    "actions": actions,
+                }
+            return resp
+        finally:
+            admission.release(ticket)
+
+    def _search_reduced(
         self,
         body: Optional[dict] = None,
         pinned_executors: Optional[List] = None,
@@ -2172,7 +2278,11 @@ class IndexService:
                         "filter": filters,
                     }
                 }
-            resp = self.search(sub)
+            # _search_reduced, not search(): legs execute INSIDE the
+            # parent request's admission grant — re-admitting each leg
+            # would double-charge the limit and can self-deadlock when
+            # outer requests hold every slot
+            resp = self._search_reduced(sub)
             return [(h["_id"], h["_score"]) for h in resp["hits"]["hits"]]
         if kind == "knn":
             knn_params = dict(params)
@@ -2184,7 +2294,7 @@ class IndexService:
                     if existing is not None
                     else extra_filter
                 )
-            resp = self.search(
+            resp = self._search_reduced(
                 {"knn": knn_params, "size": window, "_source": False}
             )
             return [(h["_id"], h["_score"]) for h in resp["hits"]["hits"]]
@@ -2454,6 +2564,11 @@ class IndexService:
                 self._note_shard_failed(sid, owner)
                 alt = self._retry_copy(sid, exclude={owner})
                 if alt is not None:
+                    if not admission.retry_allowed():
+                        # node-wide retry budget: same cap as _fan_out
+                        return "fail", shard_failure(
+                            self.name, sid, owner, e
+                        )
                     try:
                         return "ok", attempt(sid, alt)
                     except Exception as e2:

@@ -65,6 +65,26 @@ class ClusterService:
         self._pits: Dict[str, dict] = {}
         self._lock = threading.RLock()
         self._started_at = time.time()
+        # dynamic overload-protection knobs dispatch to the node-wide
+        # admission controller (ClusterSettings.addSettingsUpdateConsumer)
+        from ..search.admission import admission
+
+        self.cluster_settings.add_consumer(
+            "search.admission.enabled",
+            lambda v: admission.configure(enabled=v),
+        )
+        self.cluster_settings.add_consumer(
+            "search.admission.target_delay_ms",
+            lambda v: admission.configure(target_delay_ms=v),
+        )
+        self.cluster_settings.add_consumer(
+            "search.admission.max_queue",
+            lambda v: admission.configure(max_queue=v),
+        )
+        self.cluster_settings.add_consumer(
+            "search.admission.retry_budget.ratio",
+            lambda v: admission.configure(retry_budget_ratio=v),
+        )
         if data_path is not None:
             os.makedirs(data_path, exist_ok=True)
             self._recover()
@@ -468,6 +488,30 @@ class ClusterService:
             return self.get_index(targets[0][0]).search(body, task=task)
         if not targets:
             return _empty_search_response()
+        # multi-index / filtered-alias coordinator: ONE admission grant
+        # covers the whole request (the per-index search_internal calls
+        # below sit behind this gate, not the per-index one)
+        from ..search.admission import admission, apply_brownout
+        from ..search.failures import deadline_from
+
+        ticket = admission.acquire(
+            expression, deadline=deadline_from(body)
+        )
+        try:
+            body, brownout_actions = apply_brownout(body, ticket.tier)
+            out = self._search_multi(targets, body, task)
+            if ticket.tier > 0:
+                out["_overload"] = {
+                    "pressure_tier": ticket.tier,
+                    "pressure_mode": ticket.mode,
+                    "actions": brownout_actions,
+                }
+            return out
+        finally:
+            admission.release(ticket)
+
+    def _search_multi(self, targets, body: dict, task=None) -> dict:
+        t0 = time.perf_counter()
         size = int(body.get("size", 10))
         from_ = int(body.get("from", 0))
         sub = {**body, "from": 0, "size": from_ + size}

@@ -25,13 +25,18 @@ Schedule shape (env `ES_TPU_FAULTS`, or `POST /_internal/faults`):
   - ``batcher.dispatch``    (QueryBatcher device-dispatch of one group)
   - ``batcher.collect``     (QueryBatcher host-collect of one group)
   - ``knn.collect``         (kNN group device→host collect)
+  - ``admission.acquire``   (per-request admission gate)
 * ``match``: exact-equality filters over the ctx kwargs the site passes
   (string-compared, so {"shard": 1} matches shard=1).
 * ``kind``: ``error`` (raise InjectedFault, 500-shaped), ``drop``
   (raise InjectedFault shaped like a connect_transport_exception),
   ``delay`` / ``stall`` (sleep ``delay_ms`` then proceed — ``stall``
   is the slow-kernel simulation; both behave identically, the name
-  documents intent).
+  documents intent), ``load`` (no sleep, no raise: ``delay_ms`` is
+  returned to the caller as a SYNTHETIC queue-pressure sample —
+  `check` returns ``{"load_ms": N}`` — so overload schedules replay
+  deterministically without real queue contention; only the
+  admission site consumes it today).
 * ``prob``: trip probability (default 1.0). Draws are a pure hash of
   (seed, rule index, site, ctx, per-ctx attempt counter) — NOT a
   sequential RNG — so the schedule is deterministic regardless of
@@ -85,7 +90,7 @@ class _Rule:
             str(k): str(v) for k, v in (spec.get("match") or {}).items()
         }
         kind = str(spec.get("kind", "error"))
-        if kind not in ("error", "drop", "delay", "stall"):
+        if kind not in ("error", "drop", "delay", "stall", "load"):
             raise ValueError(f"unknown fault kind [{kind}]")
         self.kind = kind
         self.prob = float(spec.get("prob", 1.0))
@@ -164,12 +169,16 @@ class FaultRegistry:
         h = hashlib.sha256(key.encode()).digest()
         return int.from_bytes(h[:8], "big") / 2.0**64
 
-    def check(self, site: str, **ctx) -> None:
-        """Injection point. Raises InjectedFault (error/drop rules) or
-        sleeps (delay/stall rules); a no-op when nothing is armed."""
+    def check(self, site: str, **ctx) -> Optional[dict]:
+        """Injection point. Raises InjectedFault (error/drop rules),
+        sleeps (delay/stall rules), or returns an effects dict (load
+        rules: ``{"load_ms": N}`` — a synthetic queue-pressure sample
+        the admission site feeds into its congestion signal); a no-op
+        returning None when nothing is armed."""
         if not self._rules:  # fast path: unarmed in production
-            return
+            return None
         sleep_ms = 0.0
+        load_ms = 0.0
         boom: Optional[InjectedFault] = None
         with self._lock:
             sig = _ctx_sig(ctx)
@@ -189,6 +198,8 @@ class FaultRegistry:
                 rule.trips += 1
                 if rule.kind in ("delay", "stall"):
                     sleep_ms = max(sleep_ms, rule.delay_ms)
+                elif rule.kind == "load":
+                    load_ms = max(load_ms, rule.delay_ms)
                 elif rule.kind == "drop":
                     boom = InjectedFault(
                         f"injected connection drop at [{site}] ({sig})",
@@ -204,6 +215,7 @@ class FaultRegistry:
             time.sleep(sleep_ms / 1000.0)
         if boom is not None:
             raise boom
+        return {"load_ms": load_ms} if load_ms > 0.0 else None
 
 
 faults = FaultRegistry()

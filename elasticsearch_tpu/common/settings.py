@@ -107,6 +107,25 @@ def mesh_t_max() -> int:
     return max(64, v)
 
 
+# ---- admission-control knobs (search/admission.py) ----
+#
+# ES_TPU_ADMISSION:            "on" (default) | "off" — the per-node
+#                              admission layer (weighted fair queueing,
+#                              AIMD concurrency limit, deadline shed,
+#                              brownout tiers, retry budget) in front
+#                              of the batcher. Tests pin it off and
+#                              arm it explicitly.
+# ES_TPU_ADMISSION_TARGET_MS:  AIMD queue-delay target (default 75):
+#                              the batcher enqueue→dispatch wait the
+#                              limit steers toward.
+# ES_TPU_ADMISSION_MAX_QUEUE:  admission queue bound (default 1024);
+#                              overflow sheds with 429 + Retry-After.
+#
+# The same knobs are dynamically updatable as cluster settings
+# (search.admission.*, registered below; ClusterService wires the
+# update consumers to admission.configure()).
+
+
 def peak_flops() -> float:
     """Accelerator peak FLOP/s for MFU accounting."""
     raw = os.environ.get(PEAK_FLOPS_ENV, "")
@@ -187,6 +206,14 @@ def _non_negative(name):
     return check
 
 
+def _positive_f(name):
+    def check(v):
+        if not (v > 0):
+            raise SettingsError(f"[{name}] must be > 0")
+
+    return check
+
+
 # ---- index-scoped registry (IndexScopedSettings.BUILT_IN_INDEX_SETTINGS) ----
 
 INDEX_SETTINGS: Dict[str, Setting] = {
@@ -211,6 +238,11 @@ INDEX_SETTINGS: Dict[str, Setting] = {
         # per-request ?request_cache= param overrides it either way
         Setting("requests.cache.enable", True, INDEX_SCOPE,
                 parser=_parse_bool),
+        # per-index fair-share weight for the admission layer's stride
+        # scheduler: under contention an index drains admission-queue
+        # slots proportionally to its weight (default equal shares)
+        Setting("search.admission.weight", 1.0, INDEX_SCOPE, parser=float,
+                validator=_positive_f("search.admission.weight")),
         Setting("hidden", False, INDEX_SCOPE, parser=_parse_bool),
         Setting("codec", "default", INDEX_SCOPE, dynamic=False),
         Setting("default_pipeline", None, INDEX_SCOPE),
@@ -234,6 +266,17 @@ CLUSTER_SETTINGS: Dict[str, Setting] = {
                 parser=_parse_bool),
         Setting("search.max_buckets", 65536, parser=int,
                 validator=_positive("search.max_buckets")),
+        # overload-protection layer (search/admission.py): dynamically
+        # updatable; ClusterService wires update consumers through to
+        # admission.configure()
+        Setting("search.admission.enabled", True, parser=_parse_bool),
+        Setting("search.admission.target_delay_ms", 75, parser=int,
+                validator=_positive("search.admission.target_delay_ms")),
+        Setting("search.admission.max_queue", 1024, parser=int,
+                validator=_positive("search.admission.max_queue")),
+        Setting("search.admission.retry_budget.ratio", 0.1, parser=float,
+                validator=_non_negative(
+                    "search.admission.retry_budget.ratio")),
         Setting("indices.recovery.max_bytes_per_sec", "40mb"),
     ]
 }

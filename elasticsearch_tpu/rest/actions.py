@@ -554,6 +554,7 @@ class RestActions:
         batch = {
             "jobs": 0, "launches": 0, "rejected": 0, "fused_jobs": 0,
             "pruned_jobs": 0, "fused_overflow_jobs": 0,
+            "shed_dead_jobs": 0, "cancelled_jobs": 0,
         }
         # serving-pipeline roofline counters (QueryBatcher.pipeline_stats):
         # depth/in_flight of the dispatch ring, device-busy and host-stall
@@ -625,6 +626,7 @@ class RestActions:
             from ..search.batcher import QUEUE_CAPACITY
 
             queue_capacity = QUEUE_CAPACITY
+        from ..search.admission import admission
         from ..search.query_cache import filter_cache, request_cache
 
         # per-category child breakers next to the "hbm" parent (per-
@@ -664,6 +666,11 @@ class RestActions:
                         **category_breakers,
                     },
                     "pipeline": pipeline,
+                    # overload-protection block (search/admission.py):
+                    # per-tenant queue depths, the adaptive concurrency
+                    # limit, pressure tier, shed/brownout/retry-budget
+                    # counters
+                    "admission": admission.stats(),
                     "thread_pool": {
                         "search": {
                             "queue_capacity": queue_capacity,
@@ -675,6 +682,8 @@ class RestActions:
                             "fused_overflow_jobs": batch[
                                 "fused_overflow_jobs"
                             ],
+                            "shed_dead_jobs": batch["shed_dead_jobs"],
+                            "cancelled_jobs": batch["cancelled_jobs"],
                         }
                     },
                     "uptime_in_millis": int(
@@ -1169,6 +1178,12 @@ class RestActions:
             )
         if "timeout" in qs:
             body["timeout"] = qs["timeout"][0]
+        if "allow_degraded" in qs:
+            # brownout opt-out: pins the request to full-fidelity
+            # execution (it can still be shed outright under overload)
+            body["allow_degraded"] = qs["allow_degraded"][0] not in (
+                "false", "0",
+            )
         if "allow_partial_search_results" in qs:
             body["allow_partial_search_results"] = qs[
                 "allow_partial_search_results"

@@ -23,6 +23,7 @@ from ..cluster import ClusterError, ClusterService
 from ..common.memory import CircuitBreakingException
 from ..index.engine import EngineError, VersionConflictError
 from ..index.mapping import MappingParseError
+from ..search.admission import EsOverloadedError, admission, overload_body
 from ..search.aggs import AggParseError
 from ..search.batcher import EsRejectedExecutionError
 from ..search.dsl import QueryParseError
@@ -46,7 +47,10 @@ class ElasticHandler(BaseHTTPRequestHandler):
         length = int(self.headers.get("Content-Length") or 0)
         return self.rfile.read(length) if length else b""
 
-    def _respond(self, status: int, payload, head_only: bool = False) -> None:
+    def _respond(
+        self, status: int, payload, head_only: bool = False,
+        headers: Optional[dict] = None,
+    ) -> None:
         if isinstance(payload, (dict, list)):
             data = json.dumps(payload).encode()
             ctype = "application/json"
@@ -57,6 +61,8 @@ class ElasticHandler(BaseHTTPRequestHandler):
         self.send_header("X-elastic-product", "Elasticsearch")
         self.send_header("Content-Type", ctype)
         self.send_header("Content-Length", str(len(data)))
+        for k, v in (headers or {}).items():
+            self.send_header(k, str(v))
         self.end_headers()
         if not head_only:
             self.wfile.write(data)
@@ -97,6 +103,7 @@ class ElasticHandler(BaseHTTPRequestHandler):
                     head_only,
                 )
             return
+        resp_headers: Optional[dict] = None
         try:
             body = self._parse_body(path, raw)
             status, payload = route.handler(body, params or {}, qs)
@@ -108,16 +115,19 @@ class ElasticHandler(BaseHTTPRequestHandler):
             )
         except (QueryParseError, MappingParseError, AggParseError) as e:
             status, payload = 400, error_body(400, "parsing_exception", str(e))
-        except EsRejectedExecutionError as e:
-            # bounded-queue overflow → 429, the ThreadPool rejection
-            # contract (EsRejectedExecutionException)
-            status, payload = 429, error_body(
-                429, "es_rejected_execution_exception", str(e)
-            )
-        except CircuitBreakingException as e:
-            status, payload = 429, error_body(
-                429, "circuit_breaking_exception", str(e)
-            )
+        except (
+            EsOverloadedError, EsRejectedExecutionError,
+            CircuitBreakingException,
+        ) as e:
+            # EVERY overload rejection — admission shed, bounded-queue
+            # overflow (EsRejectedExecutionException contract), HBM
+            # breaker — is a 429 with a computed Retry-After header and
+            # the structured es.overloaded body block
+            retry_after = getattr(e, "retry_after", None)
+            if retry_after is None:
+                retry_after = admission.retry_after_s()
+            status, payload = 429, overload_body(e, retry_after)
+            resp_headers = {"Retry-After": int(retry_after)}
         except TaskCancelledException as e:
             # a cancelled search surfaces as 400 task_cancelled_exception
             # (TransportSearchAction's cancellation contract)
@@ -132,7 +142,7 @@ class ElasticHandler(BaseHTTPRequestHandler):
             )
         except Exception as e:  # the 500 of last resort
             status, payload = 500, error_body(500, "exception", repr(e))
-        self._respond(status, payload, head_only)
+        self._respond(status, payload, head_only, headers=resp_headers)
 
     def _parse_body(self, path: str, raw: bytes):
         last = path.rstrip("/").rsplit("/", 1)[-1]

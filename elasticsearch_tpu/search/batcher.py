@@ -39,7 +39,9 @@ from ..index.mapping import TEXT
 from ..ops import scoring
 from ..ops.scoring import BPAD
 from . import dsl
+from .admission import admission
 from .executor import Hit, TopDocs
+from .failures import SearchTimeoutError
 
 MAX_BATCH = BPAD
 
@@ -371,10 +373,14 @@ class _Job:
     hybrid BM25 + kNN legs) and collect them in any order."""
 
     __slots__ = (
-        "executor", "kind", "plan", "k", "query", "event", "result", "error"
+        "executor", "kind", "plan", "k", "query", "event", "result",
+        "error", "deadline", "t_enq",
     )
 
-    def __init__(self, executor, plan, k: int, kind: str = "match", query=None):
+    def __init__(
+        self, executor, plan, k: int, kind: str = "match", query=None,
+        deadline: Optional[float] = None,
+    ):
         self.executor = executor
         self.kind = kind  # "match" | "serve" | "knn"
         self.plan = plan
@@ -383,6 +389,10 @@ class _Job:
         self.event = threading.Event()
         self.result: Optional[TopDocs] = None
         self.error: Optional[BaseException] = None
+        # monotonic deadline (the shard's search-timeout budget): a job
+        # still queued past it is dropped at dequeue, never dispatched
+        self.deadline = deadline
+        self.t_enq = time.monotonic()
 
     def done(self) -> bool:
         return self.event.is_set()
@@ -466,6 +476,11 @@ class QueryBatcher:
             # flight on device simultaneously — the observable proof
             # that hybrid legs overlap instead of serializing
             "hybrid_overlap_events": 0,
+            # overload protection: jobs dropped at dequeue because
+            # their deadline budget was already spent (never launched)
+            # and jobs cancelled while still queued (task cancel)
+            "shed_dead_jobs": 0,
+            "cancelled_jobs": 0,
         }
         # family → groups currently dispatched-but-not-collected,
         # across ALL workers (guarded by self._lock)
@@ -513,17 +528,21 @@ class QueryBatcher:
     # ---- client side (async future API) ----
 
     def submit_nowait(
-        self, executor, plan, k: int, kind: str = "match", query=None
+        self, executor, plan, k: int, kind: str = "match", query=None,
+        deadline: Optional[float] = None,
     ) -> _Job:
         """Enqueues a job and returns its future handle WITHOUT waiting.
         Raises EsRejectedExecutionError (429) on queue overflow — the
         async path gets the same backpressure as the blocking one. A
         request thread submits every leg it needs first, then collects
         with `wait(handle)`, so independent legs (hybrid BM25 + kNN)
-        execute concurrently."""
+        execute concurrently. `deadline` (monotonic seconds) is the
+        shard's timeout budget: a job still queued past it is dropped
+        at dequeue instead of dispatched dead."""
         if self._closed:
             raise RuntimeError("query batcher closed")
-        job = _Job(executor, plan, k, kind=kind, query=query)
+        job = _Job(executor, plan, k, kind=kind, query=query,
+                   deadline=deadline)
         self._ensure_thread()
         try:
             self._queue.put_nowait(job)
@@ -557,6 +576,45 @@ class QueryBatcher:
             raise job.error
         return job.result
 
+    def cancel(self, job: _Job, error: Optional[BaseException] = None) -> bool:
+        """Fails a still-pending job's waiter (a task cancel landing
+        before dispatch): the dequeue-time gate then drops the job from
+        the queue, so it never launches. Returns False when the job
+        already completed. A job whose dispatch already started still
+        runs on device, but its waiter is failed and the completion
+        paths leave the error in place (error wins in wait())."""
+        if job.event.is_set():
+            return False
+        if error is None:
+            from ..tasks import TaskCancelledException
+
+            error = TaskCancelledException(
+                "task cancelled [search job cancelled before dispatch]"
+            )
+        with self._lock:
+            self.stats["cancelled_jobs"] += 1
+        job.error = error
+        job.event.set()  # wake AFTER the stats update (observable order)
+        return True
+
+    def _admit_job(self, j: _Job) -> bool:
+        """Dequeue-time gate: cancelled jobs (waiter already failed) are
+        dropped, and a job whose deadline budget is already spent fails
+        its waiter with a timeout instead of dispatching dead — the
+        overload-protection contract that queued work past its deadline
+        never reaches the device."""
+        if j.event.is_set():
+            return False
+        if j.deadline is not None and time.monotonic() > j.deadline:
+            with self._lock:
+                self.stats["shed_dead_jobs"] += 1
+            j.error = SearchTimeoutError(
+                "batched query deadline expired while queued"
+            )
+            j.event.set()  # wake AFTER the stats update (observable order)
+            return False
+        return True
+
     # ---- worker side (pipelined: dispatch ring + deferred collect) ----
 
     def _run(self):
@@ -587,13 +645,15 @@ class QueryBatcher:
                         job.error = RuntimeError("query batcher closed")
                         job.event.set()
                     continue
+                if not self._admit_job(job):
+                    continue
                 batch = [job]
                 while len(batch) < self.max_batch:
                     try:
                         j = self._queue.get_nowait()
                     except queue.Empty:
                         break
-                    if j is not None:
+                    if j is not None and self._admit_job(j):
                         batch.append(j)
                 inflight.append(self._dispatch_batch(batch))
                 while len(inflight) >= max(1, self.pipeline_depth):
@@ -633,6 +693,13 @@ class QueryBatcher:
         ctx = _BatchCtx(batch)
         self._ring_enter()
         try:
+            # congestion signal for the admission layer's AIMD limit:
+            # the worst enqueue→dispatch wait in this batch (the
+            # "queue delay vs target" the adaptive limit steers on)
+            now = time.monotonic()
+            admission.observe_queue_delay(
+                max(now - j.t_enq for j in batch)
+            )
             with self._lock:
                 self.stats["jobs"] += len(batch)
                 self.stats["max_batch_seen"] = max(

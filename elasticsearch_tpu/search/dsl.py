@@ -895,9 +895,13 @@ def canonical_key(q: Any) -> str:
 
 
 def canonical_body_key(body: dict, exclude: tuple = ("request_cache",
-                                                     "preference")) -> str:
+                                                     "preference",
+                                                     "_cache_only",
+                                                     "allow_degraded")) -> str:
     """Canonical request bytes for the shard request cache: the search
-    body minus per-request control flags that don't change the result."""
+    body minus per-request control flags that don't change the result
+    (`_cache_only` is the tier-3 brownout marker — the degraded request
+    must hit the same entry the healthy one populated)."""
     import json
 
     return json.dumps(

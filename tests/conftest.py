@@ -12,6 +12,13 @@ import os
 # var alone is not enough — a sitecustomize may register an accelerator
 # platform and override jax.config, so set the config explicitly too.
 os.environ["JAX_PLATFORMS"] = "cpu"
+
+# Admission control is OFF by default in tier-1 (the CPU box is slow
+# enough that real queue delays would otherwise trip brownout tiers and
+# change parity-test results); tests/test_admission.py arms the
+# controller explicitly via admission.configure(enabled=True) and the
+# _reset_admission fixture below restores process-start state.
+os.environ["ES_TPU_ADMISSION"] = "off"
 xla_flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in xla_flags:
     os.environ["XLA_FLAGS"] = (
@@ -44,6 +51,17 @@ def pytest_configure(config):
 @pytest.fixture(autouse=True)
 def _seed():
     np.random.seed(0)
+
+
+@pytest.fixture(autouse=True)
+def _reset_admission():
+    """A test that arms the admission controller (or merely drove load
+    through the batcher, which feeds its congestion EWMA) must not leak
+    limit/pressure state into the next test."""
+    yield
+    from elasticsearch_tpu.search.admission import admission
+
+    admission.reset()
 
 
 @pytest.fixture(autouse=True)
