@@ -524,6 +524,51 @@ def run_open_loop(
     }
 
 
+def batching_window(b0, b1):
+    """Continuous-batching numbers over one measured window from two
+    QueryBatcher.batching_stats() snapshots: per-bucket launch hit
+    rates, the average padded launch width, occupancy (padding waste),
+    and express-lane hits."""
+    hist = {}
+    for k in set(b0["launches_by_bucket"]) | set(b1["launches_by_bucket"]):
+        d = b1["launches_by_bucket"].get(k, 0) - b0["launches_by_bucket"].get(
+            k, 0
+        )
+        if d > 0:
+            hist[k] = d
+    launches = sum(hist.values())
+    jobs = b1["occupancy_jobs"] - b0["occupancy_jobs"]
+    slots = b1["occupancy_slots"] - b0["occupancy_slots"]
+    return {
+        "launches": launches,
+        "avg_launch_width": round(slots / launches, 2) if launches else 0.0,
+        "avg_occupancy": round(jobs / slots, 4) if slots else 0.0,
+        "bucket_hit_rates": {
+            k: round(v / launches, 4) for k, v in sorted(
+                hist.items(), key=lambda kv: int(kv[0])
+            )
+        },
+        "express_lane_hits": (
+            b1["express_lane_hits"] - b0["express_lane_hits"]
+        ),
+    }
+
+
+def leg_p50s(svc):
+    """Per-leg p50/p99 (ms) from the index's bounded rrf leg-latency
+    reservoirs — the per-request number next to the cumulative
+    bm25_leg_ms/knn_leg_ms averages."""
+    out = {}
+    with svc._rrf_lock:
+        samples = {k: list(v) for k, v in svc.rrf_leg_samples.items()}
+    for leg, vals in samples.items():
+        if vals:
+            arr = np.asarray(vals)
+            out[f"{leg}_leg_p50_ms"] = round(float(np.percentile(arr, 50)), 2)
+            out[f"{leg}_leg_p99_ms"] = round(float(np.percentile(arr, 99)), 2)
+    return out
+
+
 def batch1_p50(svc, bodies, n=32):
     """Single-inflight latency (bench honesty: pipelining gains must not
     hide latency regressions behind batching) — p50 over n sequential
@@ -864,10 +909,15 @@ def main():
             with svc_jax._rrf_lock:
                 for key in svc_jax.rrf_stats:
                     svc_jax.rrf_stats[key] = 0
+                for dq in svc_jax.rrf_leg_samples.values():
+                    dq.clear()
         pipe0 = batcher.pipeline_stats()
+        batch0 = batcher.batching_stats()
         qps, p50, p99, wall = run_load(svc_jax, blist)
         roof = roofline_window(svc_jax, pipe0, wall, len(blist))
+        batch_block = batching_window(batch0, batcher.batching_stats())
         rrf_snapshot = dict(svc_jax.rrf_stats) if name == "hybrid_rrf" else None
+        rrf_leg_block = leg_p50s(svc_jax) if name == "hybrid_rrf" else None
         log(f"[{name}] jax: {qps:.1f} QPS, p50={p50:.2f}ms p99={p99:.2f}ms "
             f"mfu={roof['mfu']:.2e} device_util={roof['device_util']:.3f}")
         # single-inflight latency: throughput-mode batching must not
@@ -907,9 +957,17 @@ def main():
             "vs_oracle": round(qps / o_qps, 2) if o_qps else None,
             "recall": round(recall, 4),
             "max_score_rel_delta": float(f"{max_rel:.3e}"),
+            "batching": batch_block,
             **roof,
             **depth_block,
         }
+        log(
+            f"[{name}] batching: avg_width="
+            f"{batch_block['avg_launch_width']} occupancy="
+            f"{batch_block['avg_occupancy']} "
+            f"buckets={batch_block['bucket_hit_rates']} "
+            f"express={batch_block['express_lane_hits']}"
+        )
         if name == "hybrid_rrf":
             # hybrid execution breakdown: per-leg wall time measured
             # from leg fan-out start (overlapped legs therefore SUM to
@@ -924,6 +982,7 @@ def main():
                     "fuse_ms": round(st["fuse_ms"] / n_rrf, 2),
                     "device_fused": st["device_fused"],
                     "host_fused": st["host_fused"],
+                    **(rrf_leg_block or {}),
                 }
             )
             log(
@@ -931,7 +990,8 @@ def main():
                 f"knn={configs[name]['knn_leg_ms']}ms "
                 f"fuse={configs[name]['fuse_ms']}ms "
                 f"(device_fused={st['device_fused']}, "
-                f"host_fused={st['host_fused']})"
+                f"host_fused={st['host_fused']}, "
+                f"per-leg p50 {rrf_leg_block})"
             )
 
     # WAND variant of the match config (track_total_hits: false)
@@ -1039,55 +1099,98 @@ def main():
     # of collapsing into unbounded queueing. ----
     open_block = None
     if os.environ.get("BENCH_OPEN_LOOP", "1") != "0":
-        closed_qps = configs["match"]["qps"]
+        dur = float(os.environ.get("BENCH_OPEN_SECONDS", 20.0))
+
+        def one_open(config_name, rate_factor, slo_ms, label):
+            """One admission-armed open-loop (Poisson) window on one
+            config; returns the run_open_loop block + admission
+            snapshot."""
+            closed = configs[config_name]["qps"]
+            rate = max(rate_factor * closed, 1.0)
+            log(
+                f"[open_loop:{config_name}:{label}] Poisson arrivals at "
+                f"{rate_factor}x closed-loop peak ({rate:.0f}/s) for "
+                f"{dur:.0f}s, SLO {slo_ms:.0f}ms…"
+            )
+            admission.reset()
+            admission.configure(enabled=True)
+            try:
+                blk = run_open_loop(
+                    svc_jax, bodies[config_name], rate_qps=rate,
+                    duration_s=dur, slo_ms=slo_ms,
+                )
+            finally:
+                adm_stats = admission.stats()
+                admission.reset()
+                admission.configure(enabled=False)
+            blk["rate_factor"] = rate_factor
+            blk["closed_loop_qps"] = closed
+            blk["goodput_vs_closed_loop"] = (
+                round(blk["goodput_qps"] / closed, 3) if closed else None
+            )
+            blk["admission"] = {
+                k: adm_stats[k]
+                for k in (
+                    "limit", "queue_delay_ewma_ms", "pressure_tier",
+                    "admitted", "queued_total", "shed_queue_full",
+                    "shed_deadline", "shed_rejected", "brownouts",
+                    "limit_decreases", "limit_increases",
+                )
+            }
+            log(
+                f"[open_loop:{config_name}:{label}] "
+                f"offered={blk['offered_qps']}/s "
+                f"goodput={blk['goodput_qps']}/s "
+                f"({blk['goodput_vs_closed_loop']}x closed-loop) "
+                f"shed={blk['shed_429']} "
+                f"accepted_p50={blk['accepted_p50_ms']}ms "
+                f"accepted_p99={blk['accepted_p99_ms']}ms "
+                f"limit={blk['admission']['limit']}"
+            )
+            return blk
+
         slo_ms = float(
             os.environ.get(
                 "BENCH_SLO_MS",
                 max(4.0 * configs["match"]["p50_ms"], 250.0),
             )
         )
-        rate_factor = float(os.environ.get("BENCH_OPEN_FACTOR", 2.0))
-        dur = float(os.environ.get("BENCH_OPEN_SECONDS", 20.0))
-        log(
-            f"[open_loop] Poisson arrivals at {rate_factor}x closed-loop "
-            f"peak ({rate_factor * closed_qps:.0f}/s) for {dur:.0f}s, "
-            f"SLO {slo_ms:.0f}ms…"
-        )
-        admission.reset()
-        admission.configure(enabled=True)
-        try:
-            open_block = run_open_loop(
-                svc_jax, bodies["match"],
-                rate_qps=rate_factor * closed_qps,
-                duration_s=dur, slo_ms=slo_ms,
-            )
-        finally:
-            adm_stats = admission.stats()
-            admission.reset()
-            admission.configure(enabled=False)
-        open_block["rate_factor"] = rate_factor
-        open_block["closed_loop_qps"] = closed_qps
-        open_block["goodput_vs_closed_loop"] = (
-            round(open_block["goodput_qps"] / closed_qps, 3)
-            if closed_qps
-            else None
-        )
-        open_block["admission"] = {
-            k: adm_stats[k]
-            for k in (
-                "limit", "queue_delay_ewma_ms", "pressure_tier",
-                "admitted", "queued_total", "shed_queue_full",
-                "shed_deadline", "shed_rejected", "brownouts",
-                "limit_decreases", "limit_increases",
-            )
+        over_factor = float(os.environ.get("BENCH_OPEN_FACTOR", 2.0))
+        mod_factor = float(os.environ.get("BENCH_OPEN_MODERATE_FACTOR", 0.4))
+        # moderate load FIRST: its accepted p50 is the interactive-
+        # latency headline the pad-bucket ladder exists for (a lone
+        # arrival rides the express lane at bucket 1 instead of a padded
+        # full-width launch); the 2x overload window after it is the
+        # PR 6 protection claim
+        open_block = {
+            "match": {
+                "moderate": one_open(
+                    "match", mod_factor, slo_ms, "moderate"
+                ),
+                "overload": one_open(
+                    "match", over_factor, slo_ms, "overload"
+                ),
+            }
         }
+        # hybrid_rrf joins the open-loop mode: the worst closed-loop p50
+        # offender — both legs now ride bucketed launches; per-leg p50
+        # shows where the remaining time goes
+        hy_slo = float(
+            os.environ.get(
+                "BENCH_HYBRID_SLO_MS",
+                max(4.0 * configs["hybrid_rrf"]["p50_ms"], 1000.0),
+            )
+        )
+        with svc_jax._rrf_lock:
+            for dq in svc_jax.rrf_leg_samples.values():
+                dq.clear()
+        hy = one_open("hybrid_rrf", mod_factor, hy_slo, "moderate")
+        hy.update(leg_p50s(svc_jax))
+        open_block["hybrid_rrf"] = {"moderate": hy}
         log(
-            f"[open_loop] offered={open_block['offered_qps']}/s "
-            f"goodput={open_block['goodput_qps']}/s "
-            f"({open_block['goodput_vs_closed_loop']}x closed-loop) "
-            f"shed={open_block['shed_429']} "
-            f"accepted_p99={open_block['accepted_p99_ms']}ms "
-            f"limit={open_block['admission']['limit']}"
+            f"[open_loop:hybrid_rrf] per-leg p50: "
+            f"bm25={hy.get('bm25_leg_p50_ms')}ms "
+            f"knn={hy.get('knn_leg_p50_ms')}ms"
         )
 
     # cumulative serving-pipeline roofline block (the "23× vs oracle"

@@ -409,6 +409,15 @@ class IndexService:
             "device_fused": 0,
             "host_fused": 0,
         }
+        # bounded per-leg latency reservoirs (newest-wins) so bench.py
+        # can report per-leg p50/p99 next to the cumulative averages —
+        # kept OUTSIDE rrf_stats, whose values are reset-to-zero numbers
+        from collections import deque as _deque
+
+        self.rrf_leg_samples = {
+            "bm25": _deque(maxlen=4096),
+            "knn": _deque(maxlen=4096),
+        }
 
     # ---- routing ----
 
@@ -838,13 +847,24 @@ class IndexService:
 
     def _wait_batched(self, job, sid: int, shard_deadline, task):
         """Collects a batcher future under the shard's timeout budget
-        and the request task's cancellation. An expired budget raises
-        SearchTimeoutError (the worker sheds the queued job at dequeue
-        too); a cancel landing while the job is still queued cancels it
-        in place — dropped from the queue, never launched — and
-        propagates task_cancelled_exception to the coordinator."""
+        and the request task's cancellation. An expired budget CANCELS
+        the job before raising SearchTimeoutError — a bare abandon would
+        leave the job queued, where it could later dispatch into this
+        dead waiter (wasted device work nobody reads); cancelling makes
+        the dequeue-time gate drop it so it never launches. A task
+        cancel landing while the job is still queued cancels it in place
+        the same way and propagates task_cancelled_exception."""
         from ..search.batcher import QueryBatcher
         from ..tasks import TaskCancelledException
+
+        def _timeout() -> SearchTimeoutError:
+            err = SearchTimeoutError(
+                f"shard [{self.name}][{sid}] batched query "
+                "exceeded the search timeout budget"
+            )
+            # never abandon the job: cancelled → dropped at dequeue
+            self._batcher.cancel(job, error=err)
+            return err
 
         step = 0.02 if (task is not None and task.cancellable) else None
         while True:
@@ -858,10 +878,7 @@ class IndexService:
             if shard_deadline is not None:
                 remaining = shard_deadline - time.monotonic()
                 if remaining <= 0 and not job.done():
-                    raise SearchTimeoutError(
-                        f"shard [{self.name}][{sid}] batched query "
-                        "exceeded the search timeout budget"
-                    )
+                    raise _timeout()
                 wait_s = (
                     remaining if wait_s is None
                     else min(wait_s, max(remaining, 0.0))
@@ -873,10 +890,7 @@ class IndexService:
                     time.monotonic() < shard_deadline
                 ):
                     continue  # poll tick; budget not spent yet
-                raise SearchTimeoutError(
-                    f"shard [{self.name}][{sid}] batched query "
-                    "exceeded the search timeout budget"
-                )
+                raise _timeout()
 
     def shard_search_local(
         self, sid: int, body: Optional[dict], pinned_executor=None,
@@ -2352,6 +2366,7 @@ class IndexService:
             for leg in legs:
                 if leg["label"] in ("bm25", "knn"):
                     st[f"{leg['label']}_leg_ms"] += leg["ms"]
+                    self.rrf_leg_samples[leg["label"]].append(leg["ms"])
         return fused
 
     def _submit_leg(

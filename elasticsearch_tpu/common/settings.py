@@ -37,6 +37,78 @@ PIPELINE_DEPTH_DEFAULT = 2
 PEAK_FLOPS_ENV = "ES_TPU_PEAK_FLOPS"
 PEAK_FLOPS_DEFAULT = 1.97e14
 
+# ---- continuous-batching launch-shape ladder (search/batcher.py) ----
+#
+# ES_TPU_BATCH_BUCKETS:  comma/space-separated query-row bucket sizes the
+#                        serving kernels compile at (default derived from
+#                        the BPAD cap: "1,4,8,16,…,BPAD"). Dispatch pads a
+#                        group to the SMALLEST bucket >= its occupancy, so
+#                        a batch of 3 jobs pays a 4-wide launch instead of
+#                        the full fixed width. "32" reproduces the
+#                        pre-ladder fixed-shape behavior (the latency-
+#                        smoke baseline). Values outside [1, BPAD] are
+#                        dropped; an empty/invalid list falls back to the
+#                        default ladder.
+# ES_TPU_BUCKET_WARMUP:  "1" (default) | "0" — eagerly compile every
+#                        ladder bucket of a kernel family the first time
+#                        that family dispatches, so bucket selection never
+#                        compiles on the steady-state hot path. Tier-1
+#                        pins it off (tests/conftest.py) to keep suite
+#                        compile time down; tests re-arm it per batcher.
+
+BATCH_BUCKETS_ENV = "ES_TPU_BATCH_BUCKETS"
+BATCH_WARMUP_ENV = "ES_TPU_BUCKET_WARMUP"
+
+_BUCKETS_MEMO: Dict[Any, tuple] = {}
+
+
+def _default_batch_buckets(bpad: int) -> tuple:
+    out = [1]
+    b = 4
+    while b < bpad:
+        out.append(b)
+        b *= 2
+    if bpad not in out:
+        out.append(bpad)
+    return tuple(out)
+
+
+def batch_buckets(bpad: int = 32) -> tuple:
+    """Ascending launch-shape ladder for the query-row dimension."""
+    raw = os.environ.get(BATCH_BUCKETS_ENV, "").strip()
+    key = (raw, int(bpad))
+    memo = _BUCKETS_MEMO.get(key)
+    if memo is not None:
+        return memo
+    vals: tuple = ()
+    if raw:
+        try:
+            parsed = sorted({int(x) for x in raw.replace(",", " ").split()})
+            vals = tuple(v for v in parsed if 1 <= v <= bpad)
+        except ValueError:
+            vals = ()
+    if not vals:
+        vals = _default_batch_buckets(bpad)
+    _BUCKETS_MEMO[key] = vals
+    return vals
+
+
+def bucket_for(n: int, buckets, multiple_of: int = 1) -> int:
+    """Smallest ladder bucket >= n (and divisible by `multiple_of`, the
+    mesh ``data``-axis constraint). Falls back to rounding n up to the
+    multiple when no ladder entry qualifies."""
+    m = max(1, int(multiple_of))
+    for b in buckets:
+        if b >= n and b % m == 0:
+            return b
+    return m * (-(-max(int(n), 1) // m))
+
+
+def bucket_warmup() -> bool:
+    """Whether first-dispatch eager bucket warmup is enabled."""
+    raw = os.environ.get(BATCH_WARMUP_ENV, "").strip().lower()
+    return raw not in ("0", "off", "false")
+
 
 def pipeline_depth() -> int:
     """Dispatcher in-flight ring depth (>= 1)."""

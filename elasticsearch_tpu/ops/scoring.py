@@ -111,15 +111,22 @@ class BatchedScoreResult(NamedTuple):
 # The round-2 lesson: compiling one XLA program per (B, T) bucket melts
 # down at corpus scale (T grows with term df under Zipf; warmup was 14
 # minutes). The fix is the standard TPU serving recipe: FIX every shape.
-# The batch dimension is always BPAD rows (short batches pad with invalid
-# rows — the accumulator init they waste is microseconds), and tile lists
-# of any length stream through launches of exactly TCHUNK tiles per row,
-# accumulating into a DONATED dense per-doc accumulator. The whole
-# serving path therefore compiles a handful of programs total, once,
-# regardless of corpus size, term frequency, or concurrency.
+# Tile lists of any length stream through launches of exactly TCHUNK
+# tiles per row, accumulating into a DONATED dense per-doc accumulator.
+#
+# The round-7 refinement: the query-row dimension is no longer a single
+# fixed width. Every kernel family compiles at a small LADDER of row
+# buckets (common/settings.batch_buckets, default 1/4/8/16/32, capped at
+# BPAD) and dispatch pads a group to the smallest bucket >= occupancy —
+# so a lone query pays a 1-wide launch, not a 32-wide one, and closed-
+# loop batches still coalesce up to BPAD. The ladder stays tiny and
+# data-independent (row counts, never tile counts), so the compile-count
+# blowup the round-2 lesson warns about cannot recur: the serving path
+# compiles len(buckets) programs per family total, eagerly warmed on a
+# family's first dispatch (search/batcher.py _maybe_warm).
 # ---------------------------------------------------------------------------
 
-BPAD = 32  # fixed query rows per launch
+BPAD = 32  # max query rows per launch (top of the bucket ladder)
 TCHUNK = 512  # fixed tiles per row per launch
 
 # ---- FLOP estimates for MFU/roofline accounting -------------------------
@@ -246,13 +253,16 @@ class ChunkedScorer:
         self.n_docs = int(self.inv_norm.shape[0])
         self.block_size = block_size
 
-    def new_acc(self, with_cnt: bool):
-        acc = jnp.zeros((BPAD, self.n_docs + 1), jnp.float32)
-        cnt = jnp.zeros((BPAD, self.n_docs + 1), jnp.int32) if with_cnt else None
+    def new_acc(self, with_cnt: bool, rows: int = BPAD):
+        """`rows` is the launch's query-row bucket (<= BPAD): the whole
+        chunked pipeline — accumulators, staged tile planes, finalize —
+        compiles per bucket, so short batches pay small launches."""
+        acc = jnp.zeros((rows, self.n_docs + 1), jnp.float32)
+        cnt = jnp.zeros((rows, self.n_docs + 1), jnp.int32) if with_cnt else None
         return acc, cnt
 
     def score_into(self, acc, cnt, tile_lists, weight_lists, staging=None):
-        """Streams per-row tile/weight lists (≤ BPAD rows, any length)
+        """Streams per-row tile/weight lists (≤ acc rows, any length)
         through TCHUNK-wide launches into the donated accumulators.
 
         `staging` optionally supplies reusable host buffers — a callable
@@ -260,17 +270,18 @@ class ChunkedScorer:
         staging slabs) — instead of fresh allocations per chunk. Only the
         validity plane needs clearing: stale tile ids/weights under
         tv=False rows contribute exactly zero (and gathers clamp)."""
+        rows = int(acc.shape[0])
         t_max = max((len(t) for t in tile_lists), default=0)
         for c0 in range(0, t_max, TCHUNK):
             if staging is not None:
-                ti = staging("chunk_ti", (BPAD, TCHUNK), np.int32)
-                tw = staging("chunk_tw", (BPAD, TCHUNK), np.float32)
-                tv = staging("chunk_tv", (BPAD, TCHUNK), np.bool_)
+                ti = staging("chunk_ti", (rows, TCHUNK), np.int32)
+                tw = staging("chunk_tw", (rows, TCHUNK), np.float32)
+                tv = staging("chunk_tv", (rows, TCHUNK), np.bool_)
                 tv[:] = False
             else:
-                ti = np.zeros((BPAD, TCHUNK), np.int32)
-                tw = np.zeros((BPAD, TCHUNK), np.float32)
-                tv = np.zeros((BPAD, TCHUNK), bool)
+                ti = np.zeros((rows, TCHUNK), np.int32)
+                tw = np.zeros((rows, TCHUNK), np.float32)
+                tv = np.zeros((rows, TCHUNK), bool)
             for j, (tl, wl) in enumerate(zip(tile_lists, weight_lists)):
                 sl = tl[c0 : c0 + TCHUNK]
                 m = len(sl)
@@ -418,15 +429,23 @@ class FusedScorer:
     def plan_shape(self):
         return (BPAD, 2 * self.t_rare + 2 * self.n_hot_slots + 1)
 
-    def pack_plans(self, plans, out=None) -> np.ndarray:
+    def plan_shape_rows(self, rows: int):
+        """Plan shape at one query-row bucket of the launch ladder."""
+        return (rows, 2 * self.t_rare + 2 * self.n_hot_slots + 1)
+
+    def pack_plans(self, plans, out=None, rows=None) -> np.ndarray:
         """plans: per job (rare_tiles i64[], rare_w f32[], hot_ranks
-        i64[], hot_w f32[], msm int). Jobs beyond BPAD are an error;
-        overflowing a slot budget must be handled by the caller. `out`
+        i64[], hot_w f32[], msm int). Jobs beyond the row bucket are an
+        error; overflowing a slot budget must be handled by the caller.
+        `rows` picks the launch's query-row bucket (default BPAD); `out`
         optionally reuses a persistent staging slab (fully rewritten:
         every region is reset before the per-job fills)."""
         T, H = self.t_rare, self.n_hot_slots
         if out is None:
-            out = np.empty(self.plan_shape, np.int32)
+            out = np.empty(
+                self.plan_shape if rows is None else self.plan_shape_rows(rows),
+                np.int32,
+            )
         out[:, :T] = -1
         out[:, T : 2 * T] = 0
         out[:, 2 * T : 2 * T + H] = -1
@@ -442,7 +461,7 @@ class FusedScorer:
         return out
 
     def search_async(self, plans, k: int, with_cnt: bool, live=None,
-                     staging=None):
+                     staging=None, rows=None):
         """Launches the fused kernel WITHOUT waiting for the result:
         returns (device_out, k) for decode_result(). Device dispatch is
         async in jax, so a caller can launch several groups (e.g. the
@@ -451,14 +470,16 @@ class FusedScorer:
         constructor's live-docs mask — cached filter bitsets mask the
         kernel through this operand (traced arg: no recompile).
         `staging` optionally supplies the reusable plan-upload buffer
-        (a (family, shape, dtype) → np.ndarray callable)."""
+        (a (family, shape, dtype) → np.ndarray callable); `rows` the
+        launch's query-row bucket (default BPAD)."""
         k = min(k, self.n_docs)
+        shape = self.plan_shape if rows is None else self.plan_shape_rows(rows)
         buf = (
-            staging("fused_plan", self.plan_shape, np.int32)
+            staging("fused_plan", shape, np.int32)
             if staging is not None
             else None
         )
-        packed = self.pack_plans(plans, out=buf)
+        packed = self.pack_plans(plans, out=buf, rows=shape[0])
         out = _fused_query(
             self.doc_ids,
             self.tfs,
@@ -494,11 +515,11 @@ class FusedScorer:
         scores = jax.lax.bitcast_convert_type(out[:, :k], jnp.float32)
         return scores, out[:, k : 2 * k], out[:, 2 * k]
 
-    def search(self, plans, k: int, with_cnt: bool, live=None):
+    def search(self, plans, k: int, with_cnt: bool, live=None, rows=None):
         """One device round trip for up to BPAD jobs. Returns
         (scores f32[B,k], docs i32[B,k], totals i64[B])."""
         return self.decode_result(
-            self.search_async(plans, k, with_cnt, live=live)
+            self.search_async(plans, k, with_cnt, live=live, rows=rows)
         )
 
 
@@ -609,16 +630,24 @@ class MultiFusedScorer:
         sec = 2 * self.t_rare + 2 * self.n_hot_slots
         return (BPAD, len(self.fields) * sec + 1)
 
-    def pack_plans(self, plans, out=None) -> np.ndarray:
+    def plan_shape_rows(self, rows: int):
+        sec = 2 * self.t_rare + 2 * self.n_hot_slots
+        return (rows, len(self.fields) * sec + 1)
+
+    def pack_plans(self, plans, out=None, rows=None) -> np.ndarray:
         """plans: per job, a list of F per-field tuples
         (rare_tiles i64[], rare_w_signed f32[], hot_ranks i64[],
-        hot_w_signed f32[]) plus a trailing msm int. `out` optionally
+        hot_w_signed f32[]) plus a trailing msm int. `rows` picks the
+        launch's query-row bucket (default BPAD); `out` optionally
         reuses a persistent staging slab (fully rewritten)."""
         T, H = self.t_rare, self.n_hot_slots
         F = len(self.fields)
         sec = 2 * T + 2 * H
         if out is None:
-            out = np.empty(self.plan_shape, np.int32)
+            out = np.empty(
+                self.plan_shape if rows is None else self.plan_shape_rows(rows),
+                np.int32,
+            )
         out[:] = -1
         for f in range(F):
             base = f * sec
@@ -638,18 +667,20 @@ class MultiFusedScorer:
         return out
 
     def search_async(self, plans, k: int, combine: str, tie: float,
-                     live=None, staging=None):
+                     live=None, staging=None, rows=None):
         """Async launch (see FusedScorer.search_async): returns
         (device_out, k) for decode_result(). `live` optionally overrides
         the live-docs mask (cached filter bitsets ride here); `staging`
-        optionally supplies the reusable plan-upload buffer."""
+        optionally supplies the reusable plan-upload buffer; `rows` the
+        launch's query-row bucket (default BPAD)."""
         k = min(k, self.n_docs)
+        shape = self.plan_shape if rows is None else self.plan_shape_rows(rows)
         buf = (
-            staging("fused_plan_mf", self.plan_shape, np.int32)
+            staging("fused_plan_mf", shape, np.int32)
             if staging is not None
             else None
         )
-        packed = self.pack_plans(plans, out=buf)
+        packed = self.pack_plans(plans, out=buf, rows=shape[0])
         out = _fused_query_mf(
             tuple(p["doc_ids"] for p in self.parts),
             tuple(p["tfs"] for p in self.parts),
@@ -668,9 +699,10 @@ class MultiFusedScorer:
     decode_result = staticmethod(FusedScorer.decode_result)
     device_result = staticmethod(FusedScorer.device_result)
 
-    def search(self, plans, k: int, combine: str, tie: float, live=None):
+    def search(self, plans, k: int, combine: str, tie: float, live=None,
+               rows=None):
         return self.decode_result(
-            self.search_async(plans, k, combine, tie, live=live)
+            self.search_async(plans, k, combine, tie, live=live, rows=rows)
         )
 
 

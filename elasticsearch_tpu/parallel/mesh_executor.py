@@ -47,6 +47,8 @@ import numpy as np
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from ..common.settings import (
+    batch_buckets,
+    bucket_for,
     mesh_data_axis,
     mesh_devices_cap,
     mesh_mode,
@@ -436,7 +438,19 @@ class MeshExecutor:
 
     # ---- plan packing (host side; mirrors the sequential builders) ----
 
-    def _pack_match(self, snap, view, jobs, t_cap):
+    def _rows_for(self, snap, n_jobs: int) -> int:
+        """The SPMD launch's query-row bucket: the same pad-bucket
+        ladder as the single-device batcher, constrained to a multiple
+        of the mesh ``data`` axis (the query batch is sharded along it)
+        so routing a single query through the mesh doesn't reintroduce
+        the full BPAD-row floor."""
+        n_data = int(snap.mesh.shape.get(DATA_AXIS, 1))
+        return min(
+            bucket_for(n_jobs, batch_buckets(BPAD), multiple_of=n_data),
+            max(BPAD, n_data),
+        )
+
+    def _pack_match(self, snap, view, jobs, t_cap, rows: int):
         """Per-(entry, job) tile plans in EXACTLY the sequential
         _run_group order: BlockMaxIndex.plan term order, all tiles
         essential (no pruning on the mesh path)."""
@@ -474,9 +488,9 @@ class MeshExecutor:
                 row.append((ti, tw))
             lists.append(row)
         T = scoring.next_bucket(t_max)
-        ti_a = np.zeros((e_pad, BPAD, T), np.int32)
-        tw_a = np.zeros((e_pad, BPAD, T), np.float32)
-        tv_a = np.zeros((e_pad, BPAD, T), bool)
+        ti_a = np.zeros((e_pad, rows, T), np.int32)
+        tw_a = np.zeros((e_pad, rows, T), np.float32)
+        tv_a = np.zeros((e_pad, rows, T), bool)
         for e, row in enumerate(lists):
             for ji, (ti, tw) in enumerate(row):
                 if ti is None or not len(ti):
@@ -486,7 +500,7 @@ class MeshExecutor:
                 tv_a[e, ji, : len(ti)] = True
         return ti_a, tw_a, tv_a, T, slots
 
-    def _pack_serve_field(self, snap, view, jobs, field, t_cap):
+    def _pack_serve_field(self, snap, view, jobs, field, t_cap, rows: int):
         """One field's signed-weight tile plans (the MultiFusedScorer
         weight-sign convention via JaxExecutor.fused_plan_field's float
         path: w = weights[tid] * boost * term_boost, negated when the
@@ -534,9 +548,9 @@ class MeshExecutor:
                 row.append((ti, tw))
             lists.append(row)
         T = scoring.next_bucket(t_max)
-        ti_a = np.zeros((e_pad, BPAD, T), np.int32)
-        tw_a = np.zeros((e_pad, BPAD, T), np.float32)
-        tv_a = np.zeros((e_pad, BPAD, T), bool)
+        ti_a = np.zeros((e_pad, rows, T), np.int32)
+        tw_a = np.zeros((e_pad, rows, T), np.float32)
+        tv_a = np.zeros((e_pad, rows, T), bool)
         for e, row in enumerate(lists):
             for ji, (ti, tw) in enumerate(row):
                 if ti is None or not len(ti):
@@ -552,8 +566,11 @@ class MeshExecutor:
         snap = self.ensure_snapshot()
         field = jobs[0].plan.field
         view = self._text_view(snap, field)
-        ti, tw, tv, T, slots = self._pack_match(snap, view, jobs, mesh_t_max())
-        msm = np.ones(BPAD, np.int32)
+        rows = self._rows_for(snap, len(jobs))
+        ti, tw, tv, T, slots = self._pack_match(
+            snap, view, jobs, mesh_t_max(), rows
+        )
+        msm = np.ones(rows, np.int32)
         msm[: len(jobs)] = [j.plan.msm for j in jobs]
         with_cnt = any(j.plan.msm > 1 for j in jobs)
         step = self._text_step(
@@ -565,26 +582,27 @@ class MeshExecutor:
             self.stats["launches"] += 1
             self.stats["jobs"] += len(jobs)
         flops = scoring.text_plan_flops(slots, 0, 0)
-        return {"snap": snap, "out": out, "flops": flops}
+        return {"snap": snap, "out": out, "flops": flops, "rows": rows}
 
     def dispatch_serve(self, jobs, kb: int):
         snap = self.ensure_snapshot()
         plan0 = jobs[0].plan
         fields = plan0.fields
         t_cap = mesh_t_max()
+        rows = self._rows_for(snap, len(jobs))
         ti_f, tw_f, tv_f, t_shapes = [], [], [], []
         slots = 0
         for f in fields:
             view = self._text_view(snap, f)
             ti, tw, tv, T, s = self._pack_serve_field(
-                snap, view, jobs, f, t_cap
+                snap, view, jobs, f, t_cap, rows
             )
             ti_f.append(ti)
             tw_f.append(tw)
             tv_f.append(tv)
             t_shapes.append(T)
             slots += s
-        msm = np.ones(BPAD, np.int32)
+        msm = np.ones(rows, np.int32)
         msm[: len(jobs)] = [j.plan.msm for j in jobs]
         step = self._text_step(
             snap, fields, kb, tuple(t_shapes), True, True,
@@ -596,7 +614,7 @@ class MeshExecutor:
             self.stats["launches"] += 1
             self.stats["jobs"] += len(jobs)
         flops = scoring.text_plan_flops(slots, 0, 0)
-        return {"snap": snap, "out": out, "flops": flops}
+        return {"snap": snap, "out": out, "flops": flops, "rows": rows}
 
     def collect_match(self, jobs, pend):
         self._collect_text(jobs, pend)
@@ -636,8 +654,9 @@ class MeshExecutor:
         view = self._knn_view(snap, field)
         dims = view["dims"]
         n_max = snap.n_docs_max
-        q = np.zeros((BPAD, dims), np.float32)
-        nc = np.zeros((snap.e_pad, BPAD), np.int32)
+        rows = self._rows_for(snap, len(jobs))
+        q = np.zeros((rows, dims), np.float32)
+        nc = np.zeros((snap.e_pad, rows), np.int32)
         max_nc = 1
         for ji, j in enumerate(jobs):
             if len(j.plan.vector) != dims:
@@ -657,7 +676,7 @@ class MeshExecutor:
             self.stats["jobs"] += len(jobs)
         total_docs = int(view["n_per_entry"].sum())
         flops = scoring.knn_flops(len(jobs), total_docs, dims)
-        return {"snap": snap, "out": out, "flops": flops}
+        return {"snap": snap, "out": out, "flops": flops, "rows": rows}
 
     def collect_knn(self, jobs, pend):
         from ..common.faults import faults

@@ -564,6 +564,17 @@ class RestActions:
             "host_stall_ms": 0.0, "flops": 0, "mfu": 0.0,
         }
         queue_capacity = 0
+        # continuous-batching counters (QueryBatcher.batching_stats):
+        # per-bucket launch histogram + occupancy, so padding waste is a
+        # measured number; express_lane_hits counts depth-1 lone-query
+        # dispatches
+        batching = {
+            "buckets": [],
+            "launches_by_bucket": {},
+            "occupancy_jobs": 0,
+            "occupancy_slots": 0,
+            "express_lane_hits": 0,
+        }
         # per-device roofline rows (straggler visibility): busy time and
         # flops merged by device id across every index's batcher
         dev_agg: dict = {}
@@ -590,6 +601,16 @@ class RestActions:
                     )
                     d["device_busy_ms"] += row["device_busy_ms"]
                     d["flops"] += row["flops"]
+                bs = b.batching_stats()
+                if len(bs["buckets"]) > len(batching["buckets"]):
+                    batching["buckets"] = bs["buckets"]
+                for bk, n in bs["launches_by_bucket"].items():
+                    batching["launches_by_bucket"][bk] = (
+                        batching["launches_by_bucket"].get(bk, 0) + n
+                    )
+                batching["occupancy_jobs"] += bs["occupancy_jobs"]
+                batching["occupancy_slots"] += bs["occupancy_slots"]
+                batching["express_lane_hits"] += bs["express_lane_hits"]
             mex = getattr(idx, "_mesh", None)
             if mex is not None:
                 for k in mesh_stats:
@@ -621,6 +642,17 @@ class RestActions:
             }
             for d in sorted(dev_agg.values(), key=lambda r: r["id"])
         ]
+        batching["avg_occupancy"] = (
+            round(batching["occupancy_jobs"] / batching["occupancy_slots"], 4)
+            if batching["occupancy_slots"]
+            else 0.0
+        )
+        if not batching["buckets"]:
+            from ..common.settings import batch_buckets
+            from ..ops.scoring import BPAD
+
+            batching["buckets"] = list(batch_buckets(BPAD))
+        pipeline["batching"] = batching
         pipeline["mesh"] = mesh_stats
         if queue_capacity == 0:
             from ..search.batcher import QUEUE_CAPACITY

@@ -11,6 +11,19 @@ executing on device, arriving requests queue; the worker drains the
 whole queue the moment it frees up, so there is no linger timer and no
 added idle latency for a lone request.
 
+Launch shapes ride a pad-bucket LADDER (common/settings.batch_buckets,
+default 1/4/8/16/32): each dispatched group pads its query rows to the
+smallest compiled bucket >= its occupancy instead of the full BPAD
+width, so a batch of 3 pays a 4-wide launch and a lone query a 1-wide
+one — the continuous-batching half of the tail-latency work (the PR 6
+admission layer is the QoS half). Lone queries arriving on an idle
+worker additionally take a depth-1 EXPRESS LANE: dispatched immediately
+at bucket 1 and collected before the next dequeue, skipping the
+in-flight ring entirely. Every ladder bucket of a kernel family is
+eagerly warmed on that family's first dispatch (`_maybe_warm`, gated by
+ES_TPU_BUCKET_WARMUP), so bucket selection never compiles on the
+steady-state hot path.
+
 Collection mode follows ES semantics (QueryPhase + WANDScorer:
 totalHitsThreshold defaults to 10_000): unless the caller asks for
 exact totals (`track_total_hits: true`), block-max pruning is the
@@ -35,6 +48,7 @@ from typing import Deque, Dict, List, Optional, Tuple, Union
 import numpy as np
 
 from ..common.faults import faults
+from ..common.settings import batch_buckets, bucket_for, bucket_warmup
 from ..index.mapping import TEXT
 from ..ops import scoring
 from ..ops.scoring import BPAD
@@ -435,7 +449,14 @@ class QueryBatcher:
     ):
         from ..common.settings import pipeline_depth as _default_depth
 
-        self.max_batch = min(max_batch, BPAD)
+        # pad-bucket launch ladder (ES_TPU_BATCH_BUCKETS): dispatched
+        # groups pad to the smallest bucket >= occupancy; the top of
+        # the ladder bounds how many jobs one batch may carry
+        self.buckets = batch_buckets(BPAD)
+        # eager per-family bucket warmup on first dispatch (mutable per
+        # instance; tier-1 pins the env off, tests re-arm per batcher)
+        self.warmup_enabled = bucket_warmup()
+        self.max_batch = min(max_batch, BPAD, self.buckets[-1])
         self.workers = workers
         # in-flight ring bound per worker (ES_TPU_PIPELINE_DEPTH):
         # depth=1 is the classic dispatch→collect loop; depth=2 double-
@@ -481,7 +502,19 @@ class QueryBatcher:
             # and jobs cancelled while still queued (task cancel)
             "shed_dead_jobs": 0,
             "cancelled_jobs": 0,
+            # continuous batching: lone queries dispatched depth-1 on an
+            # idle worker (bucket-1 launch, collected before the next
+            # dequeue — the interactive-latency fast path)
+            "express_lane_hits": 0,
         }
+        # per-bucket launch histogram + occupancy sums (guarded by
+        # self._lock; surfaced via batching_stats() → _nodes/stats):
+        # padding waste becomes a measured number instead of a guess
+        self._bucket_launches: Dict[int, int] = {}
+        self._occ_jobs = 0
+        self._occ_slots = 0
+        # (family-signature) keys whose bucket ladder is already warmed
+        self._warmed: set = set()
         # family → groups currently dispatched-but-not-collected,
         # across ALL workers (guarded by self._lock)
         self._inflight = {"text": 0, "knn": 0}
@@ -576,6 +609,26 @@ class QueryBatcher:
             raise job.error
         return job.result
 
+    def wait_or_cancel(
+        self, job: _Job, timeout: Optional[float] = None
+    ) -> TopDocs:
+        """wait() that never abandons the job on timeout: a bare
+        wait(timeout) leaves a timed-out job queued, where it can later
+        dispatch into a waiter that already gave up — wasted device work
+        and a completion nobody reads. Here the timeout cancels the job
+        first (the dequeue-time gate then drops it — it never launches)
+        and only then propagates TimeoutError."""
+        try:
+            return self.wait(job, timeout)
+        except TimeoutError:
+            self.cancel(
+                job,
+                error=TimeoutError(
+                    "batched query did not complete in time"
+                ),
+            )
+            raise
+
     def cancel(self, job: _Job, error: Optional[BaseException] = None) -> bool:
         """Fails a still-pending job's waiter (a task cancel landing
         before dispatch): the dequeue-time gate then drops the job from
@@ -655,6 +708,16 @@ class QueryBatcher:
                         break
                     if j is not None and self._admit_job(j):
                         batch.append(j)
+                if len(batch) == 1 and not inflight:
+                    # express lane: a lone query on an idle worker skips
+                    # the in-flight ring — dispatch at bucket 1, collect
+                    # before the next dequeue. Depth-1 semantics for the
+                    # latency-critical empty-queue case; under load the
+                    # drain above yields batch > 1 and the ring engages.
+                    with self._lock:
+                        self.stats["express_lane_hits"] += 1
+                    self._collect_batch(self._dispatch_batch(batch))
+                    continue
                 inflight.append(self._dispatch_batch(batch))
                 while len(inflight) >= max(1, self.pipeline_depth):
                     self._collect_batch(inflight.popleft())
@@ -738,6 +801,11 @@ class QueryBatcher:
                 kind, kb = key[1], key[-1]
                 mesh = kind in ("Mm", "Ms", "Mk")
                 fam = "knn" if kind in ("k", "Mk") else "text"
+                # pad-bucket ladder: the group's launch width is the
+                # smallest compiled bucket covering its occupancy —
+                # mesh groups pick theirs internally (the data-axis
+                # divisibility constraint lives there)
+                rows = None if mesh else bucket_for(len(jobs), self.buckets)
                 dev_ids: Tuple[int, ...] = (0,)
                 dev_entered = False
                 self._enter_kind(fam)
@@ -753,20 +821,31 @@ class QueryBatcher:
                         mesh=int(mesh),
                     )
                     if kind == "m":
-                        self._run_group(jobs, key[2], kb)
+                        # record BEFORE dispatch: match groups complete
+                        # their waiters inside _run_group, and a waiter
+                        # must never observe its own launch missing
+                        # from the histogram
+                        self._record_bucket(rows, len(jobs))
+                        self._run_group(jobs, key[2], kb, rows=rows)
+                        self._maybe_warm(key, jobs, kb, rows)
                     elif kind == "s":
+                        self._record_bucket(rows, len(jobs))
                         ctx.pending.append(
                             (key, jobs, fam,
-                             self._dispatch_serve_group(jobs, kb),
+                             self._dispatch_serve_group(jobs, kb, rows=rows),
                              dev_ids)
                         )
                         dispatched = True
+                        self._maybe_warm(key, jobs, kb, rows)
                     elif kind == "k":
+                        self._record_bucket(rows, len(jobs))
                         ctx.pending.append(
                             (key, jobs, fam,
-                             self._dispatch_knn_group(jobs), dev_ids)
+                             self._dispatch_knn_group(jobs, rows=rows),
+                             dev_ids)
                         )
                         dispatched = True
+                        self._maybe_warm(key, jobs, kb, rows)
                     else:
                         mex = jobs[0].executor
                         if kind == "Mm":
@@ -784,6 +863,9 @@ class QueryBatcher:
                             self.stats["launches"] += 1
                             self.stats["fused_jobs"] += len(jobs)
                         self._add_flops(pend["flops"], dev_ids)
+                        self._record_bucket(
+                            pend.get("rows", BPAD), len(jobs)
+                        )
                         ctx.pending.append((key, jobs, fam, pend, dev_ids))
                         dispatched = True
                 except BaseException as e:  # surface to waiters
@@ -875,6 +957,100 @@ class QueryBatcher:
         with self._lock:
             self._host_stall_s += seconds
 
+    # ---- continuous-batching accounting + bucket warmup ----
+
+    def _record_bucket(self, rows: int, njobs: int):
+        """One dispatched group: `rows` padded launch width, `njobs`
+        real query rows. avg_occupancy = Σjobs / Σslots measures the
+        padding waste the bucket ladder leaves behind."""
+        rows = int(rows)
+        with self._lock:
+            self._bucket_launches[rows] = (
+                self._bucket_launches.get(rows, 0) + 1
+            )
+            self._occ_jobs += njobs
+            self._occ_slots += rows
+
+    def batching_stats(self) -> dict:
+        """The continuous-batching block for `_nodes/stats`: per-bucket
+        launch histogram, occupancy sums (raw, so windows can diff),
+        and express-lane hits."""
+        with self._lock:
+            hist = {
+                str(b): n
+                for b, n in sorted(self._bucket_launches.items())
+            }
+            jobs, slots = self._occ_jobs, self._occ_slots
+            express = self.stats["express_lane_hits"]
+        return {
+            "buckets": list(self.buckets),
+            "launches_by_bucket": hist,
+            "occupancy_jobs": jobs,
+            "occupancy_slots": slots,
+            "avg_occupancy": round(jobs / slots, 4) if slots else 0.0,
+            "express_lane_hits": express,
+        }
+
+    def _maybe_warm(self, key, jobs: List[_Job], kb: int, rows: int):
+        """Eagerly compiles the remaining ladder buckets of this group's
+        kernel family the first time the family dispatches, by running
+        one dummy job (cloned from the live group's plan) through the
+        real group path at every other bucket. Steady-state bucket
+        selection then never compiles. Best-effort and stat-silent
+        (record=False): warm launches appear in no histogram, flop or
+        fault accounting. Gated by ES_TPU_BUCKET_WARMUP / the
+        `warmup_enabled` attribute (tier-1 pins it off)."""
+        if not self.warmup_enabled or len(self.buckets) <= 1:
+            return
+        kind = key[1]
+        warm_key: Tuple = key
+        if kind == "m":
+            # the match kernels specialize on the count plane too
+            warm_key = key + (any(j.plan.msm > 1 for j in jobs),)
+        elif kind == "k":
+            # the kNN candidate page is a compile bucket of its own
+            warm_key = key + (
+                scoring.next_bucket(
+                    max(j.plan.num_candidates for j in jobs), 16
+                ),
+            )
+        with self._lock:
+            if warm_key in self._warmed:
+                return
+            self._warmed.add(warm_key)
+        if kind == "m":
+            j0 = next((j for j in jobs if j.plan.msm > 1), jobs[0])
+        elif kind == "k":
+            j0 = max(jobs, key=lambda j: j.plan.num_candidates)
+        else:
+            j0 = jobs[0]
+        for b in self.buckets:
+            if b == rows:
+                continue
+            dummy = [
+                _Job(j0.executor, j0.plan, j0.k, kind=j0.kind,
+                     query=j0.query)
+            ]
+            try:
+                if kind == "m":
+                    self._run_group(dummy, key[2], kb, rows=b,
+                                    record=False)
+                elif kind == "s":
+                    pend = self._dispatch_serve_group(
+                        dummy, kb, rows=b, record=False
+                    )
+                    self._collect_serve_group(dummy, kb, pend,
+                                              record=False)
+                else:
+                    pend = self._dispatch_knn_group(
+                        dummy, rows=b, record=False
+                    )
+                    self._collect_knn_group(dummy, pend, record=False)
+            except BaseException:
+                # warmup is opportunistic: a failed bucket just compiles
+                # lazily on its first live hit instead
+                pass
+
     # ---- per-device busy windows (straggler visibility) ----
 
     def _dev_enter(self, dev_ids: Tuple[int, ...]):
@@ -951,10 +1127,15 @@ class QueryBatcher:
             ),
         }
 
-    def _run_group(self, jobs: List[_Job], field: str, kb: int):
+    def _run_group(self, jobs: List[_Job], field: str, kb: int,
+                   rows: Optional[int] = None, record: bool = True):
+        """`rows` is the group's padded launch width (a ladder bucket >=
+        len(jobs); default BPAD); `record=False` (bucket warmup) skips
+        all stats/flop accounting."""
         ex = jobs[0].executor
         reader = ex.reader
         nj = len(jobs)
+        rows = rows or BPAD
         staging = getattr(ex, "staging_slab", None)
         # shard-level pruning eligibility: a capped total may only be
         # shortcut to (cap, gte) when ≥ cap live matches are guaranteed
@@ -988,27 +1169,31 @@ class QueryBatcher:
                 ]
                 if all(p is not None for p in fplans):
                     pend = fs.search_async(
-                        fplans, kb, with_cnt, staging=staging
+                        fplans, kb, with_cnt, staging=staging, rows=rows
                     )
-                    with self._lock:
-                        self.stats["launches"] += 1
-                        self.stats["fused_jobs"] += nj
-                    self._add_flops(sum(
-                        scoring.text_plan_flops(len(p[0]), len(p[2]), n_docs)
-                        for p in fplans
-                    ))
+                    if record:
+                        with self._lock:
+                            self.stats["launches"] += 1
+                            self.stats["fused_jobs"] += nj
+                        self._add_flops(sum(
+                            scoring.text_plan_flops(
+                                len(p[0]), len(p[2]), n_docs
+                            )
+                            for p in fplans
+                        ))
                     dev_items.append((si, *fs.device_result(pend)))
                     continue
-                with self._lock:
-                    self.stats["fused_overflow_jobs"] += sum(
-                        1 for p in fplans if p is None
-                    )
+                if record:
+                    with self._lock:
+                        self.stats["fused_overflow_jobs"] += sum(
+                            1 for p in fplans if p is None
+                        )
             # ---- chunked path (small segments / slot overflow) ----
             bmx = ex.block_index(si, field)
             cs = ex.chunked_scorer(si, field)
             if bmx is None or cs is None:
                 continue
-            acc, cnt = cs.new_acc(with_cnt)
+            acc, cnt = cs.new_acc(with_cnt, rows=rows)
             a_tiles: List[np.ndarray] = []
             a_w: List[np.ndarray] = []
             deferred: List[list] = []
@@ -1040,17 +1225,19 @@ class QueryBatcher:
                 a_w.append(np.concatenate(wl) if wl else empty_w)
                 deferred.append(hots)
             acc, cnt = cs.score_into(acc, cnt, a_tiles, a_w, staging=staging)
-            with self._lock:
-                self.stats["launches"] += 1
-            self._add_flops(scoring.text_plan_flops(
-                sum(len(t) for t in a_tiles), 0, 0
-            ))
+            if record:
+                with self._lock:
+                    self.stats["launches"] += 1
+                self._add_flops(scoring.text_plan_flops(
+                    sum(len(t) for t in a_tiles), 0, 0
+                ))
             if any(deferred):
                 # ---- the threshold broadcast + survival test (the one
                 # host-dependent round: only runs when pruning engages) ----
                 t0 = time.perf_counter()
                 theta, accmax = cs.threshold(acc, kb)
-                self._add_stall(time.perf_counter() - t0)
+                if record:
+                    self._add_stall(time.perf_counter() - t0)
                 b_tiles: List[np.ndarray] = []
                 b_w: List[np.ndarray] = []
                 for ji, hots in enumerate(deferred):
@@ -1074,12 +1261,13 @@ class QueryBatcher:
                 acc, cnt = cs.score_into(
                     acc, cnt, b_tiles, b_w, staging=staging
                 )
-                with self._lock:
-                    self.stats["launches"] += 1
-                self._add_flops(scoring.text_plan_flops(
-                    sum(len(t) for t in b_tiles), 0, 0
-                ))
-            msm = np.ones(BPAD, np.int32)
+                if record:
+                    with self._lock:
+                        self.stats["launches"] += 1
+                    self._add_flops(scoring.text_plan_flops(
+                        sum(len(t) for t in b_tiles), 0, 0
+                    ))
+            msm = np.ones(rows, np.int32)
             msm[:nj] = [j.plan.msm for j in jobs]
             dev_items.append(
                 (si, *cs.finalize_device(acc, cnt, msm, kb))
@@ -1090,7 +1278,8 @@ class QueryBatcher:
         if dev_items:
             t0 = time.perf_counter()
             ms, mseg, mdoc, mtot = scoring.merge_segment_topk(dev_items, kb)
-            self._add_stall(time.perf_counter() - t0)
+            if record:
+                self._add_stall(time.perf_counter() - t0)
         else:
             ms = np.full((nj, 0), -np.inf, np.float32)
             mseg = mdoc = np.zeros((nj, 0), np.int32)
@@ -1113,8 +1302,9 @@ class QueryBatcher:
             total = int(mtot[ji].sum())
             relation = "eq"
             if pruned_flags[ji]:
-                with self._lock:
-                    self.stats["pruned_jobs"] += 1
+                if record:
+                    with self._lock:
+                        self.stats["pruned_jobs"] += 1
                 # pruned tiles mean the collected count is a lower bound —
                 # never report it as exact, even at tth_cap == 0 where the
                 # REST layer omits totals (internal consumers of TopDocs
@@ -1144,14 +1334,18 @@ class QueryBatcher:
         with self._lock:
             self._inflight[fam] -= 1
 
-    def _dispatch_serve_group(self, jobs: List[_Job], kb: int) -> List[Tuple]:
+    def _dispatch_serve_group(self, jobs: List[_Job], kb: int,
+                              rows: Optional[int] = None,
+                              record: bool = True) -> List[Tuple]:
         """Launches the multi-field fused kernels for ServePlan jobs
         (bool / multi_match) on every eligible segment WITHOUT host
         sync. Segments without a fused scorer (below FUSED_MIN_DOCS) or
         jobs overflowing slot budgets are marked for the per-job
-        fallback, which runs at collect time."""
+        fallback, which runs at collect time. `rows` pads the launch to
+        one ladder bucket; `record=False` (warmup) mutes stats."""
         ex = jobs[0].executor
         nj = len(jobs)
+        rows = rows or BPAD
         staging = getattr(ex, "staging_slab", None)
         plan0 = jobs[0].plan
         fields = plan0.fields
@@ -1181,20 +1375,24 @@ class QueryBatcher:
                     )
             if fs is not None and all(p is not None for p in fplans):
                 pend = fs.search_async(
-                    fplans, kb, plan0.combine, plan0.tie, staging=staging
+                    fplans, kb, plan0.combine, plan0.tie, staging=staging,
+                    rows=rows,
                 )
-                with self._lock:
-                    self.stats["launches"] += 1
-                    self.stats["fused_jobs"] += nj
-                n_docs = ex.reader.segments[si].num_docs
-                self._add_flops(sum(
-                    scoring.text_plan_flops(len(sec[0]), len(sec[2]), n_docs)
-                    for sections, _ in fplans
-                    for sec in sections
-                ))
+                if record:
+                    with self._lock:
+                        self.stats["launches"] += 1
+                        self.stats["fused_jobs"] += nj
+                    n_docs = ex.reader.segments[si].num_docs
+                    self._add_flops(sum(
+                        scoring.text_plan_flops(
+                            len(sec[0]), len(sec[2]), n_docs
+                        )
+                        for sections, _ in fplans
+                        for sec in sections
+                    ))
                 items.append(("fused", si, fs, pend))
             else:
-                if fs is not None and fplans is not None:
+                if record and fs is not None and fplans is not None:
                     with self._lock:
                         self.stats["fused_overflow_jobs"] += sum(
                             1 for p in fplans if p is None
@@ -1202,7 +1400,8 @@ class QueryBatcher:
                 items.append(("fallback", si, None, None))
         return items
 
-    def _collect_serve_group(self, jobs: List[_Job], kb: int, items):
+    def _collect_serve_group(self, jobs: List[_Job], kb: int, items,
+                             record: bool = True):
         """Host side of the serve group: one device-side merge + packed
         download covers every fused segment; fallback segments (below
         FUSED_MIN_DOCS / slot overflow) run per job on the host and join
@@ -1222,7 +1421,8 @@ class QueryBatcher:
             ms, mseg, mdoc, mtot = scoring.merge_segment_topk(
                 fused_items, kb
             )
-            self._add_stall(time.perf_counter() - t0)
+            if record:
+                self._add_stall(time.perf_counter() - t0)
             for ji in range(len(jobs)):
                 finite = np.isfinite(ms[ji])
                 for s, si, d in zip(
@@ -1235,20 +1435,25 @@ class QueryBatcher:
                 continue
             for ji, j in enumerate(jobs):
                 s1, d1, t1 = ex.segment_topk(j.query, si, kb)
-                with self._lock:
-                    self.stats["launches"] += 1
+                if record:
+                    with self._lock:
+                        self.stats["launches"] += 1
                 self._collect(
                     [j], [per_job_cands[ji]], totals[ji: ji + 1],
                     si, s1[None, :], d1[None, :], np.array([t1]),
                 )
         self._finish_jobs(jobs, per_job_cands, totals, reader)
 
-    def _dispatch_knn_group(self, jobs: List[_Job]) -> List[Tuple]:
+    def _dispatch_knn_group(self, jobs: List[_Job],
+                            rows: Optional[int] = None,
+                            record: bool = True) -> List[Tuple]:
         """Launches the batched brute-force kNN matmul per segment
-        (BASELINE config 4); results stay on device until collect."""
+        (BASELINE config 4); results stay on device until collect.
+        `rows` pads the query-row dimension to one ladder bucket."""
         ex = jobs[0].executor
         reader = ex.reader
         nj = len(jobs)
+        rows = rows or BPAD
         staging = getattr(ex, "staging_slab", None)
         field = jobs[0].plan.field
         items: List[Tuple] = []
@@ -1261,12 +1466,12 @@ class QueryBatcher:
             dims = int(vectors.shape[1])
             n = seg.num_docs
             if staging is not None:
-                q = staging("knn_q", (BPAD, dims), np.float32)
-                valid = staging("knn_valid", (BPAD,), np.bool_)
+                q = staging("knn_q", (rows, dims), np.float32)
+                valid = staging("knn_valid", (rows,), np.bool_)
                 valid[:] = False  # stale rows are masked, not re-scored
             else:
-                q = np.zeros((BPAD, dims), np.float32)
-                valid = np.zeros(BPAD, bool)
+                q = np.zeros((rows, dims), np.float32)
+                valid = np.zeros(rows, bool)
             for ji, j in enumerate(jobs):
                 q[ji] = np.asarray(j.plan.vector, np.float32)
                 valid[ji] = True
@@ -1287,14 +1492,16 @@ class QueryBatcher:
                 np.asarray(q), np.asarray(valid),
                 vectors, cand_mask, vf.similarity, kc,
             )
-            with self._lock:
-                self.stats["launches"] += 1
-                self.stats["fused_jobs"] += nj
-            self._add_flops(scoring.knn_flops(nj, n, dims))
+            if record:
+                with self._lock:
+                    self.stats["launches"] += 1
+                    self.stats["fused_jobs"] += nj
+                self._add_flops(scoring.knn_flops(nj, n, dims))
             items.append((si, n, s, d))
         return items
 
-    def _collect_knn_group(self, jobs: List[_Job], items):
+    def _collect_knn_group(self, jobs: List[_Job], items,
+                           record: bool = True):
         """Per-segment top num_candidates, then a global per-job k cut —
         the coordinator merge of DfsPhase.executeKnnVectorQuery. The
         per-segment candidate buffers never leave the device: one merge
@@ -1304,13 +1511,15 @@ class QueryBatcher:
         strictly-positive constant cannot change the order), so scores
         are float-identical to the host merge; a job carrying a zero or
         negative boost would reorder, so that group merges on host."""
-        faults.check("knn.collect", jobs=len(jobs))
+        if record:
+            faults.check("knn.collect", jobs=len(jobs))
         reader = jobs[0].executor.reader
         per_job_cands: List[List[Tuple[float, int, int]]] = [[] for _ in jobs]
         if items and all(j.plan.boost > 0.0 for j in jobs):
-            # BPAD rows to match the device buffers; padded query rows
-            # keep nc=0 (their scores are -inf anyway)
-            nc_rows = np.zeros((BPAD, len(items)), np.int32)
+            # the device buffers' row bucket; padded query rows keep
+            # nc=0 (their scores are -inf anyway)
+            rows = int(items[0][2].shape[0])
+            nc_rows = np.zeros((rows, len(items)), np.int32)
             for ii, (si, n, _, _) in enumerate(items):
                 for ji, j in enumerate(jobs):
                     nc_rows[ji, ii] = min(j.plan.num_candidates, n)
@@ -1319,7 +1528,8 @@ class QueryBatcher:
             ms, mseg, mdoc, counts = scoring.knn_merge_segment_topk(
                 [(si, s, d) for si, _, s, d in items], nc_rows, k_out
             )
-            self._add_stall(time.perf_counter() - t0)
+            if record:
+                self._add_stall(time.perf_counter() - t0)
             for ji, j in enumerate(jobs):
                 finite = np.isfinite(ms[ji])
                 cap = min(j.plan.k, j.k)

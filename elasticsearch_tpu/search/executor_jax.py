@@ -420,7 +420,11 @@ class JaxExecutor:
                 fs, si, field, plan.terms, plan.boost, plan.msm
             )
             if fplan is not None:
-                s, d, tot = fs.search([fplan], kk, plan.msm > 1, live=base)
+                # single-request path: a 1-row launch (the smallest
+                # ladder bucket), not the full padded width
+                s, d, tot = fs.search(
+                    [fplan], kk, plan.msm > 1, live=base, rows=1
+                )
                 return s[0], d[0], int(tot[0]), False
         bmx = self.block_index(si, field)
         cs = self.chunked_scorer(si, field)
@@ -431,7 +435,7 @@ class JaxExecutor:
         # capped-total shortcut is unsound here
         prune_ok = plan.wand_ok and plan.tth_cap == 0
         with_cnt = plan.msm > 1
-        acc, cnt = cs.new_acc(with_cnt)
+        acc, cnt = cs.new_acc(with_cnt, rows=1)
         plans = bmx.plan(list(plan.terms), plan.boost)
         empty_i = np.empty(0, np.int64)
         empty_w = np.empty(0, np.float32)
@@ -489,8 +493,7 @@ class JaxExecutor:
                 [np.concatenate(tl2) if tl2 else empty_i],
                 [np.concatenate(wl2) if wl2 else empty_w],
             )
-        msm_arr = np.ones(scoring.BPAD, np.int32)
-        msm_arr[0] = plan.msm
+        msm_arr = np.asarray([plan.msm], np.int32)
         s, d, tot = cs.finalize(acc, cnt, msm_arr, kk, live=base)
         return s[0], d[0], int(tot[0]), pruned
 
@@ -513,7 +516,8 @@ class JaxExecutor:
                 return None
             sections.append(sec)
         s, d, tot = fs.search(
-            [(sections, plan.msm)], kk, plan.combine, plan.tie, live=base
+            [(sections, plan.msm)], kk, plan.combine, plan.tie, live=base,
+            rows=1,
         )
         return s[0], d[0], int(tot[0]), False
 

@@ -1,0 +1,134 @@
+#!/usr/bin/env bash
+# Continuous-batching latency smoke: pre-push sanity for the pad-bucket
+# launch ladder (search/batcher.py + ops/scoring.py).
+#
+# Builds one miniature Zipf corpus (large enough that the fused serving
+# path engages, i.e. >= FUSED_MIN_DOCS per segment) and serves it twice:
+#   * FIXED baseline — ES_TPU_BATCH_BUCKETS=32 pins every launch to the
+#     pre-ladder full-width shape;
+#   * LADDER — the default bucket ladder (1/4/8/16/32) + express lane.
+# Both are driven with the SAME open-loop Poisson arrival rate at
+# moderate load (admission off: pure latency, nothing sheds) and the
+# same closed-loop saturation load, and the smoke asserts:
+#   * open-loop accepted p50 (ladder) <= p50 (fixed) / LAT_P50_FACTOR
+#     (default 4 — the miniature form of the 194ms -> interactive gate);
+#   * closed-loop peak QPS regression <= 5% (bucketing must not cost
+#     throughput when batches do fill);
+#   * zero batcher worker-thread leaks (the tests/conftest.py
+#     invariant, applied inline).
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+export JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}"
+# miniature corpus knobs (bench.py reads these at import)
+export BENCH_N_DOCS="${LAT_DOCS:-120000}"
+export BENCH_VOCAB="${LAT_VOCAB:-8000}"
+export BENCH_DIMS="${LAT_DIMS:-8}"
+export BENCH_THREADS="${LAT_THREADS:-48}"
+export BENCH_N_QUERIES="${LAT_QUERIES:-512}"
+
+python - <<'PY'
+import os
+import time
+
+import numpy as np
+
+import bench
+from bench import build_corpus, make_query_texts, make_service, run_load, \
+    run_open_loop
+from elasticsearch_tpu.search.admission import admission
+
+P50_FACTOR = float(os.environ.get("LAT_P50_FACTOR", 4.0))
+QPS_TOL = float(os.environ.get("LAT_QPS_TOL", 0.95))
+DUR_S = float(os.environ.get("LAT_OPEN_SECONDS", 12.0))
+MOD_FACTOR = float(os.environ.get("LAT_MODERATE_FACTOR", 0.3))
+K = 10
+
+admission.configure(enabled=False)
+
+t0 = time.perf_counter()
+seg_jax, _seg_np, body_df, _title_df = build_corpus()
+print(f"corpus built in {time.perf_counter()-t0:.1f}s "
+      f"({bench.N_DOCS} docs)")
+
+texts = make_query_texts(body_df, bench.N_QUERIES)
+bodies = [{"query": {"match": {"body": t}}, "size": K} for t in texts]
+
+
+def measure(label, buckets_env):
+    """(closed_qps, open_p50_fn) for one launch-shape configuration."""
+    if buckets_env is None:
+        os.environ.pop("ES_TPU_BATCH_BUCKETS", None)
+    else:
+        os.environ["ES_TPU_BATCH_BUCKETS"] = buckets_env
+    svc = make_service(seg_jax, "jax")
+    svc.name = f"lat-{label}"
+    # warm/compile: sequential (express lane + bucket warmup on the
+    # ladder variant), then a concurrent pass for the big buckets
+    for b in bodies[:6]:
+        svc.search(b)
+    run_load(svc, bodies[:128])
+    qps, p50, _, _ = run_load(svc, bodies)
+    print(f"[{label}] closed-loop: {qps:.1f} QPS p50={p50:.2f}ms "
+          f"(buckets={svc._batcher.buckets})")
+    return svc, qps
+
+
+svc_fixed, qps_fixed = measure("fixed", "32")
+svc_ladder, qps_ladder = measure("ladder", None)
+
+# same moderate Poisson arrival rate against both variants: the p50
+# delta is then purely the launch-shape effect
+rate = max(MOD_FACTOR * min(qps_fixed, qps_ladder), 2.0)
+slo = 60_000.0  # effectively no SLO: we gate on the measured p50
+
+
+def open_p50(label, svc):
+    blk = run_open_loop(svc, bodies, rate_qps=rate, duration_s=DUR_S,
+                        slo_ms=slo, max_workers=128)
+    assert blk["errors"] == 0, f"[{label}] errors: {blk['errors']}"
+    assert blk["completed"] >= 10, f"[{label}] too few completions: {blk}"
+    bs = svc._batcher.batching_stats()
+    print(f"[{label}] open-loop @ {rate:.0f}/s: "
+          f"accepted_p50={blk['accepted_p50_ms']}ms "
+          f"p99={blk['accepted_p99_ms']}ms "
+          f"launches_by_bucket={bs['launches_by_bucket']} "
+          f"avg_occupancy={bs['avg_occupancy']} "
+          f"express={bs['express_lane_hits']}")
+    return float(blk["accepted_p50_ms"])
+
+
+p50_fixed = open_p50("fixed", svc_fixed)
+p50_ladder = open_p50("ladder", svc_ladder)
+
+assert p50_ladder <= p50_fixed / P50_FACTOR, (
+    f"open-loop accepted p50 {p50_ladder:.2f}ms not <= 1/{P50_FACTOR:.0f} "
+    f"of the fixed-shape baseline {p50_fixed:.2f}ms — the bucket ladder "
+    "is not buying interactive latency"
+)
+assert qps_ladder >= QPS_TOL * qps_fixed, (
+    f"closed-loop QPS regressed: ladder {qps_ladder:.1f} < "
+    f"{QPS_TOL:.0%} of fixed {qps_fixed:.1f}"
+)
+print(f"p50 improvement: {p50_fixed / max(p50_ladder, 1e-9):.1f}x "
+      f"(gate {P50_FACTOR:.0f}x); QPS ratio "
+      f"{qps_ladder / max(qps_fixed, 1e-9):.3f} (gate {QPS_TOL})")
+
+svc_fixed.close()
+svc_ladder.close()
+
+# batcher-thread leak check (the tests/conftest.py fixture, inline)
+from elasticsearch_tpu.search.batcher import live_batchers
+
+leaked = []
+for b in list(live_batchers):
+    if not getattr(b, "_closed", False):
+        continue
+    for t in list(b._threads):
+        t.join(timeout=10.0)
+        if t.is_alive():
+            leaked.append(t.name)
+assert not leaked, f"closed QueryBatcher left live worker threads: {leaked}"
+print("no leaked batcher threads")
+print("LATENCY SMOKE OK")
+PY

@@ -5,7 +5,10 @@
 # T1_MESH=1 additionally re-runs the mesh-marked tests alone under the
 # forced 8-device CPU host platform (they also run inside the main
 # suite; the re-run isolates the mesh-parallel serving path for quick
-# iteration). The combined exit code fails if either run fails.
+# iteration). T1_LATENCY=1 additionally runs the continuous-batching
+# latency smoke (scripts/latency_smoke.sh: open-loop accepted-p50 and
+# closed-loop QPS gates for the pad-bucket launch ladder). The combined
+# exit code fails if any enabled run fails.
 set -o pipefail; rm -f /tmp/_t1.log; timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' --continue-on-collection-errors -p no:cacheprovider -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log; rc=${PIPESTATUS[0]}; echo DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' /tmp/_t1.log | tr -cd . | wc -c)
 if [ "${T1_MESH:-0}" = "1" ]; then
     echo "--- T1_MESH: mesh-marked tests on the forced 8-device host platform ---"
@@ -15,5 +18,11 @@ if [ "${T1_MESH:-0}" = "1" ]; then
         -p no:xdist -p no:randomly
     mesh_rc=$?
     [ "$rc" -eq 0 ] && rc=$mesh_rc
+fi
+if [ "${T1_LATENCY:-0}" = "1" ]; then
+    echo "--- T1_LATENCY: continuous-batching latency smoke (bucket ladder) ---"
+    bash scripts/latency_smoke.sh
+    lat_rc=$?
+    [ "$rc" -eq 0 ] && rc=$lat_rc
 fi
 exit $rc

@@ -19,6 +19,13 @@ os.environ["JAX_PLATFORMS"] = "cpu"
 # controller explicitly via admission.configure(enabled=True) and the
 # _reset_admission fixture below restores process-start state.
 os.environ["ES_TPU_ADMISSION"] = "off"
+
+# Eager bucket warmup is OFF in tier-1: warming every ladder bucket of
+# every kernel family on first dispatch would multiply suite compile
+# time for no coverage gain (buckets still engage lazily and are parity-
+# tested); tests/test_continuous_batching.py re-arms it per batcher via
+# the `warmup_enabled` attribute to prove the no-recompile contract.
+os.environ["ES_TPU_BUCKET_WARMUP"] = "0"
 xla_flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in xla_flags:
     os.environ["XLA_FLAGS"] = (

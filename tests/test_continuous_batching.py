@@ -1,0 +1,666 @@
+"""Continuous batching over the pad-bucket launch ladder (round 7).
+
+Contracts under test:
+  * every ladder bucket is FLOAT-EXACT vs the fixed-BPAD launch shape
+    (match / bool / multi_match / knn; chunked AND fused engines) and
+    vs the NumPy oracle — bucketing is padding only, never semantics;
+  * lone queries ride the express lane (depth-1, bucket-1) with
+    identical results, and the hit is counted;
+  * after a family's eager bucket warmup, randomized bucket load
+    compiles NOTHING new (jit cache-size probe);
+  * scheduling invariants survive the ladder: the 429 queue bound,
+    close/drain during randomized bucket load, and deadline shedding
+    at dequeue;
+  * the wait-timeout bugfix: a timed-out waiter CANCELS its job (it
+    never launches into a dead waiter) — batcher-level and through the
+    shard timeout path;
+  * the per-bucket launch histogram surfaces in `_nodes/stats`.
+"""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from elasticsearch_tpu.cluster.indices import IndexService
+from elasticsearch_tpu.common.settings import (
+    BATCH_BUCKETS_ENV,
+    batch_buckets,
+    bucket_for,
+)
+from elasticsearch_tpu.ops import scoring
+from elasticsearch_tpu.search import dsl
+from elasticsearch_tpu.search.batcher import (
+    EsRejectedExecutionError,
+    QueryBatcher,
+    extract_knn_plan,
+    extract_match_plan,
+    extract_serve_plan,
+)
+
+WORDS = [
+    "alpha", "beta", "gamma", "delta", "epsilon", "zeta", "eta", "theta",
+    "iota", "kappa", "lam", "mu", "nu", "xi", "omicron", "pi",
+]
+DIMS = 8
+
+
+def _zipf(n):
+    w = 1.0 / np.arange(1, n + 1)
+    return w / w.sum()
+
+
+def make_service(n_docs=240, seed=0, waves=3, backend="jax", name="cb"):
+    rng = np.random.default_rng(seed)
+    svc = IndexService(
+        name,
+        settings={"number_of_shards": 1, "search.backend": backend},
+        mappings_json={
+            "properties": {
+                "title": {"type": "text"},
+                "body": {"type": "text"},
+                "vec": {"type": "dense_vector", "dims": DIMS,
+                        "similarity": "cosine"},
+            }
+        },
+    )
+    per_wave = max(1, n_docs // waves)
+    for i in range(n_docs):
+        kt = int(rng.integers(1, 4))
+        kb = int(rng.integers(3, 12))
+        svc.index_doc(
+            str(i),
+            {
+                "title": " ".join(rng.choice(WORDS, kt, p=_zipf(len(WORDS)))),
+                "body": " ".join(rng.choice(WORDS, kb, p=_zipf(len(WORDS)))),
+                "vec": [float(x) for x in rng.normal(size=DIMS)],
+            },
+        )
+        if (i + 1) % per_wave == 0:
+            svc.refresh()
+    svc.refresh()
+    return svc
+
+
+@pytest.fixture(scope="module")
+def service():
+    svc = make_service()
+    yield svc
+    svc.close()
+
+
+@pytest.fixture(scope="module")
+def oracle():
+    svc = make_service(backend="numpy", name="cb-oracle")
+    yield svc
+    svc.close()
+
+
+def workerless(monkeypatch, **kw):
+    b = QueryBatcher(**kw)
+    monkeypatch.setattr(b, "_ensure_thread", lambda: None)
+    return b
+
+
+def td_fingerprint(td):
+    """Exact (unrounded) identity of a TopDocs."""
+    return (
+        [(h.doc_id, h.segment, h.local_doc, h.score) for h in td.hits],
+        td.total,
+        td.relation,
+        td.max_score,
+    )
+
+
+# ---------------------------------------------------------------------
+# ladder selection
+# ---------------------------------------------------------------------
+
+
+class TestLadder:
+    def test_default_ladder(self):
+        assert batch_buckets(32) == (1, 4, 8, 16, 32)
+        assert batch_buckets(8) == (1, 4, 8)
+
+    def test_env_override_and_validation(self, monkeypatch):
+        monkeypatch.setenv(BATCH_BUCKETS_ENV, "2, 8 16")
+        assert batch_buckets(32) == (2, 8, 16)
+        monkeypatch.setenv(BATCH_BUCKETS_ENV, "0,64,7")
+        assert batch_buckets(32) == (7,)  # out-of-range values dropped
+        monkeypatch.setenv(BATCH_BUCKETS_ENV, "garbage")
+        assert batch_buckets(32) == (1, 4, 8, 16, 32)  # fallback
+        monkeypatch.setenv(BATCH_BUCKETS_ENV, "32")
+        assert batch_buckets(32) == (32,)  # the fixed-shape baseline
+
+    def test_bucket_for_smallest_cover(self):
+        ladder = (1, 4, 8, 16, 32)
+        assert bucket_for(1, ladder) == 1
+        assert bucket_for(2, ladder) == 4
+        assert bucket_for(4, ladder) == 4
+        assert bucket_for(9, ladder) == 16
+        assert bucket_for(32, ladder) == 32
+
+    def test_bucket_for_data_axis_multiple(self):
+        ladder = (1, 4, 8, 16, 32)
+        # the mesh data axis shards the query batch: bucket must divide
+        assert bucket_for(1, ladder, multiple_of=2) == 4
+        assert bucket_for(5, ladder, multiple_of=4) == 8
+        # no qualifying ladder entry → round up to the multiple
+        assert bucket_for(3, (1, 3), multiple_of=2) == 4
+
+
+# ---------------------------------------------------------------------
+# float-exact parity: every bucket vs the fixed-BPAD shape + the oracle
+# ---------------------------------------------------------------------
+
+
+def match_plans(svc, n, tth=10_000):
+    out = []
+    for i in range(n):
+        w1 = WORDS[i % len(WORDS)]
+        w2 = WORDS[(i * 3 + 1) % len(WORDS)]
+        q = dsl.parse_query({"match": {"body": f"{w1} {w2}"}})
+        p = extract_match_plan(q, svc.mappings, svc.analysis, tth)
+        assert p is not None
+        out.append((p, q))
+    return out
+
+
+def serve_plans(svc, n):
+    out = []
+    for i in range(n):
+        w1 = WORDS[i % len(WORDS)]
+        w2 = WORDS[(i * 5 + 2) % len(WORDS)]
+        body = {"bool": {"must": [{"term": {"body": w1}}],
+                         "should": [{"match": {"title": w2}}]}}
+        q = dsl.parse_query(body)
+        p = extract_serve_plan(q, svc.mappings, svc.analysis)
+        assert p is not None
+        out.append((p, q))
+    return out
+
+
+def knn_plans(svc, n, seed=3, nc=50):
+    rng = np.random.default_rng(seed)
+    out = []
+    for _ in range(n):
+        sec = dsl.parse_knn({
+            "field": "vec",
+            "query_vector": [float(x) for x in rng.normal(size=DIMS)],
+            "k": 8,
+            "num_candidates": nc,
+        })
+        p = extract_knn_plan([sec], svc.mappings)
+        assert p is not None
+        out.append((p, None))
+    return out
+
+
+def run_bucket(b, ex, plans, kind, kb, rows):
+    """Dispatch ONE group of len(plans) jobs at a padded launch width of
+    `rows` through the real group path; returns the TopDocs list."""
+    jobs = [
+        b.submit_nowait(ex, p, 10 if kind != "knn" else 8, kind=kind,
+                        query=q)
+        for p, q in plans
+    ]
+    if kind == "match":
+        b._run_group(jobs, plans[0][0].field, kb, rows=rows)
+    elif kind == "serve":
+        pend = b._dispatch_serve_group(jobs, kb, rows=rows)
+        b._collect_serve_group(jobs, kb, pend)
+    else:
+        pend = b._dispatch_knn_group(jobs, rows=rows)
+        b._collect_knn_group(jobs, pend)
+    return [QueryBatcher.wait(j, timeout=30) for j in jobs]
+
+
+class TestBucketParity:
+    @pytest.mark.parametrize("kind", ["match", "serve", "knn"])
+    def test_every_bucket_matches_fixed_shape(
+        self, service, monkeypatch, kind
+    ):
+        ex = service._executor(service.shards[0])
+        tiny = workerless(monkeypatch, workers=1)
+        maker = {"match": match_plans, "serve": serve_plans,
+                 "knn": knn_plans}[kind]
+        kb = 16
+        for rows in batch_buckets(scoring.BPAD):
+            plans = maker(service, rows)  # full occupancy at this bucket
+            got = run_bucket(tiny, ex, plans, kind, kb, rows)
+            ref = run_bucket(tiny, ex, plans, kind, kb, scoring.BPAD)
+            for g, r in zip(got, ref):
+                assert td_fingerprint(g) == td_fingerprint(r), (kind, rows)
+            # partial occupancy: fewer jobs than the bucket width
+            if rows > 1:
+                part = plans[: rows // 2 + 1]
+                got_p = run_bucket(tiny, ex, part, kind, kb, rows)
+                ref_p = run_bucket(tiny, ex, part, kind, kb, scoring.BPAD)
+                for g, r in zip(got_p, ref_p):
+                    assert td_fingerprint(g) == td_fingerprint(r)
+        tiny.close()
+
+    def test_fused_engine_bucket_parity(self, monkeypatch):
+        """Force the fused single-round-trip scorer (normally gated to
+        large segments) so the bucketed plan upload path is exercised
+        too — not just the chunked engine."""
+        from elasticsearch_tpu.search import executor_jax
+
+        monkeypatch.setattr(executor_jax, "FUSED_MIN_DOCS", 10)
+        svc = make_service(n_docs=300, seed=7, name="cb-fused")
+        try:
+            ex = svc._executor(svc.shards[0])
+            assert ex.fused_scorer(0, "body") is not None
+            tiny = workerless(monkeypatch, workers=1)
+            for rows in (1, 4, 32):
+                plans = match_plans(svc, rows)
+                got = run_bucket(tiny, ex, plans, "match", 16, rows)
+                ref = run_bucket(tiny, ex, plans, "match", 16, scoring.BPAD)
+                for g, r in zip(got, ref):
+                    assert td_fingerprint(g) == td_fingerprint(r), rows
+            tiny.close()
+        finally:
+            svc.close()
+
+    def test_end_to_end_parity_with_oracle(self, service, oracle):
+        """The bucketed serving path (express lane + whatever batches
+        form under concurrency) stays hit-for-hit with the NumPy
+        oracle for every plan family."""
+        rng = np.random.default_rng(17)
+        bodies = []
+        for i in range(24):
+            w = WORDS[int(rng.integers(0, 8))]
+            w2 = WORDS[int(rng.integers(0, len(WORDS)))]
+            kind = i % 4
+            if kind == 0:
+                bodies.append(
+                    {"query": {"match": {"body": f"{w} {w2}"}}, "size": 7}
+                )
+            elif kind == 1:
+                bodies.append({
+                    "query": {"bool": {
+                        "must": [{"term": {"body": w}}],
+                        "should": [{"match": {"title": w2}}],
+                    }},
+                    "size": 7,
+                })
+            elif kind == 2:
+                bodies.append({
+                    "query": {"multi_match": {
+                        "query": f"{w} {w2}",
+                        "fields": ["title", "body"],
+                        "tie_breaker": 0.3,
+                    }},
+                    "size": 7,
+                })
+            else:
+                v = [float(x) for x in rng.normal(size=DIMS)]
+                bodies.append({
+                    "knn": {"field": "vec", "query_vector": v, "k": 5,
+                            "num_candidates": 50},
+                    "size": 5,
+                })
+        results = [None] * len(bodies)
+        errs = []
+        cursor = [0]
+        lock = threading.Lock()
+
+        def worker():
+            while True:
+                with lock:
+                    i = cursor[0]
+                    if i >= len(bodies):
+                        return
+                    cursor[0] += 1
+                try:
+                    results[i] = service.search(bodies[i])
+                except Exception as e:  # pragma: no cover
+                    errs.append(e)
+                    return
+
+        ts = [threading.Thread(target=worker) for _ in range(8)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
+        assert not errs, errs
+        for body, got in zip(bodies, results):
+            want = oracle.search(body)
+            assert [
+                (h["_id"], round(h["_score"], 4))
+                for h in got["hits"]["hits"]
+            ] == [
+                (h["_id"], round(h["_score"], 4))
+                for h in want["hits"]["hits"]
+            ], body
+
+
+# ---------------------------------------------------------------------
+# express lane
+# ---------------------------------------------------------------------
+
+
+class TestExpressLane:
+    def test_lone_query_rides_express_lane(self, service, oracle):
+        b = service._batcher
+        before = b.stats["express_lane_hits"]
+        hist0 = dict(b.batching_stats()["launches_by_bucket"])
+        body = {"query": {"match": {"body": "alpha gamma"}}, "size": 7}
+        got = service.search(body)
+        assert b.stats["express_lane_hits"] > before
+        hist1 = b.batching_stats()["launches_by_bucket"]
+        assert hist1.get("1", 0) > hist0.get("1", 0)  # bucket-1 launch
+        want = oracle.search(body)
+        assert [
+            (h["_id"], round(h["_score"], 4)) for h in got["hits"]["hits"]
+        ] == [
+            (h["_id"], round(h["_score"], 4)) for h in want["hits"]["hits"]
+        ]
+        assert got["hits"]["total"] == want["hits"]["total"]
+
+
+# ---------------------------------------------------------------------
+# no recompile after warmup (the jit cache-size probe)
+# ---------------------------------------------------------------------
+
+
+def _cache_sizes():
+    fns = {
+        "_chunk_add": scoring._chunk_add,
+        "_chunk_add_cnt": scoring._chunk_add_cnt,
+        "_finalize": scoring._finalize,
+        "_fused_query": scoring._fused_query,
+        "_fused_query_mf": scoring._fused_query_mf,
+        "_merge_segments": scoring._merge_segments,
+        "_knn_merge_segments": scoring._knn_merge_segments,
+        "knn_topk_batch": scoring.knn_topk_batch,
+        "topk_hits": scoring.topk_hits,
+    }
+    return {name: fn._cache_size() for name, fn in fns.items()}
+
+
+class TestNoRecompileAfterWarmup:
+    def test_randomized_bucket_load_compiles_nothing_new(self):
+        """One query per family (with eager warmup armed) must leave the
+        jit caches complete: randomized concurrent load across every
+        bucket afterwards compiles ZERO new programs."""
+        svc = make_service(n_docs=200, seed=11, name="cb-warm")
+        try:
+            svc._batcher.warmup_enabled = True
+            # one query per family signature → _maybe_warm compiles the
+            # whole ladder for each (same k bucket, fixed nc)
+            warm_bodies = [
+                {"query": {"match": {"body": "alpha beta"}}, "size": 7},
+                {"query": {"bool": {
+                    "must": [{"term": {"body": "alpha"}}],
+                    "should": [{"match": {"title": "beta"}}]}}, "size": 7},
+                {"query": {"multi_match": {
+                    "query": "gamma delta", "fields": ["title", "body"],
+                    "tie_breaker": 0.3}}, "size": 7},
+                {"knn": {"field": "vec",
+                         "query_vector": [0.1] * DIMS, "k": 5,
+                         "num_candidates": 50}, "size": 5},
+            ]
+            for body in warm_bodies:
+                svc.search(body)
+            sizes0 = _cache_sizes()
+
+            rng = np.random.default_rng(23)
+            bodies = []
+            for i in range(64):
+                w = WORDS[int(rng.integers(0, 8))]
+                w2 = WORDS[int(rng.integers(0, len(WORDS)))]
+                kind = i % 4
+                if kind == 0:
+                    bodies.append({"query": {"match": {
+                        "body": f"{w} {w2}"}}, "size": 7})
+                elif kind == 1:
+                    bodies.append({"query": {"bool": {
+                        "must": [{"term": {"body": w}}],
+                        "should": [{"match": {"title": w2}}]}},
+                        "size": 7})
+                elif kind == 2:
+                    bodies.append({"query": {"multi_match": {
+                        "query": f"{w} {w2}",
+                        "fields": ["title", "body"],
+                        "tie_breaker": 0.3}}, "size": 7})
+                else:
+                    v = [float(x) for x in rng.normal(size=DIMS)]
+                    bodies.append({"knn": {
+                        "field": "vec", "query_vector": v, "k": 5,
+                        "num_candidates": 50}, "size": 5})
+            errs = []
+            cursor = [0]
+            lock = threading.Lock()
+
+            def worker():
+                while True:
+                    with lock:
+                        i = cursor[0]
+                        if i >= len(bodies):
+                            return
+                        cursor[0] += 1
+                    try:
+                        svc.search(bodies[i])
+                    except Exception as e:  # pragma: no cover
+                        errs.append(e)
+                        return
+
+            # vary concurrency so many bucket sizes actually occur
+            for threads in (1, 5, 12):
+                cursor[0] = 0
+                ts = [threading.Thread(target=worker)
+                      for _ in range(threads)]
+                for t in ts:
+                    t.start()
+                for t in ts:
+                    t.join()
+            assert not errs, errs
+            sizes1 = _cache_sizes()
+            assert sizes1 == sizes0, (
+                "bucketed load recompiled after warmup: "
+                f"{ {k: (sizes0[k], sizes1[k]) for k in sizes0 if sizes0[k] != sizes1[k]} }"
+            )
+        finally:
+            svc.close()
+
+
+# ---------------------------------------------------------------------
+# scheduling invariants under the ladder
+# ---------------------------------------------------------------------
+
+
+class TestSchedulingInvariants:
+    def test_429_bound_unchanged(self, service, monkeypatch):
+        ex = service._executor(service.shards[0])
+        plan = extract_match_plan(
+            dsl.parse_query({"match": {"body": "alpha"}}),
+            service.mappings, service.analysis, False,
+        )
+        tiny = workerless(monkeypatch, workers=1, queue_capacity=4)
+        rejected = 0
+        for _ in range(10):
+            try:
+                tiny.submit_nowait(ex, plan, 5)
+            except EsRejectedExecutionError:
+                rejected += 1
+        assert rejected == 6
+        assert tiny.stats["rejected"] == 6
+        tiny.close()  # queued waiters must fail, not hang
+
+    def test_flood_and_close_under_randomized_buckets(self, service):
+        """A flood of mixed-family jobs (bucket sizes land wherever the
+        race puts them) all complete; close() mid-traffic fails the
+        rest instead of hanging, and the workers exit."""
+        ex = service._executor(service.shards[0])
+        mp = [p for p, _ in match_plans(service, 8)]
+        kp = [p for p, _ in knn_plans(service, 4, seed=5)]
+        tiny = QueryBatcher(workers=3, queue_capacity=64)
+        jobs = []
+        for i in range(48):
+            try:
+                if i % 3 == 2:
+                    jobs.append(tiny.submit_nowait(
+                        ex, kp[i % len(kp)], 8, kind="knn"))
+                else:
+                    jobs.append(tiny.submit_nowait(
+                        ex, mp[i % len(mp)], 10))
+            except EsRejectedExecutionError:
+                pass
+        done = 0
+        for j in jobs:
+            td = QueryBatcher.wait(j, timeout=30)
+            assert td is not None
+            done += 1
+        assert done == len(jobs)
+        # close with fresh jobs racing in: nobody may hang
+        tail = []
+        for i in range(8):
+            try:
+                tail.append(tiny.submit_nowait(ex, mp[i % len(mp)], 10))
+            except EsRejectedExecutionError:
+                pass
+        tiny.close()
+        for j in tail:
+            assert j.event.wait(20)
+        for t in tiny._threads:
+            t.join(timeout=10)
+            assert not t.is_alive()
+
+    def test_deadline_shed_at_dequeue_preserved(self, service, monkeypatch):
+        """_admit_job still drops dead jobs before any bucket is chosen:
+        a mixed queue of dead and live jobs sheds exactly the dead ones
+        and the live ones complete normally."""
+        from elasticsearch_tpu.search.failures import SearchTimeoutError
+
+        ex = service._executor(service.shards[0])
+        mp = [p for p, _ in match_plans(service, 4)]
+        b = QueryBatcher()
+        b.workers = 0  # keep everything queued
+        dead = [
+            b.submit_nowait(ex, mp[i], 10,
+                            deadline=time.monotonic() - 0.01)
+            for i in range(3)
+        ]
+        live = [b.submit_nowait(ex, mp[i], 10) for i in range(4)]
+        b.workers = 2
+        b._ensure_thread()
+        for j in dead:
+            with pytest.raises(SearchTimeoutError):
+                QueryBatcher.wait(j, timeout=10)
+        for j in live:
+            assert QueryBatcher.wait(j, timeout=30) is not None
+        assert b.stats["shed_dead_jobs"] == 3
+        b.close()
+
+
+# ---------------------------------------------------------------------
+# wait-timeout cancels the job (the satellite bugfix)
+# ---------------------------------------------------------------------
+
+
+class TestWaitTimeoutCancelsJob:
+    def test_wait_or_cancel_drops_queued_job(self, service):
+        """Regression: wait(job, timeout) used to abandon a timed-out
+        job in the queue, where it could later dispatch into the dead
+        waiter. wait_or_cancel cancels it — it never launches."""
+        ex = service._executor(service.shards[0])
+        plan = extract_match_plan(
+            dsl.parse_query({"match": {"body": "alpha"}}),
+            service.mappings, service.analysis, False,
+        )
+        b = QueryBatcher()
+        b.workers = 0  # no dispatcher: the job stays queued
+        job = b.submit_nowait(ex, plan, 5)
+        with pytest.raises(TimeoutError):
+            b.wait_or_cancel(job, timeout=0.05)
+        assert job.event.is_set()
+        assert job.error is not None
+        assert b.stats["cancelled_jobs"] == 1
+        # a worker starting later must drop the job at dequeue
+        b.workers = 1
+        b._ensure_thread()
+        deadline = time.monotonic() + 5.0
+        while b._queue.qsize() and time.monotonic() < deadline:
+            time.sleep(0.01)
+        assert b.stats["jobs"] == 0, "timed-out job entered a batch"
+        assert b.stats["launches"] == 0
+        b.close()
+
+    def test_shard_timeout_cancels_queued_job_end_to_end(self):
+        """Through the real shard path: a request whose timeout budget
+        expires while its batched job is still queued returns a
+        timed-out partial AND cancels the job — a worker arriving later
+        never dispatches it."""
+        svc = make_service(n_docs=40, seed=3, name="cb-timeout")
+        try:
+            b = svc._batcher
+            b.workers = 0  # nothing drains: the job must sit queued
+            resp = svc.search({
+                "query": {"match": {"body": "alpha"}},
+                "timeout": "120ms",
+            })
+            assert resp["timed_out"] is True
+            # the coordinator may return its timed-out partial before
+            # the abandoned shard thread finishes cancelling: poll
+            deadline = time.monotonic() + 5.0
+            while (
+                b.stats["cancelled_jobs"] == 0
+                and time.monotonic() < deadline
+            ):
+                time.sleep(0.01)
+            assert b.stats["cancelled_jobs"] == 1
+            b.workers = 1
+            b._ensure_thread()
+            deadline = time.monotonic() + 5.0
+            while b._queue.qsize() and time.monotonic() < deadline:
+                time.sleep(0.01)
+            assert b.stats["jobs"] == 0, "dead job entered a batch"
+            assert b.stats["launches"] == 0
+        finally:
+            svc.close()
+
+
+# ---------------------------------------------------------------------
+# observability
+# ---------------------------------------------------------------------
+
+
+class TestBatchingStats:
+    def test_batching_stats_shape(self, service):
+        service.search({"query": {"match": {"body": "alpha"}}, "size": 5})
+        bs = service._batcher.batching_stats()
+        assert set(bs) == {
+            "buckets", "launches_by_bucket", "occupancy_jobs",
+            "occupancy_slots", "avg_occupancy", "express_lane_hits",
+        }
+        assert bs["buckets"] == list(batch_buckets(scoring.BPAD))
+        assert sum(bs["launches_by_bucket"].values()) > 0
+        assert 0.0 < bs["avg_occupancy"] <= 1.0
+        assert bs["occupancy_slots"] >= bs["occupancy_jobs"] > 0
+
+    def test_nodes_stats_batching_block(self):
+        from elasticsearch_tpu.cluster.service import ClusterService
+        from elasticsearch_tpu.rest.actions import RestActions
+
+        c = ClusterService()
+        try:
+            c.create_index("cbs", {
+                "settings": {"search.backend": "jax"},
+                "mappings": {"properties": {"body": {"type": "text"}}},
+            })
+            idx = c.indices["cbs"]
+            for i in range(20):
+                idx.index_doc(str(i), {"body": f"alpha beta {i}"})
+            idx.refresh()
+            idx.search({"query": {"match": {"body": "alpha"}}})
+            actions = RestActions(c)
+            _, resp = actions.nodes_stats(None, {}, {})
+            blk = resp["nodes"]["node-0"]["pipeline"]["batching"]
+            assert blk["buckets"] == list(batch_buckets(scoring.BPAD))
+            assert sum(blk["launches_by_bucket"].values()) > 0
+            assert blk["express_lane_hits"] >= 1
+            assert 0.0 < blk["avg_occupancy"] <= 1.0
+        finally:
+            c.close()

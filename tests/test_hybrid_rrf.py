@@ -203,8 +203,8 @@ class TestHybridServing:
         batcher = service._batcher
         orig = QueryBatcher._dispatch_knn_group
 
-        def slow_dispatch(self, jobs):
-            items = orig(self, jobs)
+        def slow_dispatch(self, jobs, rows=None, record=True):
+            items = orig(self, jobs, rows=rows, record=record)
             time.sleep(0.05)  # keep "knn" in flight while text enters
             return items
 
