@@ -151,6 +151,7 @@ def build_postings(rng, vocab, lengths, n_docs=None):
 def build_corpus():
     from elasticsearch_tpu.index.segment import (
         NumericField,
+        OrdinalField,
         Segment,
         VectorField,
     )
@@ -168,6 +169,19 @@ def build_corpus():
     exists = np.ones(N_DOCS, bool)
     # numeric doc-value column for the agg/range-filter configs
     popularity = rng.integers(0, 100, size=N_DOCS).astype(np.float64)
+    # dashboard-shape agg columns (cold_agg config): a 30-day date
+    # column and a 16-way keyword column (single-valued ordinal CSR)
+    day = (
+        1_700_000_000_000
+        + rng.integers(0, 30, size=N_DOCS).astype(np.int64) * 86_400_000
+    ).astype(np.float64)
+    cat_ords = rng.integers(0, 16, size=N_DOCS).astype(np.int32)
+    cat_field = OrdinalField(
+        ord_terms=[f"cat{j:02d}" for j in range(16)],
+        ords=cat_ords,
+        mv_ords=cat_ords.copy(),
+        mv_offsets=np.arange(N_DOCS + 1, dtype=np.int32),
+    )
 
     def seg_with(vectors):
         return Segment(
@@ -178,9 +192,10 @@ def build_corpus():
             numerics={
                 "popularity": NumericField(
                     values=popularity, exists=exists.copy()
-                )
+                ),
+                "day": NumericField(values=day, exists=exists.copy()),
             },
-            ordinals={},
+            ordinals={"cat": cat_field},
             vectors={
                 "vec": VectorField(
                     vectors=vectors,
@@ -209,6 +224,8 @@ def make_service(seg, backend: str):
                 "title": {"type": "text"},
                 "body": {"type": "text"},
                 "popularity": {"type": "integer"},
+                "day": {"type": "date"},
+                "cat": {"type": "keyword"},
                 "vec": {
                     "type": "dense_vector",
                     "dims": DIMS,
@@ -389,6 +406,31 @@ def build_bodies(body_df, title_df):
             "aggs": {"pop_avg": {"avg": {"field": "popularity"}}},
         }
         for t in agg_texts
+    ]
+    # config 8: COLD agg traffic — every request is a unique dashboard
+    # body (terms + date_histogram + stats, the classic Kibana shape)
+    # with the request cache opted out, so each one pays the full agg
+    # computation: host AggCollector vs the device segment-sum engine
+    # is an apples-to-apples A/B on the same bodies.
+    cold_agg_texts = make_query_texts(
+        body_df, min(N_QUERIES_SECONDARY, 1024), seed=19
+    )
+    bodies["cold_agg"] = [
+        {
+            "size": 0,
+            "request_cache": False,
+            "query": {"match": {"body": t}},
+            "aggs": {
+                "by_day": {
+                    "date_histogram": {
+                        "field": "day", "fixed_interval": "1d",
+                    }
+                },
+                "cats": {"terms": {"field": "cat"}},
+                "pop": {"stats": {"field": "popularity"}},
+            },
+        }
+        for t in cold_agg_texts
     ]
     return bodies
 
@@ -1086,6 +1128,64 @@ def main():
         f"[repeated_agg] cold={agg_cold_qps:.1f} QPS "
         f"warm={agg_warm_qps:.1f} QPS (hit rate {agg_hit_rate:.3f}, "
         f"agg delta {agg_max_rel:.2e})"
+    )
+
+    # ---- cold_agg: unique-body (cache-miss) dashboard traffic, host
+    # AggCollector vs the device segment-sum engine on the SAME bodies,
+    # with an exact agg-parity gate between the two paths ----
+    from elasticsearch_tpu.search import aggs_device
+
+    log("[cold_agg] warmup/compile…")
+    os.environ["ES_TPU_DEVICE_AGGS"] = "force"  # silent host routing
+    # would invalidate the A/B — force makes it a hard error instead
+    try:
+        for b in bodies["cold_agg"][:4]:
+            svc_jax.search(b)
+        dev0 = aggs_device.stats_snapshot()["device_routed"]
+        agg_dev_qps, agg_dev_p50, agg_dev_p99, _ = run_load(
+            svc_jax, bodies["cold_agg"]
+        )
+        dev_routed = (
+            aggs_device.stats_snapshot()["device_routed"] - dev0
+        )
+        os.environ["ES_TPU_DEVICE_AGGS"] = "off"
+        for b in bodies["cold_agg"][:2]:
+            svc_jax.search(b)
+        agg_host_qps, agg_host_p50, _, _ = run_load(
+            svc_jax, bodies["cold_agg"]
+        )
+        # parity gate: device partials reduce to EXACTLY the host
+        # collector's response (the "never a silent wrong answer"
+        # contract, measured); the numpy oracle service cross-checks
+        # the backend too
+        os.environ["ES_TPU_DEVICE_AGGS"] = "force"
+        agg_parity_exact = True
+        for b in bodies["cold_agg"][:6]:
+            dev_aggs = svc_jax.search(b)["aggregations"]
+            os.environ["ES_TPU_DEVICE_AGGS"] = "off"
+            host_aggs = svc_jax.search(b)["aggregations"]
+            oracle_aggs = svc_np.search(b)["aggregations"]
+            os.environ["ES_TPU_DEVICE_AGGS"] = "force"
+            if dev_aggs != host_aggs or dev_aggs != oracle_aggs:
+                agg_parity_exact = False
+    finally:
+        os.environ["ES_TPU_DEVICE_AGGS"] = "auto"
+    agg_speedup = agg_dev_qps / max(agg_host_qps, 1e-9)
+    configs["cold_agg"] = {
+        "qps": round(agg_dev_qps, 1),
+        "host_qps": round(agg_host_qps, 1),
+        "device_qps": round(agg_dev_qps, 1),
+        "speedup_vs_host": round(agg_speedup, 2),
+        "p50_ms": round(agg_dev_p50, 2),
+        "p99_ms": round(agg_dev_p99, 2),
+        "host_p50_ms": round(agg_host_p50, 2),
+        "device_routed": int(dev_routed),
+        "agg_parity_exact": bool(agg_parity_exact),
+    }
+    log(
+        f"[cold_agg] host={agg_host_qps:.1f} QPS "
+        f"device={agg_dev_qps:.1f} QPS ({agg_speedup:.2f}x, "
+        f"parity_exact={agg_parity_exact})"
     )
 
     # single-thread oracle (GIL-free per-core honesty number)

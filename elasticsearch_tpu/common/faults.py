@@ -26,6 +26,9 @@ Schedule shape (env `ES_TPU_FAULTS`, or `POST /_internal/faults`):
   - ``batcher.collect``     (QueryBatcher host-collect of one group)
   - ``knn.collect``         (kNN group device→host collect)
   - ``admission.acquire``   (per-request admission gate)
+  - ``aggs.collect``        (device-aggregation plan dispatch — ctx
+    carries index/shard; an injected error here exercises the
+    device→host AggCollector fallback deterministically)
 * ``match``: exact-equality filters over the ctx kwargs the site passes
   (string-compared, so {"shard": 1} matches shard=1).
 * ``kind``: ``error`` (raise InjectedFault, 500-shaped), ``drop``

@@ -179,6 +179,28 @@ def mesh_t_max() -> int:
     return max(64, v)
 
 
+# ---- device-aggregations knobs (search/aggs_device.py) ----
+#
+# ES_TPU_DEVICE_AGGS:  "auto" (default) — size:0/agg bodies whose whole
+#                      agg tree is device-supported AND float-exact-safe
+#                      (integer-valued columns within the float32 exact
+#                      window; see search/aggs_device.py) run as
+#                      segment-sum kernels on device, everything else on
+#                      the host AggCollector; "force" — unsupported
+#                      trees RAISE instead of silently host-routing (the
+#                      bench/CI routing assertion mode; runtime faults
+#                      still fall back to the host); "off" — every agg
+#                      body uses the host collector (the pre-PR 8 path).
+
+DEVICE_AGGS_ENV = "ES_TPU_DEVICE_AGGS"
+
+
+def device_aggs_mode() -> str:
+    """Device-aggregations routing mode: "auto" | "force" | "off"."""
+    v = os.environ.get(DEVICE_AGGS_ENV, "auto").strip().lower()
+    return v if v in ("auto", "force", "off") else "auto"
+
+
 # ---- admission-control knobs (search/admission.py) ----
 #
 # ES_TPU_ADMISSION:            "on" (default) | "off" — the per-node

@@ -57,7 +57,11 @@ from ..common.settings import (
 from ..index.segment import INVALID_DOC, TILE
 from ..ops import scoring
 from .mesh import DATA_AXIS, SHARD_AXIS, fold_factor, make_mesh
-from .sharded import build_mesh_knn_step, build_mesh_text_step
+from .sharded import (
+    build_mesh_agg_step,
+    build_mesh_knn_step,
+    build_mesh_text_step,
+)
 
 BPAD = scoring.BPAD
 
@@ -123,6 +127,7 @@ class _MeshSnapshot:
         self.live = None  # bool[E_pad, Nmax] device (live ∧ in-range)
         self.text: Dict[str, dict] = {}  # field -> stacked text arrays
         self.knn: Dict[str, dict] = {}  # field -> stacked vector arrays
+        self.aggs: Dict[tuple, dict] = {}  # stacked agg column views
         self.steps: Dict[tuple, object] = {}
         self.closed = False
 
@@ -152,6 +157,32 @@ class _MeshSnapshot:
         charges, self.charges = self.charges, []
         for cat, nbytes in charges:
             hbm_ledger.release(cat, nbytes)
+
+
+class MeshAggPlan:
+    """A compiled mesh agg body — the batcher's ``mesh_agg`` job plan.
+    ``sig`` groups structurally identical dashboard shapes into one
+    SPMD launch; the query (match plan terms / match_all) varies per
+    row. ``terms``/``boost``/``msm`` delegate to the match plan so the
+    mesh text packers can treat agg jobs like match jobs."""
+
+    def __init__(self, nodes, specs, mplan):
+        self.nodes = nodes
+        self.specs = specs
+        self.mplan = mplan  # batcher MatchPlan | None (match_all)
+        self.sig = (tuple(specs), mplan is not None)
+
+    @property
+    def terms(self):
+        return self.mplan.terms if self.mplan is not None else ()
+
+    @property
+    def boost(self) -> float:
+        return self.mplan.boost if self.mplan is not None else 1.0
+
+    @property
+    def msm(self) -> int:
+        return self.mplan.msm if self.mplan is not None else 1
 
 
 class MeshExecutor:
@@ -389,6 +420,156 @@ class MeshExecutor:
                 "n_per_entry": n_per_entry,
             }
             snap.knn[field] = view
+            return view
+
+    # ---- stacked aggregation views (lazy, per snapshot) ----
+
+    def _agg_num_view(self, snap: _MeshSnapshot, field: str) -> dict:
+        """Stacked float32 doc-value column (min/max), exact int32 copy
+        (sums), and exists mask [E, Nmax]."""
+        from ..search import aggs_device
+
+        key = ("num", field)
+        view = snap.aggs.get(key)
+        if view is not None:
+            return view
+        with self._lock:
+            view = snap.aggs.get(key)
+            if view is not None:
+                return view
+            vals = np.zeros((snap.e_pad, snap.n_docs_max), np.float32)
+            ivals = np.zeros((snap.e_pad, snap.n_docs_max), np.int32)
+            exists = np.zeros((snap.e_pad, snap.n_docs_max), bool)
+            for e, (sid, si) in enumerate(snap.entries):
+                nf = snap.readers[sid].segments[si].numerics.get(field)
+                if nf is None:
+                    continue
+                n = len(nf.values)
+                vals[e, :n] = nf.values.astype(np.float32)
+                exists[e, :n] = nf.exists
+                p = aggs_device.col_profile(snap.executors[sid], si, field)
+                if p.sum_exact and p.n_exist:
+                    col = np.zeros(n, np.int32)
+                    col[nf.exists] = (
+                        nf.values[nf.exists].astype(np.int64).astype(
+                            np.int32
+                        )
+                    )
+                    ivals[e, :n] = col
+            snap.charge(vals.nbytes + ivals.nbytes + exists.nbytes)
+            sh2 = NamedSharding(snap.mesh, P(SHARD_AXIS, None))
+            view = {
+                "values": jax.device_put(vals, sh2),
+                "ivalues": jax.device_put(ivals, sh2),
+                "exists": jax.device_put(exists, sh2),
+            }
+            snap.aggs[key] = view
+            return view
+
+    def _agg_ord_view(self, snap: _MeshSnapshot, field: str) -> dict:
+        """GLOBAL ordinal table + stacked per-entry multi-value CSR
+        mapped onto it: the ordinal-table union across the ``shards``
+        axis happens here at snapshot build, so the device step only
+        scatter-adds per-entry count vectors and ``psum``s them."""
+        key = ("ord", field)
+        view = snap.aggs.get(key)
+        if view is not None:
+            return view
+        with self._lock:
+            view = snap.aggs.get(key)
+            if view is not None:
+                return view
+            per_entry = []
+            vocab = set()
+            l_max = 1
+            for sid, si in snap.entries:
+                of = snap.readers[sid].segments[si].ordinals.get(field)
+                per_entry.append(of)
+                if of is not None:
+                    vocab.update(of.ord_terms)
+                    l_max = max(l_max, len(of.mv_ords))
+            gterms = sorted(vocab)
+            gmap = {t: i for i, t in enumerate(gterms)}
+            gords = np.zeros((snap.e_pad, l_max), np.int32)
+            edocs = np.zeros((snap.e_pad, l_max), np.int32)
+            evalid = np.zeros((snap.e_pad, l_max), bool)
+            for e, of in enumerate(per_entry):
+                if of is None or not len(of.mv_ords):
+                    continue
+                L = len(of.mv_ords)
+                remap = np.array(
+                    [gmap[t] for t in of.ord_terms], np.int32
+                )
+                gords[e, :L] = remap[of.mv_ords]
+                edocs[e, :L] = np.repeat(
+                    np.arange(len(of.mv_offsets) - 1, dtype=np.int32),
+                    np.diff(of.mv_offsets),
+                )
+                evalid[e, :L] = True
+            snap.charge(gords.nbytes + edocs.nbytes + evalid.nbytes)
+            sh2 = NamedSharding(snap.mesh, P(SHARD_AXIS, None))
+            view = {
+                "gterms": gterms,
+                "gords": jax.device_put(gords, sh2),
+                "edocs": jax.device_put(edocs, sh2),
+                "evalid": jax.device_put(evalid, sh2),
+            }
+            snap.aggs[key] = view
+            return view
+
+    def _agg_histo_view(
+        self, snap: _MeshSnapshot, field: str, interval: int, offset: int
+    ) -> dict:
+        """Stacked GLOBAL-relative histogram bucket ids (host int64
+        floor-division, exact at any span) + exists [E, Nmax]."""
+        key = ("histo", field, int(interval), int(offset))
+        view = snap.aggs.get(key)
+        if view is not None:
+            return view
+        with self._lock:
+            view = snap.aggs.get(key)
+            if view is not None:
+                return view
+            qs = []
+            for sid, si in snap.entries:
+                nf = snap.readers[sid].segments[si].numerics.get(field)
+                if nf is None or not nf.exists.any():
+                    qs.append(None)
+                    continue
+                qs.append(
+                    (nf.values[nf.exists].astype(np.int64) - offset)
+                    // interval
+                )
+            qmins = [int(q.min()) for q in qs if q is not None]
+            if not qmins:
+                raise MeshUnavailable(f"no entry has field [{field}]")
+            qmin = min(qmins)
+            nb = max(int(q.max()) for q in qs if q is not None) - qmin + 1
+            from ..search.aggs_device import MAX_DEVICE_BUCKETS
+
+            if nb > MAX_DEVICE_BUCKETS:
+                raise MeshUnavailable(f"histogram would make {nb} buckets")
+            ids = np.zeros((snap.e_pad, snap.n_docs_max), np.int32)
+            exists = np.zeros((snap.e_pad, snap.n_docs_max), bool)
+            for e, ((sid, si), q) in enumerate(zip(snap.entries, qs)):
+                if q is None:
+                    continue
+                nf = snap.readers[sid].segments[si].numerics.get(field)
+                n = len(nf.values)
+                col = np.zeros(n, np.int32)
+                col[nf.exists] = (q - qmin).astype(np.int32)
+                ids[e, :n] = col
+                exists[e, :n] = nf.exists
+            snap.charge(ids.nbytes + exists.nbytes)
+            sh2 = NamedSharding(snap.mesh, P(SHARD_AXIS, None))
+            view = {
+                "qmin": qmin,
+                "nb": nb,
+                "nbpad": scoring.next_bucket(nb, 16),
+                "ids": jax.device_put(ids, sh2),
+                "exists": jax.device_put(exists, sh2),
+            }
+            snap.aggs[key] = view
             return view
 
     # ---- compiled step cache ----
@@ -726,6 +907,273 @@ class MeshExecutor:
                 hits=hits,
                 snapshot=snap,
             )
+            j.event.set()
+
+    # ---- mesh aggregations (one SPMD launch per agg-body group) ----
+
+    def compile_agg(self, nodes, mplan, mappings) -> "MeshAggPlan":
+        """Compiles a size:0 agg body for the mesh step. Supported on
+        this path: metric leaves sum/avg/min/max/value_count/stats,
+        keyword terms, histogram / date_histogram (fixed intervals) —
+        all WITHOUT subs; anything else raises MeshUnavailable and the
+        per-shard path (with its own device engine) serves the request.
+        The same float-exactness profiles as search/aggs_device gate
+        routing, with the sum window tightened to the GLOBAL Σ|v| since
+        psum accumulates float32 partial sums across the whole index."""
+        from ..index.mapping import KEYWORD
+        from ..search import aggs_device
+        from ..search.aggs import PIPELINE_TYPES, _int_param, _norm_order
+        from ..search.aggs_device import (
+            I32_SUM_BOUND,
+            _METRIC_KINDS,
+            _NEEDS_CMP,
+            _NEEDS_SUM,
+            _parse_dh_interval,
+        )
+
+        snap = self.ensure_snapshot()
+        specs = []
+        for node in nodes:
+            if node.type in PIPELINE_TYPES:
+                continue
+            if node.subs:
+                raise MeshUnavailable("mesh aggs do not nest")
+            if node.type in _METRIC_KINDS and node.type != "percentiles":
+                field = node.params.get("field")
+                if field is None:
+                    raise MeshUnavailable("metric without a field")
+                mf = mappings.get(field)
+                if mf is not None and mf.type in ("keyword", "text"):
+                    raise MeshUnavailable("keyword metric")
+                abs_total = 0.0
+                for sid, si in snap.entries:
+                    p = aggs_device.col_profile(
+                        snap.executors[sid], si, field
+                    )
+                    abs_total += p.abs_sum
+                    if node.type in _NEEDS_SUM and not (
+                        not p.present or p.n_exist == 0 or p.integer_valued
+                    ):
+                        raise MeshUnavailable("non-integer sum column")
+                    if node.type in _NEEDS_CMP and not p.cmp_exact:
+                        raise MeshUnavailable("non-f32-exact column")
+                if node.type in _NEEDS_SUM and abs_total >= I32_SUM_BOUND:
+                    raise MeshUnavailable("sum outside the int32 window")
+                specs.append(
+                    ("metric", node.name, node.type, field)
+                )
+            elif node.type == "terms":
+                field = node.params.get("field")
+                mf = mappings.get(field) if field else None
+                if mf is None or mf.type != KEYWORD:
+                    raise MeshUnavailable("mesh terms needs keyword")
+                order = _norm_order(
+                    node.params.get("order", {"_count": "desc"})
+                )
+                if next(iter(order)) not in ("_count", "_key"):
+                    raise MeshUnavailable("terms order")
+                size = _int_param(node, "size", 10)
+                shard_size = _int_param(
+                    node, "shard_size", max(int(size * 1.5) + 10, size)
+                )
+                specs.append(
+                    ("terms_kw", node.name, field, size, shard_size,
+                     tuple(order.items()))
+                )
+            elif node.type in ("histogram", "date_histogram"):
+                field = node.params.get("field")
+                if field is None:
+                    raise MeshUnavailable("histogram without a field")
+                date = node.type == "date_histogram"
+                if date:
+                    interval, cal = _parse_dh_interval(node.params)
+                    if cal is not None:
+                        raise MeshUnavailable("calendar interval")
+                    offset = 0
+                else:
+                    interval = float(node.params.get("interval", 0))
+                    offset = float(node.params.get("offset", 0))
+                    if (
+                        interval <= 0
+                        or interval != int(interval)
+                        or offset != int(offset)
+                    ):
+                        raise MeshUnavailable("non-integer interval")
+                for sid, si in snap.entries:
+                    p = aggs_device.col_profile(
+                        snap.executors[sid], si, field
+                    )
+                    if p.present and p.n_exist and not p.integer_valued:
+                        raise MeshUnavailable("non-integer histogram col")
+                specs.append(
+                    ("histo", node.name, field, int(interval), int(offset),
+                     date)
+                )
+            else:
+                raise MeshUnavailable(f"mesh agg type [{node.type}]")
+        return MeshAggPlan(nodes, specs, mplan)
+
+    def dispatch_agg(self, jobs):
+        snap = self.ensure_snapshot()
+        plan0 = jobs[0].plan
+        rows = self._rows_for(snap, len(jobs))
+        node_descs = []
+        collect_meta = []
+        for spec in plan0.specs:
+            kind = spec[0]
+            if kind == "metric":
+                view = self._agg_num_view(snap, spec[3])
+                node_descs.append(
+                    ("metric", view["values"], view["ivalues"],
+                     view["exists"])
+                )
+                collect_meta.append((spec, None))
+            elif kind == "terms_kw":
+                view = self._agg_ord_view(snap, spec[2])
+                nbpad = scoring.next_bucket(
+                    max(len(view["gterms"]), 1), 16
+                )
+                node_descs.append(
+                    ("counts_entry", view["gords"], view["edocs"],
+                     view["evalid"], nbpad)
+                )
+                collect_meta.append((spec, view["gterms"]))
+            else:  # histo
+                view = self._agg_histo_view(
+                    snap, spec[2], spec[3], spec[4]
+                )
+                node_descs.append(
+                    ("counts_doc", view["ids"], view["exists"],
+                     view["nbpad"])
+                )
+                collect_meta.append((spec, view["qmin"]))
+        with_cnt = any(j.plan.msm > 1 for j in jobs)
+        if plan0.mplan is not None:
+            field = plan0.mplan.field
+            tview = self._text_view(snap, field)
+            ti, tw, tv, T, slots = self._pack_match(
+                snap, tview, jobs, mesh_t_max(), rows
+            )
+            text = (
+                tview["doc_ids"], tview["tfs"], tview["inv_norm"]
+            )
+        else:
+            field = None
+            T = 1
+            slots = 0
+            ti = np.zeros((snap.e_pad, rows, 1), np.int32)
+            tw = np.zeros((snap.e_pad, rows, 1), np.float32)
+            tv = np.zeros((snap.e_pad, rows, 1), bool)
+            text = None
+        msm = np.ones(rows, np.int32)
+        msm[: len(jobs)] = [j.plan.msm for j in jobs]
+        key = ("agg", plan0.sig, field, T, rows, with_cnt)
+        step = snap.steps.get(key)
+        if step is None:
+            with self._lock:
+                step = snap.steps.get(key)
+                if step is None:
+                    step = build_mesh_agg_step(
+                        snap.mesh, snap.live, node_descs, text,
+                        with_cnt,
+                    )
+                    snap.steps[key] = step
+        with _LAUNCH_LOCK:
+            out = step(ti, tw, tv, msm)
+        with self._lock:
+            self.stats["launches"] += 1
+            self.stats["jobs"] += len(jobs)
+        n_total = sum(
+            snap.readers[sid].segments[si].num_docs
+            for sid, si in snap.entries
+        )
+        from ..ops.agg_kernels import agg_flops
+
+        flops = scoring.text_plan_flops(slots, 0, 0) + agg_flops(
+            n_total, len(node_descs)
+        )
+        return {
+            "snap": snap, "out": out, "meta": collect_meta,
+            "flops": flops, "rows": rows,
+        }
+
+    def collect_agg(self, jobs, pend):
+        from ..search import aggs_device
+        from ..search.aggs import _bkey, _order_buckets
+        from ..search.aggs_device import _metric_partial
+
+        snap = pend["snap"]
+        outs = jax.device_get(pend["out"])
+        totals, maxs = outs[0], outs[1]
+        for ji, j in enumerate(jobs):
+            partials = {}
+            idx = 2
+            for spec, extra in pend["meta"]:
+                kind, name = spec[0], spec[1]
+                if kind == "metric":
+                    c = int(outs[idx][ji])
+                    s = float(outs[idx + 1][ji])
+                    mn = float(outs[idx + 2][ji])
+                    mx = float(outs[idx + 3][ji])
+                    idx += 4
+                    partials[name] = _metric_partial(
+                        spec[2], c, s if c else 0.0,
+                        mn if c else None, mx if c else None,
+                    )
+                elif kind == "terms_kw":
+                    row = np.asarray(outs[idx][ji])
+                    idx += 1
+                    gterms = extra
+                    counts = {
+                        gterms[int(o)]: int(row[o])
+                        for o in np.nonzero(row[: len(gterms)])[0]
+                    }
+                    _sp, _name, _field, size, shard_size, order_t = spec
+                    order = dict(order_t)
+                    top = _order_buckets(counts, order)[:shard_size]
+                    shard_error = (
+                        top[-1][1]
+                        if len(counts) > shard_size and top
+                        else 0
+                    )
+                    partials[name] = {
+                        "t": "terms",
+                        "buckets": {
+                            _bkey(k): {
+                                "key": k, "doc_count": c2, "subs": {}
+                            }
+                            for k, c2 in top
+                        },
+                        "sum_docs": sum(counts.values()),
+                        "size": size,
+                        "order": order,
+                        "shard_error": shard_error,
+                    }
+                else:  # histo
+                    row = np.asarray(outs[idx][ji])
+                    idx += 1
+                    qmin = extra
+                    _sp, _name, _field, interval, offset, date = spec
+                    buckets = {}
+                    for rel in np.nonzero(row)[0]:
+                        raw = (qmin + int(rel)) * interval + offset
+                        k = int(raw) if date else float(raw)
+                        buckets[k] = {
+                            "key": k,
+                            "doc_count": int(row[rel]),
+                            "subs": {},
+                        }
+                    partials[name] = {
+                        "t": "date_histogram" if date else "histogram",
+                        "buckets": buckets,
+                    }
+            mx = float(maxs[ji])
+            j.result = {
+                "total": int(totals[ji]),
+                "max_score": mx if np.isfinite(mx) else None,
+                "partials": partials,
+                "snapshot": snap,
+            }
             j.event.set()
 
     def _hit(self, snap, score, entry, doc) -> MeshHit:

@@ -664,6 +664,217 @@ def build_mesh_knn_step(
     return step
 
 
+def build_mesh_agg_step(
+    mesh: Mesh,
+    live: jax.Array,  # bool[E, Nmax] (live docs ∧ in-range padding mask)
+    node_descs: Sequence[tuple],
+    text: Optional[tuple],  # None | (doc_ids[E,T,128], tfs, inv[E,Nmax])
+    with_cnt: bool,
+):
+    """One SPMD aggregation step over stacked (shard, segment) entries:
+    per-entry bucket accumulators (segment-sum scatters over the stacked
+    doc-value / ordinal columns) reduce across the ``shards`` axis with
+    ``psum`` (counts, sums) / ``pmin`` / ``pmax`` — the coordinator's
+    agg reduce collapsed onto the ICI, one launch for the whole index.
+
+    ``node_descs`` (arrays stacked [E, …] and device-sharded on
+    ``shards``): ("metric", values, exists) → per-row (count, sum, min,
+    max); ("counts_doc", ids, exists, nbpad) → per-row int32[nbpad]
+    bucket counts (histogram family — ids are host-precomputed exact
+    relative bucket ids); ("counts_entry", gords, edocs, evalid, nbpad)
+    → the same over the multi-value ordinal CSR mapped to a GLOBAL
+    ordinal table (keyword terms — the table union happens host-side at
+    snapshot build; the per-entry count vectors are what psum merges
+    across the shards axis).
+
+    ``text`` carries one match-plan field's stacked postings (the query
+    mask is the same per-entry BM25 accumulation the serving text step
+    runs — float-exact masks); None serves match_all (mask = live).
+
+    fn(ti[E,B,T], tw, tv, msm[B]) →
+        (totals[B], max_scores[B], per-node outputs…), everything
+    replicated over ``shards`` and sharded over ``data`` only.
+    """
+    has_text = text is not None
+    n_docs = int(live.shape[1])
+
+    def body(*args):
+        it = iter(args)
+        if has_text:
+            d_b = next(it)
+            t_b = next(it)
+            i_b = next(it)
+        live_b = next(it)
+        node_b = []
+        for desc in node_descs:
+            kind = desc[0]
+            if kind == "metric":
+                node_b.append((kind, next(it), next(it), next(it)))
+            elif kind == "counts_doc":
+                node_b.append((kind, next(it), next(it), desc[3]))
+            else:  # counts_entry
+                node_b.append((kind, next(it), next(it), next(it), desc[4]))
+        ti_b = next(it)
+        tw_b = next(it)
+        tv_b = next(it)
+        msm = next(it)
+        Bd = msm.shape[0]
+
+        def scatter_rows(ids_e, sel, nbpad):
+            # [Bd, L] selection → [Bd, nbpad] counts; unselected slots
+            # land in a trash bucket that psum never sees
+            def one(sel_row):
+                safe = jnp.where(sel_row, ids_e, nbpad)
+                return (
+                    jnp.zeros(nbpad + 1, jnp.int32).at[safe].add(1)[:nbpad]
+                )
+
+            return jax.vmap(one)(sel)
+
+        def entry(e_args):
+            it2 = iter(e_args)
+            if has_text:
+                dids = next(it2)
+                tfs_ = next(it2)
+                inv = next(it2)
+            live_e = next(it2)
+            nodes_e = []
+            for desc in node_b:
+                n_arr = len(desc) - (1 if desc[0] == "metric" else 2)
+                arrs = tuple(next(it2) for _ in range(n_arr))
+                nodes_e.append((desc[0], arrs, desc[-1]))
+            ti_e = next(it2)
+            tw_e = next(it2)
+            tv_e = next(it2)
+            if has_text:
+                nt = dids.shape[0]
+                rows_d = dids[jnp.clip(ti_e, 0, nt - 1)]
+                rows_t = tfs_[jnp.clip(ti_e, 0, nt - 1)]
+                valid = (rows_d >= 0) & tv_e[:, :, None]
+                tgt, s = bm25_tile_contrib(
+                    rows_d, rows_t, tw_e[:, :, None], valid, inv, n_docs
+                )
+                acc = jnp.zeros((Bd, n_docs + 1), jnp.float32)
+                acc = jax.vmap(
+                    lambda a, d2, v2: a.at[d2.ravel()].add(v2.ravel())
+                )(acc, tgt, s)
+                scores = acc[:, :n_docs]
+                if with_cnt:
+                    cnt = jnp.zeros((Bd, n_docs + 1), jnp.int32)
+                    cnt = jax.vmap(
+                        lambda c, d2, v2: c.at[d2.ravel()].add(
+                            v2.ravel().astype(jnp.int32)
+                        )
+                    )(cnt, tgt, valid)
+                    mask = cnt[:, :n_docs] >= jnp.maximum(msm, 1)[:, None]
+                else:
+                    mask = scores > 0
+            else:
+                mask = jnp.ones((Bd, n_docs), bool)
+                scores = jnp.ones((Bd, n_docs), jnp.float32)
+            mask = mask & live_e[None, :]
+            total_e = mask.sum(axis=1, dtype=jnp.int32)
+            max_e = jnp.where(mask, scores, -jnp.inf).max(axis=1)
+            outs = []
+            for kind, arrs, nbpad in nodes_e:
+                if kind == "metric":
+                    vals, ivals, exists = arrs
+                    sel = mask & exists[None, :]
+                    v = vals.astype(jnp.float32)
+                    outs.append(
+                        (
+                            sel.sum(axis=1, dtype=jnp.int32),
+                            jnp.where(sel, ivals, 0).sum(
+                                axis=1, dtype=jnp.int32
+                            ),
+                            jnp.where(sel, v, jnp.inf).min(axis=1),
+                            jnp.where(sel, v, -jnp.inf).max(axis=1),
+                        )
+                    )
+                elif kind == "counts_doc":
+                    ids_e, exists = arrs
+                    sel = mask & exists[None, :]
+                    outs.append(scatter_rows(ids_e, sel, nbpad))
+                else:  # counts_entry
+                    gords_e, edocs_e, evalid_e = arrs
+                    sel = (
+                        jnp.take(mask, edocs_e, axis=1)
+                        & evalid_e[None, :]
+                    )
+                    outs.append(scatter_rows(gords_e, sel, nbpad))
+            return (total_e, max_e, tuple(outs))
+
+        per_entry = []
+        if has_text:
+            per_entry.extend([d_b, t_b, i_b])
+        per_entry.append(live_b)
+        for desc in node_b:
+            per_entry.extend(desc[1:] if desc[0] == "metric" else desc[1:-1])
+        per_entry.extend([ti_b, tw_b, tv_b])
+        total_f, max_f, outs_f = jax.vmap(
+            lambda *xs: entry(xs)
+        )(*per_entry)
+        totals = jax.lax.psum(
+            total_f.sum(axis=0), SHARD_AXIS
+        )
+        maxs = jax.lax.pmax(max_f.max(axis=0), SHARD_AXIS)
+        outs = []
+        for desc, out_f in zip(node_descs, outs_f):
+            if desc[0] == "metric":
+                c_f, s_f, mn_f, mx_f = out_f
+                outs.append(
+                    (
+                        jax.lax.psum(c_f.sum(axis=0), SHARD_AXIS),
+                        jax.lax.psum(s_f.sum(axis=0), SHARD_AXIS),
+                        jax.lax.pmin(mn_f.min(axis=0), SHARD_AXIS),
+                        jax.lax.pmax(mx_f.max(axis=0), SHARD_AXIS),
+                    )
+                )
+            else:
+                outs.append(
+                    jax.lax.psum(out_f.sum(axis=0), SHARD_AXIS)
+                )
+        return (totals, maxs) + tuple(
+            x for o in outs for x in (o if isinstance(o, tuple) else (o,))
+        )
+
+    p3 = P(SHARD_AXIS, None, None)
+    p2 = P(SHARD_AXIS, None)
+    p_plan = P(SHARD_AXIS, DATA_AXIS, None)
+    in_specs: list = []
+    if has_text:
+        in_specs.extend([p3, p3, p2])
+    in_specs.append(p2)
+    for desc in node_descs:
+        in_specs.extend([p2] * (len(desc) - (2 if desc[0] != "metric" else 1)))
+    in_specs.extend([p_plan, p_plan, p_plan, P(DATA_AXIS)])
+    out_specs: list = [P(DATA_AXIS), P(DATA_AXIS)]
+    for desc in node_descs:
+        if desc[0] == "metric":
+            out_specs.extend([P(DATA_AXIS)] * 4)
+        else:
+            out_specs.append(P(DATA_AXIS, None))
+    fn = shard_map(
+        body,
+        mesh=mesh,
+        in_specs=tuple(in_specs),
+        out_specs=tuple(out_specs),
+        check_vma=False,
+    )
+    static_arrays: list = []
+    if has_text:
+        static_arrays.extend(list(text))
+    static_arrays.append(live)
+    for desc in node_descs:
+        static_arrays.extend(desc[1:-1] if desc[0] != "metric" else desc[1:])
+
+    @jax.jit
+    def step(ti, tw, tv, msm):
+        return fn(*static_arrays, ti, tw, tv, msm)
+
+    return step
+
+
 def rrf_fuse(
     lex: ShardedTopK, vec: ShardedTopK, k: int, rank_constant: int = 60
 ) -> Tuple[jax.Array, jax.Array]:

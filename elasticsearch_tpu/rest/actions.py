@@ -664,6 +664,18 @@ class RestActions:
         # per-category child breakers next to the "hbm" parent (per-
         # category bytes were accounted but invisible before)
         category_breakers = hbm_ledger.child_breakers()
+        # device-aggregations engine counters (search/aggs_device.py):
+        # device_routed vs host_routed shard collections, mid-flight
+        # fallbacks, mesh SPMD agg launches, kernel wall time, and the
+        # `aggs` HBM ledger bytes (int offset / value-ordinal columns)
+        from ..search.aggs_device import stats_snapshot as agg_stats
+
+        aggs_block = agg_stats()
+        aggs_block["batched_jobs"] = sum(
+            getattr(idx, "_batcher", None).stats.get("agg_jobs", 0)
+            for idx in self.cluster.indices.values()
+            if getattr(idx, "_batcher", None) is not None
+        )
         return 200, {
             "cluster_name": self.cluster.cluster_name,
             "nodes": {
@@ -698,6 +710,7 @@ class RestActions:
                         **category_breakers,
                     },
                     "pipeline": pipeline,
+                    "aggs": aggs_block,
                     # overload-protection block (search/admission.py):
                     # per-tenant queue depths, the adaptive concurrency
                     # limit, pressure tier, shed/brownout/retry-budget

@@ -506,6 +506,9 @@ class QueryBatcher:
             # idle worker (bucket-1 launch, collected before the next
             # dequeue — the interactive-latency fast path)
             "express_lane_hits": 0,
+            # device-aggregations job family (size:0/agg bodies riding
+            # the dispatch/collect pipeline as segment-sum launches)
+            "agg_jobs": 0,
         }
         # per-bucket launch histogram + occupancy sums (guarded by
         # self._lock; surfaced via batching_stats() → _nodes/stats):
@@ -513,11 +516,16 @@ class QueryBatcher:
         self._bucket_launches: Dict[int, int] = {}
         self._occ_jobs = 0
         self._occ_slots = 0
-        # (family-signature) keys whose bucket ladder is already warmed
+        # (family-signature) keys whose bucket ladder is already warmed,
+        # plus a count of warm loops still running (the warm runs on the
+        # worker AFTER the triggering group's waiters complete, so it is
+        # asynchronous to every caller; wait_warm_idle() lets tests and
+        # benchmarks quiesce before probing compile caches)
         self._warmed: set = set()
+        self._warm_inflight = 0
         # family → groups currently dispatched-but-not-collected,
         # across ALL workers (guarded by self._lock)
-        self._inflight = {"text": 0, "knn": 0}
+        self._inflight = {"text": 0, "knn": 0, "agg": 0}
         # per-device roofline accounting (straggler visibility): device
         # id → [inflight_groups, busy_t0, busy_s, flops]; single-device
         # groups attribute to device 0, mesh groups to every device in
@@ -791,6 +799,13 @@ class QueryBatcher:
                     )
                 elif j.kind == "mesh_knn":
                     key = (id(j.executor), "Mk", j.plan.field, kb)
+                elif j.kind == "agg":
+                    # device-aggregations family: jobs group by the
+                    # compiled plan's structural signature so identical
+                    # dashboard shapes share one dispatch slot
+                    key = (id(j.executor), "a", j.plan.sig, kb)
+                elif j.kind == "mesh_agg":
+                    key = (id(j.executor), "Ma", j.plan.sig, kb)
                 else:  # knn
                     key = (id(j.executor), "k", j.plan.field, kb)
                 groups.setdefault(key, []).append(j)
@@ -799,8 +814,13 @@ class QueryBatcher:
             )
             for key, jobs in ordered:
                 kind, kb = key[1], key[-1]
-                mesh = kind in ("Mm", "Ms", "Mk")
-                fam = "knn" if kind in ("k", "Mk") else "text"
+                mesh = kind in ("Mm", "Ms", "Mk", "Ma")
+                if kind in ("k", "Mk"):
+                    fam = "knn"
+                elif kind in ("a", "Ma"):
+                    fam = "agg"
+                else:
+                    fam = "text"
                 # pad-bucket ladder: the group's launch width is the
                 # smallest compiled bucket covering its occupancy —
                 # mesh groups pick theirs internally (the data-axis
@@ -846,12 +866,20 @@ class QueryBatcher:
                         )
                         dispatched = True
                         self._maybe_warm(key, jobs, kb, rows)
+                    elif kind == "a":
+                        ctx.pending.append(
+                            (key, jobs, fam,
+                             self._dispatch_agg_group(jobs), dev_ids)
+                        )
+                        dispatched = True
                     else:
                         mex = jobs[0].executor
                         if kind == "Mm":
                             pend = mex.dispatch_match(jobs, kb)
                         elif kind == "Ms":
                             pend = mex.dispatch_serve(jobs, kb)
+                        elif kind == "Ma":
+                            pend = mex.dispatch_agg(jobs)
                         else:
                             pend = mex.dispatch_knn(jobs, kb)
                         # the busy window opens on the devices the
@@ -907,6 +935,8 @@ class QueryBatcher:
                         self._collect_serve_group(jobs, key[-1], pend)
                     elif kind == "k":
                         self._collect_knn_group(jobs, pend)
+                    elif kind == "a":
+                        self._collect_agg_group(jobs, pend)
                     elif kind in ("Mm", "Ms"):
                         t0 = time.perf_counter()
                         jobs[0].executor.collect_match(jobs, pend)
@@ -914,6 +944,10 @@ class QueryBatcher:
                     elif kind == "Mk":
                         t0 = time.perf_counter()
                         jobs[0].executor.collect_knn(jobs, pend)
+                        self._add_stall(time.perf_counter() - t0)
+                    elif kind == "Ma":
+                        t0 = time.perf_counter()
+                        jobs[0].executor.collect_agg(jobs, pend)
                         self._add_stall(time.perf_counter() - t0)
                     else:
                         self._collect_knn_group(jobs, pend)
@@ -1018,38 +1052,57 @@ class QueryBatcher:
             if warm_key in self._warmed:
                 return
             self._warmed.add(warm_key)
-        if kind == "m":
-            j0 = next((j for j in jobs if j.plan.msm > 1), jobs[0])
-        elif kind == "k":
-            j0 = max(jobs, key=lambda j: j.plan.num_candidates)
-        else:
-            j0 = jobs[0]
-        for b in self.buckets:
-            if b == rows:
-                continue
-            dummy = [
-                _Job(j0.executor, j0.plan, j0.k, kind=j0.kind,
-                     query=j0.query)
-            ]
-            try:
-                if kind == "m":
-                    self._run_group(dummy, key[2], kb, rows=b,
-                                    record=False)
-                elif kind == "s":
-                    pend = self._dispatch_serve_group(
-                        dummy, kb, rows=b, record=False
-                    )
-                    self._collect_serve_group(dummy, kb, pend,
-                                              record=False)
-                else:
-                    pend = self._dispatch_knn_group(
-                        dummy, rows=b, record=False
-                    )
-                    self._collect_knn_group(dummy, pend, record=False)
-            except BaseException:
-                # warmup is opportunistic: a failed bucket just compiles
-                # lazily on its first live hit instead
-                pass
+            self._warm_inflight += 1
+        try:
+            if kind == "m":
+                j0 = next((j for j in jobs if j.plan.msm > 1), jobs[0])
+            elif kind == "k":
+                j0 = max(jobs, key=lambda j: j.plan.num_candidates)
+            else:
+                j0 = jobs[0]
+            for b in self.buckets:
+                if b == rows:
+                    continue
+                dummy = [
+                    _Job(j0.executor, j0.plan, j0.k, kind=j0.kind,
+                         query=j0.query)
+                ]
+                try:
+                    if kind == "m":
+                        self._run_group(dummy, key[2], kb, rows=b,
+                                        record=False)
+                    elif kind == "s":
+                        pend = self._dispatch_serve_group(
+                            dummy, kb, rows=b, record=False
+                        )
+                        self._collect_serve_group(dummy, kb, pend,
+                                                  record=False)
+                    else:
+                        pend = self._dispatch_knn_group(
+                            dummy, rows=b, record=False
+                        )
+                        self._collect_knn_group(dummy, pend, record=False)
+                except BaseException:
+                    # warmup is opportunistic: a failed bucket just
+                    # compiles lazily on its first live hit instead
+                    pass
+        finally:
+            with self._lock:
+                self._warm_inflight -= 1
+
+    def wait_warm_idle(self, timeout: float = 60.0) -> bool:
+        """Blocks until no bucket-warmup loop is running (the warm is
+        asynchronous to the triggering request — its waiters complete
+        BEFORE the remaining ladder buckets compile). Test/benchmark
+        hook: compile-cache probes must quiesce first or they race the
+        warm tail. Returns False on timeout."""
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            with self._lock:
+                if self._warm_inflight == 0:
+                    return True
+            time.sleep(0.01)
+        return False
 
     # ---- per-device busy windows (straggler visibility) ----
 
@@ -1443,6 +1496,43 @@ class QueryBatcher:
                     si, s1[None, :], d1[None, :], np.array([t1]),
                 )
         self._finish_jobs(jobs, per_job_cands, totals, reader)
+
+    def _dispatch_agg_group(self, jobs: List[_Job]) -> List[Tuple]:
+        """Launches the device-aggregation plans (search/aggs_device
+        segment-sum kernels) for a group of same-signature agg jobs
+        WITHOUT host sync; downloads happen at collect. Per-job failure
+        isolation: one body's injected fault or column surprise fails
+        only that job's waiter (the shard path then reruns it on the
+        host collector), not its group."""
+        out: List[Tuple] = []
+        for j in jobs:
+            try:
+                pend = j.plan.dispatch()
+            except BaseException as e:
+                out.append(("err", e))
+                continue
+            with self._lock:
+                self.stats["launches"] += 1
+                self.stats["agg_jobs"] += 1
+            self._add_flops(j.plan.flops_estimate())
+            out.append(("ok", pend))
+        return out
+
+    def _collect_agg_group(self, jobs: List[_Job], pends: List[Tuple]):
+        for j, (tag, pend) in zip(jobs, pends):
+            if j.event.is_set():
+                continue
+            if tag == "err":
+                j.error = pend
+                j.event.set()
+                continue
+            try:
+                t0 = time.perf_counter()
+                j.result = j.plan.collect(pend)  # (TopDocs, partials)
+                self._add_stall(time.perf_counter() - t0)
+            except BaseException as e:
+                j.error = e
+            j.event.set()
 
     def _dispatch_knn_group(self, jobs: List[_Job],
                             rows: Optional[int] = None,

@@ -202,6 +202,13 @@ class JaxExecutor:
         self._fused_mf: Dict[Tuple[int, tuple], object] = {}
         self._sort_rank_cache: Dict[Tuple[int, str, bool], tuple] = {}
         self._entry_docs_dev_cache: Dict[Tuple[int, str], object] = {}
+        # device-aggregations engine caches (search/aggs_device.py):
+        # per-(segment, field) column exactness profiles plus the int32
+        # offset / value-ordinal agg columns (charged to the `aggs`
+        # HbmLedger category, released with the executor on generation
+        # bump — exactly the invalidation the agg plans need)
+        self._agg_profiles: Dict[Tuple[int, str], object] = {}
+        self._agg_cols: Dict[tuple, object] = {}
         self._seg_weights: Dict[Tuple[int, str], np.ndarray] = {}
         self._df_maps: Dict[str, Dict[str, int]] = {}
         self._shard_dfs: Dict[Tuple[str, str], int] = {}

@@ -1,0 +1,196 @@
+#!/usr/bin/env bash
+# Device-aggregations smoke: cold (cache-miss) dashboard agg traffic,
+# host AggCollector vs the device segment-sum engine on the SAME bodies.
+#
+# Gates:
+#   1. EXACT agg parity — device-routed responses must equal the host
+#      collector AND the numpy oracle bit-for-bit on every probe body
+#      (always enforced; "never a silent wrong answer" measured).
+#   2. Routing — every probe body must actually ride the device engine
+#      (ES_TPU_DEVICE_AGGS=force would hard-error otherwise).
+#   3. Cold-agg device throughput >= 5x the host collector — enforced
+#      only on hosts with >= AGGS_SMOKE_MIN_CORES (default 8) cores:
+#      the device path's win is GIL-free kernels scaling across the
+#      batcher workers (and HBM bandwidth on a real TPU); on a 1-core
+#      CI box both paths serialize onto the same core and the honest
+#      expectation is parity (same skip rule as mesh_smoke.sh's
+#      scaling gate). The measured speedup is printed either way.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+export JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}"
+export ES_TPU_ADMISSION=off
+export ES_TPU_BUCKET_WARMUP=0
+
+N_DOCS="${AGGS_SMOKE_N_DOCS:-200000}"
+N_BODIES="${AGGS_SMOKE_N_BODIES:-64}"
+MIN_CORES="${AGGS_SMOKE_MIN_CORES:-8}"
+MIN_SPEEDUP="${AGGS_SMOKE_MIN_SPEEDUP:-5.0}"
+
+python - "$N_DOCS" "$N_BODIES" "$MIN_CORES" "$MIN_SPEEDUP" <<'PY'
+import json
+import os
+import sys
+import threading
+import time
+
+import numpy as np
+
+n_docs, n_bodies = int(sys.argv[1]), int(sys.argv[2])
+min_cores, min_speedup = int(sys.argv[3]), float(sys.argv[4])
+
+sys.path.insert(0, os.getcwd())
+import bench
+
+bench.N_DOCS = n_docs
+from elasticsearch_tpu.cluster.indices import IndexService
+from elasticsearch_tpu.index.segment import (
+    NumericField, OrdinalField, Segment,
+)
+from elasticsearch_tpu.search import aggs_device
+
+rng = np.random.default_rng(5)
+lengths = rng.integers(8, 20, size=n_docs)
+pf, df = bench.build_postings(rng, 8000, lengths)
+pop = rng.integers(0, 100, size=n_docs).astype(np.float64)
+day = (
+    1_700_000_000_000
+    + rng.integers(0, 30, size=n_docs).astype(np.int64) * 86_400_000
+).astype(np.float64)
+cats = rng.integers(0, 16, size=n_docs).astype(np.int32)
+exists = np.ones(n_docs, bool)
+seg = Segment(
+    num_docs=n_docs,
+    doc_ids=[str(i) for i in range(n_docs)],
+    sources=[None] * n_docs,
+    postings={"body": pf},
+    numerics={
+        "popularity": NumericField(pop, exists.copy()),
+        "day": NumericField(day, exists.copy()),
+    },
+    ordinals={
+        "cat": OrdinalField(
+            [f"cat{j:02d}" for j in range(16)], cats, cats.copy(),
+            np.arange(n_docs + 1, dtype=np.int32),
+        )
+    },
+    vectors={},
+)
+MAPPING = {
+    "properties": {
+        "body": {"type": "text"},
+        "popularity": {"type": "integer"},
+        "day": {"type": "date"},
+        "cat": {"type": "keyword"},
+    }
+}
+
+
+def make(name, backend):
+    svc = IndexService(
+        name,
+        settings={"number_of_shards": 1, "search.backend": backend},
+        mappings_json=MAPPING,
+    )
+    eng = svc.shards[0]
+    eng.segments = [seg]
+    eng.live_docs = [None]
+    eng.seg_versions = [np.ones(n_docs, np.int64)]
+    eng.seg_seqnos = [np.arange(n_docs, dtype=np.int64)]
+    eng.seg_names = ["seg_0_0"]
+    eng._next_seq = n_docs
+    eng.change_generation += 1
+    return svc
+
+
+svc = make("aggs-smoke", "jax")
+svc_np = make("aggs-smoke-np", "numpy")
+
+texts = bench.make_query_texts(df, n_bodies, seed=19, lo=20, hi=3000)
+bodies = [
+    {
+        "size": 0,
+        "request_cache": False,
+        "query": {"match": {"body": t}},
+        "aggs": {
+            "by_day": {"date_histogram": {"field": "day",
+                                          "fixed_interval": "1d"}},
+            "cats": {"terms": {"field": "cat"}},
+            "pop": {"stats": {"field": "popularity"}},
+        },
+    }
+    for t in texts
+]
+
+
+def run(mode, threads=16):
+    os.environ["ES_TPU_DEVICE_AGGS"] = mode
+    svc.search(bodies[0])
+    svc.search(bodies[1])
+    qi = [0]
+    lock = threading.Lock()
+
+    def worker():
+        while True:
+            with lock:
+                i = qi[0]
+                if i >= len(bodies):
+                    break
+                qi[0] += 1
+            svc.search(bodies[i])
+
+    ts = [threading.Thread(target=worker) for _ in range(threads)]
+    t0 = time.perf_counter()
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+    return len(bodies) / (time.perf_counter() - t0)
+
+
+# warm both modes' compiles before measuring, A/B fair either order
+run("force")
+host_qps = run("off")
+dev_qps = run("force")
+host_qps = max(host_qps, run("off"))
+dev_qps = max(dev_qps, run("force"))
+
+# ---- gate 1+2: exact parity, device-routed (force would hard-error) ----
+os.environ["ES_TPU_DEVICE_AGGS"] = "force"
+routed0 = aggs_device.stats_snapshot()["device_routed"]
+for b in bodies[: min(12, len(bodies))]:
+    dev = svc.search(b)["aggregations"]
+    os.environ["ES_TPU_DEVICE_AGGS"] = "off"
+    host = svc.search(b)["aggregations"]
+    os.environ["ES_TPU_DEVICE_AGGS"] = "force"
+    oracle = svc_np.search(b)["aggregations"]
+    assert dev == host == oracle, (
+        "AGG PARITY FAILED:\n"
+        f"device: {json.dumps(dev, sort_keys=True)[:800]}\n"
+        f"host:   {json.dumps(host, sort_keys=True)[:800]}\n"
+        f"oracle: {json.dumps(oracle, sort_keys=True)[:800]}"
+    )
+assert aggs_device.stats_snapshot()["device_routed"] > routed0
+
+speedup = dev_qps / max(host_qps, 1e-9)
+cores = len(os.sched_getaffinity(0))
+print(
+    f"cold_agg: host={host_qps:.1f} QPS device={dev_qps:.1f} QPS "
+    f"speedup={speedup:.2f}x parity=exact cores={cores}"
+)
+if cores >= min_cores:
+    assert speedup >= min_speedup, (
+        f"device cold-agg speedup {speedup:.2f}x < {min_speedup}x "
+        f"on a {cores}-core host"
+    )
+    print(f"speedup gate PASSED (>= {min_speedup}x)")
+else:
+    print(
+        f"speedup gate SKIPPED: {cores} core(s) < {min_cores} — the "
+        "device win needs GIL-free kernel parallelism across batcher "
+        "workers (or a real accelerator); parity gate enforced above"
+    )
+svc.close()
+svc_np.close()
+print("AGGS SMOKE OK")
+PY

@@ -7,7 +7,10 @@
 # suite; the re-run isolates the mesh-parallel serving path for quick
 # iteration). T1_LATENCY=1 additionally runs the continuous-batching
 # latency smoke (scripts/latency_smoke.sh: open-loop accepted-p50 and
-# closed-loop QPS gates for the pad-bucket launch ladder). The combined
+# closed-loop QPS gates for the pad-bucket launch ladder). T1_AGGS=1
+# additionally runs the device-aggregations smoke (scripts/aggs_smoke.sh:
+# exact host/device agg parity always; the >= 5x cold-agg throughput
+# gate engages on hosts with >= 8 cores). The combined
 # exit code fails if any enabled run fails.
 set -o pipefail; rm -f /tmp/_t1.log; timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' --continue-on-collection-errors -p no:cacheprovider -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log; rc=${PIPESTATUS[0]}; echo DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' /tmp/_t1.log | tr -cd . | wc -c)
 if [ "${T1_MESH:-0}" = "1" ]; then
@@ -24,5 +27,11 @@ if [ "${T1_LATENCY:-0}" = "1" ]; then
     bash scripts/latency_smoke.sh
     lat_rc=$?
     [ "$rc" -eq 0 ] && rc=$lat_rc
+fi
+if [ "${T1_AGGS:-0}" = "1" ]; then
+    echo "--- T1_AGGS: device-aggregations smoke (parity + cold-agg A/B) ---"
+    bash scripts/aggs_smoke.sh
+    aggs_rc=$?
+    [ "$rc" -eq 0 ] && rc=$aggs_rc
 fi
 exit $rc

@@ -1,0 +1,453 @@
+"""Device-side aggregations engine (search/aggs_device.py): float
+parity vs the host AggCollector oracle for every supported node type,
+the routing predicate (unsupported trees → host, exactness-unsafe
+columns → host), HBM degrade, generation-bump invalidation, the shard
+request cache regression (device-path miss → warm hit → tier-3
+cache_only serve), and mesh SPMD parity on the forced 8-device CPU
+platform."""
+
+import json
+import threading
+
+import numpy as np
+import pytest
+
+from elasticsearch_tpu.cluster.indices import IndexService
+from elasticsearch_tpu.search import aggs_device
+
+WORDS = ["alpha", "beta", "gamma", "delta", "epsilon"]
+CATS = ["red", "green", "blue", "black"]
+
+MAPPING = {
+    "properties": {
+        "body": {"type": "text"},
+        "cat": {"type": "keyword"},
+        "tags": {"type": "keyword"},
+        "price": {"type": "double"},
+        "qty": {"type": "integer"},
+        "flag": {"type": "boolean"},
+        "day": {"type": "date"},
+        "huge": {"type": "double"},
+    }
+}
+
+
+def _index_docs(svc, rng, n, start):
+    for i in range(start, start + n):
+        doc = {
+            "body": " ".join(
+                rng.choice(WORDS, size=int(rng.integers(1, 4)))
+            ),
+            "cat": str(rng.choice(CATS)),
+            "tags": [
+                str(t)
+                for t in rng.choice(
+                    CATS, size=int(rng.integers(0, 3)), replace=False
+                )
+            ],
+            "qty": int(rng.integers(0, 50)),
+            "flag": bool(rng.integers(0, 2)),
+        }
+        if rng.random() > 0.15:
+            doc["price"] = int(rng.integers(1, 500))
+        if rng.random() > 0.15:
+            # ~90 days of millis: overflows both float32 and a single
+            # int32 offset — the two-word/host-floor paths must be exact
+            doc["day"] = int(
+                1_700_000_000_000 + int(rng.integers(0, 90)) * 86_400_000
+            )
+        if rng.random() > 0.5:
+            # non-integer values outside the float32-exact window: any
+            # sum/min/max over this column must route to the host
+            doc["huge"] = float(rng.random() * 1e17 + 0.123456789)
+        svc.index_doc(str(i), doc)
+
+
+def make_pair(n_docs=240, n_shards=2, seed=3):
+    out = []
+    for backend in ("jax", "numpy"):
+        rng = np.random.default_rng(seed)
+        svc = IndexService(
+            f"da-{backend}-{n_shards}",
+            settings={
+                "number_of_shards": n_shards,
+                "search.backend": backend,
+            },
+            mappings_json=MAPPING,
+        )
+        # two refresh rounds → multiple segments per shard
+        _index_docs(svc, rng, n_docs // 2, 0)
+        svc.refresh()
+        _index_docs(svc, rng, n_docs - n_docs // 2, n_docs // 2)
+        svc.refresh()
+        out.append(svc)
+    return out
+
+
+@pytest.fixture(scope="module")
+def pair():
+    jx, np_ = make_pair()
+    yield jx, np_
+    jx.close()
+    np_.close()
+
+
+def _round_trip(body):
+    return json.loads(json.dumps(body))
+
+
+def _check_parity(jx, np_, body, expect_device=True):
+    before = aggs_device.stats_snapshot()
+    rj = jx.search(_round_trip(body))
+    rn = np_.search(_round_trip(body))
+    assert rj["aggregations"] == rn["aggregations"], body
+    assert rj["hits"]["total"] == rn["hits"]["total"]
+    assert rj["hits"]["max_score"] == rn["hits"]["max_score"]
+    assert [
+        (h["_id"], h["_score"]) for h in rj["hits"]["hits"]
+    ] == [(h["_id"], h["_score"]) for h in rn["hits"]["hits"]]
+    after = aggs_device.stats_snapshot()
+    if expect_device:
+        assert after["device_routed"] > before["device_routed"], body
+    return rj
+
+
+PARITY_BODIES = [
+    # every supported metric leaf at once, incl. sorted-quantile
+    # percentiles (f32-exact column → identical multiset → exact)
+    {"size": 0, "aggs": {
+        "s": {"stats": {"field": "price"}},
+        "a": {"avg": {"field": "qty"}},
+        "mn": {"min": {"field": "price"}},
+        "mx": {"max": {"field": "price"}},
+        "vc": {"value_count": {"field": "qty"}},
+        "p": {"percentiles": {"field": "price",
+                              "percents": [5, 50, 95]}},
+    }},
+    # keyword terms (multi-value ordinal CSR) with metric subs
+    {"size": 0, "query": {"match": {"body": "alpha"}},
+     "aggs": {"cats": {"terms": {"field": "cat"},
+                       "aggs": {"q": {"avg": {"field": "qty"}},
+                                "st": {"stats": {"field": "price"}}}}}},
+    {"size": 0, "aggs": {"tags": {"terms": {"field": "tags",
+                                            "size": 2}}}},
+    {"size": 0, "aggs": {"ka": {"terms": {"field": "cat",
+                                          "order": {"_key": "asc"}}}}},
+    # numeric + boolean terms (value ordinals)
+    {"size": 0, "aggs": {"nt": {"terms": {"field": "qty", "size": 5},
+                                "aggs": {"m": {"max": {"field": "price"}}}}}},
+    {"size": 0, "aggs": {"bt": {"terms": {"field": "flag"}}}},
+    # histogram / date_histogram (+ fixed-interval spellings)
+    {"size": 0, "aggs": {"qh": {"histogram": {"field": "qty",
+                                              "interval": 10},
+                                "aggs": {"m": {"sum": {"field": "qty"}}}}}},
+    {"size": 0, "aggs": {"dh": {"date_histogram": {
+        "field": "day", "fixed_interval": "7d"}}}},
+    {"size": 0, "aggs": {"dm": {"date_histogram": {
+        "field": "day", "calendar_interval": "day"}}}},
+    # range / date_range with unbounded edges + subs
+    {"size": 0, "aggs": {"pr": {"range": {
+        "field": "price",
+        "ranges": [{"to": 100}, {"from": 100, "to": 300},
+                   {"from": 300}]},
+        "aggs": {"q": {"sum": {"field": "qty"}}}}}},
+    {"size": 0, "aggs": {"dr": {"date_range": {
+        "field": "day",
+        "ranges": [{"to": "2023-12-15"}, {"from": "2023-12-15"}]}}}},
+    # filter / filters riding the bitset cache, with subs
+    {"size": 0, "aggs": {"f": {"filter": {"term": {"cat": "red"}},
+                               "aggs": {"q": {"avg": {"field": "qty"}}}}}},
+    {"size": 0, "aggs": {"fs": {"filters": {"filters": {
+        "r": {"term": {"cat": "red"}},
+        "hi": {"range": {"qty": {"gte": 25}}}}}}}},
+    # filtered query body (live ∧ filter bitset feeds the agg masks)
+    {"size": 0, "query": {"bool": {
+        "must": [{"match": {"body": "beta"}}],
+        "filter": [{"range": {"qty": {"gte": 10}}}]}},
+     "aggs": {"s": {"sum": {"field": "qty"}},
+              "cats": {"terms": {"field": "cat"}}}},
+    # hits + aggs together (size > 0)
+    {"size": 4, "query": {"match": {"body": "gamma delta"}},
+     "aggs": {"cats": {"terms": {"field": "cat"}}}},
+    # match_all (no query key)
+    {"size": 0, "aggs": {"s": {"stats": {"field": "qty"}}}},
+]
+
+
+class TestDeviceAggParity:
+    @pytest.mark.parametrize("body", PARITY_BODIES)
+    def test_parity(self, pair, body):
+        jx, np_ = pair
+        _check_parity(jx, np_, body)
+
+    def test_single_shard_parity(self):
+        jx, np_ = make_pair(n_docs=120, n_shards=1, seed=11)
+        try:
+            for body in PARITY_BODIES[:6]:
+                _check_parity(jx, np_, body)
+        finally:
+            jx.close()
+            np_.close()
+
+    def test_concurrent_agg_jobs_batch(self, pair):
+        """Identical-signature agg bodies ride one batcher group; every
+        response stays float-exact under concurrency."""
+        jx, np_ = pair
+        bodies = [
+            {"size": 0, "query": {"match": {"body": w}},
+             "aggs": {"cats": {"terms": {"field": "cat"}},
+                      "s": {"sum": {"field": "qty"}}}}
+            for w in WORDS * 3
+        ]
+        expected = [np_.search(_round_trip(b))["aggregations"]
+                    for b in bodies]
+        results = [None] * len(bodies)
+        errors = []
+
+        def run(i):
+            try:
+                results[i] = jx.search(_round_trip(bodies[i]))[
+                    "aggregations"
+                ]
+            except Exception as e:  # pragma: no cover
+                errors.append(e)
+
+        threads = [
+            threading.Thread(target=run, args=(i,))
+            for i in range(len(bodies))
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert not errors
+        assert results == expected
+        assert jx._batcher.stats["agg_jobs"] > 0
+
+
+class TestRouting:
+    def test_unsupported_type_routes_host(self, pair):
+        jx, np_ = pair
+        body = {"size": 0,
+                "aggs": {"c": {"cardinality": {"field": "cat"}}}}
+        before = aggs_device.stats_snapshot()
+        rj = jx.search(_round_trip(body))
+        rn = np_.search(_round_trip(body))
+        assert rj["aggregations"] == rn["aggregations"]
+        after = aggs_device.stats_snapshot()
+        assert after["device_routed"] == before["device_routed"]
+        assert after["host_routed"] > before["host_routed"]
+
+    def test_deep_nesting_routes_host(self, pair):
+        jx, np_ = pair
+        body = {"size": 0, "aggs": {"cats": {
+            "terms": {"field": "cat"},
+            "aggs": {"inner": {"terms": {"field": "tags"}}}}}}
+        _check_parity(jx, np_, body, expect_device=False)
+
+    def test_f32_unsafe_column_routes_host(self, pair, monkeypatch):
+        jx, np_ = pair
+        body = {"size": 0, "aggs": {"s": {"sum": {"field": "huge"}}}}
+        before = aggs_device.stats_snapshot()
+        rj = jx.search(_round_trip(body))
+        rn = np_.search(_round_trip(body))
+        assert rj["aggregations"] == rn["aggregations"]
+        assert (
+            aggs_device.stats_snapshot()["device_routed"]
+            == before["device_routed"]
+        )
+        # force mode surfaces the routing reason instead of host-running
+        # (request_cache off so the earlier answer can't serve the body)
+        monkeypatch.setenv("ES_TPU_DEVICE_AGGS", "force")
+        with pytest.raises(Exception) as ei:
+            jx.search({**_round_trip(body), "request_cache": False})
+        assert "float32" in str(ei.value)
+
+    def test_off_mode_host_routes_everything(self, pair, monkeypatch):
+        jx, np_ = pair
+        monkeypatch.setenv("ES_TPU_DEVICE_AGGS", "off")
+        body = {"size": 0, "aggs": {"s": {"stats": {"field": "qty"}}}}
+        before = aggs_device.stats_snapshot()
+        rj = jx.search(_round_trip(body))
+        rn = np_.search(_round_trip(body))
+        assert rj["aggregations"] == rn["aggregations"]
+        assert (
+            aggs_device.stats_snapshot()["device_routed"]
+            == before["device_routed"]
+        )
+
+    def test_hbm_degrade_falls_back_to_host(self, monkeypatch):
+        """A budget too tight for the agg columns degrades compilation
+        to the host collector — same answer, degraded counter bumped."""
+        from elasticsearch_tpu.common.memory import hbm_ledger
+
+        jx, np_ = make_pair(n_docs=80, n_shards=1, seed=21)
+        try:
+            body = {"size": 0,
+                    "aggs": {"dh": {"date_histogram": {
+                        "field": "day", "fixed_interval": "30d"}}}}
+            expected = np_.search(_round_trip(body))["aggregations"]
+            monkeypatch.setattr(hbm_ledger, "budget", hbm_ledger.used)
+            before = aggs_device.stats_snapshot()
+            degraded0 = hbm_ledger.stats()["degraded_allocations"]
+            rj = jx.search(_round_trip(body))
+            assert rj["aggregations"] == expected
+            after = aggs_device.stats_snapshot()
+            assert after["device_routed"] == before["device_routed"]
+            assert (
+                hbm_ledger.stats()["degraded_allocations"] > degraded0
+            )
+        finally:
+            jx.close()
+            np_.close()
+
+    def test_generation_bump_invalidates_and_releases(self):
+        from elasticsearch_tpu.common.memory import hbm_ledger
+
+        jx, np_ = make_pair(n_docs=60, n_shards=1, seed=31)
+        try:
+            base = hbm_ledger.stats()["by_category"].get("aggs", 0)
+            body = {"size": 0, "aggs": {
+                "qh": {"histogram": {"field": "qty", "interval": 5}},
+                "cats": {"terms": {"field": "cat"}}}}
+            _check_parity(jx, np_, body)
+            charged = hbm_ledger.stats()["by_category"].get("aggs", 0)
+            assert charged > base  # agg columns live on device
+            # a write + refresh bumps the change generation: the new
+            # executor recompiles against fresh columns, the old
+            # executor's agg charges are released on close
+            for svc in (jx, np_):
+                svc.index_doc("new-doc", {"qty": 7, "cat": "red",
+                                          "body": "alpha"})
+                svc.refresh()
+            _check_parity(jx, np_, body)
+        finally:
+            jx.close()
+            np_.close()
+        assert hbm_ledger.stats()["by_category"].get("aggs", 0) <= base
+
+
+class TestRequestCacheDevicePath:
+    def test_device_miss_then_warm_hit_then_tier3(self, pair):
+        """Satellite regression: device-collected agg responses must
+        populate the shard request cache (miss → warm hit) and be
+        servable by brownout tier-3 cache_only."""
+        from elasticsearch_tpu.search.admission import (
+            RequestCacheOnlyMiss,
+        )
+        from elasticsearch_tpu.search.query_cache import request_cache
+
+        jx, np_ = pair
+        body = {"size": 0,
+                "query": {"match": {"body": "epsilon"}},
+                "aggs": {"cats": {"terms": {"field": "cat"}},
+                         "s": {"stats": {"field": "qty"}}}}
+        before_dev = aggs_device.stats_snapshot()["device_routed"]
+        first = jx.search(_round_trip(body))
+        assert (
+            aggs_device.stats_snapshot()["device_routed"] > before_dev
+        )
+        hits0 = request_cache.node_stats()["hit_count"]
+        second = jx.search(_round_trip(body))
+        assert request_cache.node_stats()["hit_count"] > hits0
+        assert second["aggregations"] == first["aggregations"]
+        # tier-3 cache_only: the warmed shard bodies serve from cache…
+        # (the coordinator collapses paging to from:0/size:0 before the
+        # shard call, so the direct shard body must match that shape)
+        sub = {**_round_trip(body), "from": 0, "size": 0,
+               "_cache_only": True}
+        for sid in range(jx.num_shards):
+            served = jx.shard_search_local(sid, _round_trip(sub))
+            assert served["aggs"]
+        # …and an un-warmed body sheds instead of computing
+        cold = {
+            "size": 0,
+            "from": 0,
+            "query": {"match": {"body": "never-indexed-term-xyz"}},
+            "aggs": {"u": {"avg": {"field": "qty"}}},
+            "_cache_only": True,
+        }
+        with pytest.raises(RequestCacheOnlyMiss):
+            jx.shard_search_local(0, cold)
+
+
+@pytest.mark.mesh
+class TestMeshAggs:
+    def test_mesh_agg_parity(self, monkeypatch):
+        """Agg bodies execute as ONE SPMD launch (psum accumulators
+        across the shards axis) and match the per-shard path exactly."""
+        jx, np_ = make_pair(n_docs=160, n_shards=4, seed=41)
+        try:
+            bodies = [
+                {"size": 0, "aggs": {
+                    "s": {"stats": {"field": "qty"}},
+                    "cats": {"terms": {"field": "cat"}},
+                    "dh": {"date_histogram": {"field": "day",
+                                              "fixed_interval": "7d"}}}},
+                {"size": 0, "query": {"match": {"body": "alpha"}},
+                 "aggs": {"cats": {"terms": {"field": "cat"}},
+                          "m": {"max": {"field": "qty"}}}},
+                {"size": 0, "query": {"match_all": {}},
+                 "aggs": {"h": {"histogram": {"field": "qty",
+                                              "interval": 10}}}},
+            ]
+            monkeypatch.setenv("ES_TPU_MESH", "off")
+            base = [jx.search(_round_trip(b)) for b in bodies]
+            monkeypatch.setenv("ES_TPU_MESH", "force")
+            before = aggs_device.stats_snapshot()["mesh_routed"]
+            meshed = [jx.search(_round_trip(b)) for b in bodies]
+            for b0, b1 in zip(base, meshed):
+                assert b0["aggregations"] == b1["aggregations"]
+                assert b0["hits"]["total"] == b1["hits"]["total"]
+                assert b0["hits"]["max_score"] == b1["hits"]["max_score"]
+            assert (
+                aggs_device.stats_snapshot()["mesh_routed"]
+                >= before + len(bodies)
+            )
+            # a mesh-unsupported tree (filter agg) falls through to the
+            # per-shard device engine — still exact, never an error
+            fallback_body = {"size": 0, "aggs": {
+                "f": {"filter": {"term": {"cat": "red"}}}}}
+            r_mesh = jx.search(_round_trip(fallback_body))
+            monkeypatch.setenv("ES_TPU_MESH", "off")
+            r_off = jx.search(_round_trip(fallback_body))
+            assert r_mesh["aggregations"] == r_off["aggregations"]
+        finally:
+            jx.close()
+            np_.close()
+
+    def test_mesh_auto_keeps_request_cache_path(self, monkeypatch):
+        """In auto mesh mode, cacheable agg bodies stay on the shard
+        path (the request cache owns them); only cache-opted-out bodies
+        ride the mesh."""
+        jx, np_ = make_pair(n_docs=80, n_shards=4, seed=51)
+        try:
+            monkeypatch.setenv("ES_TPU_MESH", "auto")
+            body = {"size": 0,
+                    "aggs": {"s": {"stats": {"field": "qty"}}}}
+            before = aggs_device.stats_snapshot()["mesh_routed"]
+            jx.search(_round_trip(body))
+            assert (
+                aggs_device.stats_snapshot()["mesh_routed"] == before
+            )
+            opted_out = {**_round_trip(body), "request_cache": False}
+            r1 = jx.search(opted_out)
+            r2 = jx.search(_round_trip(body))
+            assert r1["aggregations"] == r2["aggregations"]
+            assert (
+                aggs_device.stats_snapshot()["mesh_routed"] > before
+            )
+        finally:
+            jx.close()
+            np_.close()
+
+
+class TestNodesStatsBlock:
+    def test_aggs_counters(self, pair):
+        jx, _ = pair
+        jx.search({"size": 0,
+                   "aggs": {"s": {"stats": {"field": "qty"}}}})
+        snap = aggs_device.stats_snapshot()
+        assert snap["device_routed"] > 0
+        assert snap["kernel_ms"] >= 0.0
+        assert "ledger_bytes" in snap

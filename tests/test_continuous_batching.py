@@ -404,6 +404,10 @@ class TestNoRecompileAfterWarmup:
             ]
             for body in warm_bodies:
                 svc.search(body)
+            # the warm loop runs on the worker AFTER each triggering
+            # request completes — quiesce before snapshotting the jit
+            # caches or the warm tail races the probe
+            assert svc._batcher.wait_warm_idle()
             sizes0 = _cache_sizes()
 
             rng = np.random.default_rng(23)
@@ -457,6 +461,7 @@ class TestNoRecompileAfterWarmup:
                 for t in ts:
                     t.join()
             assert not errs, errs
+            assert svc._batcher.wait_warm_idle()
             sizes1 = _cache_sizes()
             assert sizes1 == sizes0, (
                 "bucketed load recompiled after warmup: "

@@ -345,6 +345,57 @@ class TestBatcherFaults:
             faults.clear()
             svc.close()
 
+    def test_aggs_collect_fault_falls_back_to_host(self):
+        """The `aggs.collect` site fires inside the device-agg plan
+        dispatch: an injected error must exercise the device→host
+        AggCollector fallback deterministically — same answer, zero
+        shard failures, fallback counter bumped."""
+        from elasticsearch_tpu.search import aggs_device
+
+        jx = build_service("jax", "af-dev", shards=2)
+        nps = build_service("numpy", "af-np", shards=2)
+        try:
+            body = {
+                "size": 0,
+                "query": {"match": {"body": "alpha"}},
+                "aggs": {"ns": {"stats": {"field": "n"}}},
+                "request_cache": False,
+            }
+            expected = nps.search(dict(body))["aggregations"]
+            # deterministic schedule: shard 0's dispatch errors once;
+            # shard 1 stays on the device path
+            faults.configure(
+                {
+                    "rules": [
+                        {"site": "aggs.collect", "kind": "error",
+                         "match": {"shard": 0}, "times": 1}
+                    ]
+                }
+            )
+            before = aggs_device.stats_snapshot()
+            resp = jx.search(dict(body))
+            after = aggs_device.stats_snapshot()
+            assert resp["aggregations"] == expected
+            assert resp["_shards"]["failed"] == 0
+            assert after["fallbacks"] == before["fallbacks"] + 1
+            assert after["device_routed"] >= before["device_routed"] + 1
+            assert after["host_routed"] >= before["host_routed"] + 1
+            # delay kind: slow, not wrong — device path still serves
+            faults.configure(
+                {
+                    "rules": [
+                        {"site": "aggs.collect", "kind": "delay",
+                         "delay_ms": 30}
+                    ]
+                }
+            )
+            resp2 = jx.search(dict(body))
+            assert resp2["aggregations"] == expected
+        finally:
+            faults.clear()
+            jx.close()
+            nps.close()
+
     def test_knn_collect_fault_partial(self):
         svc = build_service("jax", "bf-knn", shards=2)
         try:
