@@ -2,7 +2,8 @@
 
 Prints ONE JSON line:
   {"metric": ..., "value": N, "unit": ..., "vs_baseline": N,
-   "configs": {match, bool, multi_match, knn, hybrid_rrf}, ...}
+   "configs": {match, bool, multi_match, knn (exact baseline),
+               ann_knn (IVF nprobe sweep + recall@10), hybrid_rrf}, ...}
 
 What is measured (BASELINE.md config table / VERDICT round-3 #4, #5):
   - the REST/executor serving path — IndexService.search() end to end:
@@ -913,6 +914,190 @@ def mesh_sweep(svc, svc_oracle, body_df):
     }
 
 
+# ---------------------------------------------------------------------------
+# ann_knn config: IVF probed search vs the exact brute-force baseline,
+# nprobe sweep with recall@10 reported next to QPS (ISSUE 9)
+# ---------------------------------------------------------------------------
+
+ANN_DOCS = int(os.environ.get("BENCH_ANN_DOCS", min(N_DOCS, 1_000_000)))
+ANN_CENTERS = int(os.environ.get("BENCH_ANN_CENTERS", 512))
+ANN_QUERIES = min(N_QUERIES_SECONDARY, 1024)
+
+
+def build_ann_services():
+    """(ivf service, exact service, query vectors) over a shared
+    clustered-vector segment (mixture of ANN_CENTERS Gaussian centers,
+    float16 rows like the main corpus)."""
+    from elasticsearch_tpu.cluster.indices import IndexService
+    from elasticsearch_tpu.index.segment import Segment, VectorField
+
+    rng = np.random.default_rng(SEED + 31)
+    log(f"[ann_knn] sampling {ANN_DOCS}x{DIMS} clustered vectors…")
+    centers = rng.normal(size=(ANN_CENTERS, DIMS)).astype(np.float32)
+    asg = rng.integers(0, ANN_CENTERS, size=ANN_DOCS)
+    vecs = centers[asg] + 0.5 * rng.normal(size=(ANN_DOCS, DIMS)).astype(
+        np.float32
+    )
+    vecs /= np.linalg.norm(vecs, axis=1, keepdims=True)
+    vecs16 = vecs.astype(np.float16)
+    exists = np.ones(ANN_DOCS, bool)
+    seg = Segment(
+        num_docs=ANN_DOCS,
+        doc_ids=[str(i) for i in range(ANN_DOCS)],
+        sources=[None] * ANN_DOCS,
+        postings={},
+        numerics={},
+        ordinals={},
+        vectors={
+            "vec": VectorField(
+                vectors=vecs16, exists=exists,
+                similarity="cosine", unit_vectors=vecs16,
+            )
+        },
+    )
+
+    def svc_of(name, extra):
+        svc = IndexService(
+            name,
+            settings={
+                "number_of_shards": 1, "search.backend": "jax", **extra,
+            },
+            mappings_json={
+                "properties": {
+                    "vec": {
+                        "type": "dense_vector", "dims": DIMS,
+                        "similarity": "cosine",
+                    }
+                }
+            },
+        )
+        eng = svc.shards[0]
+        eng.segments = [seg]
+        eng.live_docs = [None]
+        eng.seg_versions = [np.ones(ANN_DOCS, np.int64)]
+        eng.seg_seqnos = [np.arange(ANN_DOCS, dtype=np.int64)]
+        eng.seg_names = ["seg_0_0"]
+        eng._next_seq = ANN_DOCS
+        eng.change_generation += 1
+        return svc
+
+    nlist = int(
+        os.environ.get("BENCH_ANN_NLIST", max(64, int(np.sqrt(ANN_DOCS)) * 2))
+    )
+    svc_ivf = svc_of("bench-ann-ivf", {"knn.type": "ivf", "knn.nlist": nlist})
+    svc_exact = svc_of("bench-ann-exact", {})
+    # queries: perturbed corpus rows (the "find my neighbors" shape)
+    picks = rng.choice(ANN_DOCS, size=ANN_QUERIES, replace=False)
+    qv = vecs[picks] + 0.05 * rng.normal(size=(ANN_QUERIES, DIMS)).astype(
+        np.float32
+    )
+    qv /= np.linalg.norm(qv, axis=1, keepdims=True)
+    return svc_ivf, svc_exact, qv, nlist
+
+
+def run_ann_config(configs):
+    from elasticsearch_tpu.search import ann as ann_mod
+
+    svc_ivf, svc_exact, qv, nlist = build_ann_services()
+    try:
+        def knn_bodies(nprobe=None):
+            out = []
+            for v in qv:
+                sec = {
+                    "field": "vec",
+                    "query_vector": [float(x) for x in v],
+                    "k": K,
+                    "num_candidates": 100,
+                }
+                if nprobe is not None:
+                    sec["nprobe"] = nprobe
+                out.append({"knn": sec, "size": K, "_source": False})
+            return out
+
+        def recall_at_k(bodies_a, n=24):
+            recs = []
+            for ba in bodies_a[:n]:
+                be = {k: v for k, v in ba.items() if k != "knn"}
+                be["knn"] = {
+                    k: v for k, v in ba["knn"].items() if k != "nprobe"
+                }
+                a = {
+                    h["_id"]
+                    for h in svc_ivf.search(ba)["hits"]["hits"]
+                }
+                e = {
+                    h["_id"]
+                    for h in svc_exact.search(be)["hits"]["hits"]
+                }
+                recs.append(len(a & e) / max(1, len(e)))
+            return float(np.mean(recs))
+
+        log("[ann_knn] warmup/compile (k-means build + probe kernels)…")
+        tb = time.perf_counter()
+        for b in knn_bodies()[:4]:
+            svc_ivf.search(b)
+        for b in knn_bodies()[:4]:
+            svc_exact.search(b)
+        log(f"[ann_knn] warm ({time.perf_counter()-tb:.1f}s)")
+        exact_qps, exact_p50, _, _ = run_load(svc_exact, knn_bodies())
+        log(f"[ann_knn] exact baseline: {exact_qps:.1f} QPS "
+            f"p50={exact_p50:.2f}ms")
+        sweep = {}
+        for nprobe in (4, 8, 16, 32):
+            bl = knn_bodies(nprobe)
+            svc_ivf.search(bl[0])
+            stats0 = ann_mod.stats_snapshot()
+            qps, p50, p99, _ = run_load(svc_ivf, bl)
+            rec = recall_at_k(bl)
+            stats1 = ann_mod.stats_snapshot()
+            sweep[str(nprobe)] = {
+                "qps": round(qps, 1),
+                "p50_ms": round(p50, 2),
+                "p99_ms": round(p99, 2),
+                "recall_at_10": round(rec, 4),
+                "speedup_vs_exact": (
+                    round(qps / exact_qps, 2) if exact_qps else None
+                ),
+                "clusters_scanned": (
+                    stats1["clusters_scanned"] - stats0["clusters_scanned"]
+                ),
+                "clusters_total": (
+                    stats1["clusters_total"] - stats0["clusters_total"]
+                ),
+            }
+            log(
+                f"[ann_knn] nprobe={nprobe}: {qps:.1f} QPS "
+                f"p50={p50:.2f}ms recall@10={rec:.4f} "
+                f"({sweep[str(nprobe)]['speedup_vs_exact']}x exact)"
+            )
+        # headline: the default-nprobe row (index setting default 8)
+        head = sweep["8"]
+        snap = ann_mod.stats_snapshot()
+        return {
+            "kind": "ivf",
+            "n_docs": ANN_DOCS,
+            "nlist": nlist,
+            "qps": head["qps"],
+            "p50_ms": head["p50_ms"],
+            "p99_ms": head["p99_ms"],
+            "recall_at_10": head["recall_at_10"],
+            "speedup_vs_exact": head["speedup_vs_exact"],
+            "exact_baseline_qps": round(exact_qps, 1),
+            "exact_baseline_p50_ms": round(exact_p50, 2),
+            "nprobe_sweep": sweep,
+            "ann_stats": {
+                k: snap[k]
+                for k in (
+                    "builds", "build_ms", "ledger_bytes",
+                    "exact_fallbacks", "small_segment_exact",
+                )
+            },
+        }
+    finally:
+        svc_ivf.close()
+        svc_exact.close()
+
+
 def main():
     t0 = time.perf_counter()
     # closed-loop sections measure RAW serving capacity: the admission
@@ -1187,6 +1372,18 @@ def main():
         f"device={agg_dev_qps:.1f} QPS ({agg_speedup:.2f}x, "
         f"parity_exact={agg_parity_exact})"
     )
+
+    # ---- ann_knn: the IVF ANN tier vs the exact brute-force baseline
+    # (the `knn` config above IS the exact baseline — kept forever as
+    # the float oracle). Its OWN clustered-vector corpus: real embedding
+    # spaces are clustered, which is both the regime where IVF's
+    # locality assumption holds and the honest shape for a recall
+    # number (uniform random vectors are ANN's degenerate worst case).
+    # Sweeps nprobe and reports recall@10 vs the exact path NEXT TO the
+    # QPS it buys; the hard gates live in scripts/ann_smoke.sh. ----
+    configs["knn"]["kind"] = "exact_brute_force"
+    ann_block = run_ann_config(configs)
+    configs["ann_knn"] = ann_block
 
     # single-thread oracle (GIL-free per-core honesty number)
     o1_qps, _, _, _ = run_load(svc_np, bodies["match"][:24], threads=1)

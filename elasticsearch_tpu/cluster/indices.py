@@ -998,6 +998,13 @@ class IndexService:
                 dsl.parse_knn(kb)
                 for kb in (knn_body if isinstance(knn_body, list) else [knn_body])
             ]
+            if str(self.settings.get("search.backend")) == "jax":
+                # IVF ANN routing (index.knn.type, ?exact=true escape
+                # hatch, per-section nprobe): the numpy oracle backend
+                # never routes — it IS the exact reference
+                from ..search import ann as ann_mod
+
+                ann_mod.annotate(knn, self.settings, body)
         aggs_body = body.get("aggs") or body.get("aggregations")
         agg_nodes = None
         if aggs_body is not None:
@@ -1920,6 +1927,9 @@ class IndexService:
                 dsl.parse_knn(kb)
                 for kb in (knn_body if isinstance(knn_body, list) else [knn_body])
             ]
+            from ..search import ann as ann_mod
+
+            ann_mod.annotate(knn, self.settings, body)
             plan = extract_knn_plan(knn, self.mappings)
             kind = "mesh_knn"
         if plan is None:
@@ -2609,6 +2619,9 @@ class IndexService:
                 sec = dsl.parse_knn(params)
             except (dsl.QueryParseError, KeyError, TypeError, ValueError):
                 return None  # malformed → sync path raises the real error
+            from ..search import ann as ann_mod
+
+            ann_mod.annotate([sec], self.settings, None)
             plan = extract_knn_plan([sec], self.mappings)
             if plan is None:
                 return None

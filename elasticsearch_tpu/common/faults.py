@@ -29,6 +29,10 @@ Schedule shape (env `ES_TPU_FAULTS`, or `POST /_internal/faults`):
   - ``aggs.collect``        (device-aggregation plan dispatch — ctx
     carries index/shard; an injected error here exercises the
     device→host AggCollector fallback deterministically)
+  - ``ann.probe``           (IVF ANN probe path, per segment — ctx
+    carries field/segment; error kind proves the deterministic
+    IVF→exact brute-force fallback, delay kind the slow-not-wrong
+    contract)
 * ``match``: exact-equality filters over the ctx kwargs the site passes
   (string-compared, so {"shard": 1} matches shard=1).
 * ``kind``: ``error`` (raise InjectedFault, 500-shaped), ``drop``

@@ -308,6 +308,16 @@ def _positive_f(name):
     return check
 
 
+def _one_of(name, allowed):
+    def check(v):
+        if v not in allowed:
+            raise SettingsError(
+                f"[{name}] must be one of {'|'.join(allowed)}, got [{v}]"
+            )
+
+    return check
+
+
 # ---- index-scoped registry (IndexScopedSettings.BUILT_IN_INDEX_SETTINGS) ----
 
 INDEX_SETTINGS: Dict[str, Setting] = {
@@ -327,6 +337,19 @@ INDEX_SETTINGS: Dict[str, Setting] = {
         Setting("merge.policy.max_segments", 8, INDEX_SCOPE, parser=int,
                 validator=_positive("merge.policy.max_segments")),
         Setting("knn.quantization", "none", INDEX_SCOPE),
+        # IVF ANN tier (ops/ivf.py, search/ann.py): "exact" keeps every
+        # knn request on the brute-force oracle; "ivf" clusters each
+        # segment's vectors at executor build and probes top-nprobe
+        # clusters at query time (per-request `nprobe` override and the
+        # ?exact=true escape hatch always available)
+        Setting("knn.type", "exact", INDEX_SCOPE,
+                validator=_one_of("knn.type", ("exact", "ivf"))),
+        # cluster count per segment (0 = auto ~sqrt(N))
+        Setting("knn.nlist", 0, INDEX_SCOPE, parser=int,
+                validator=_non_negative("knn.nlist")),
+        # default probe width (per-request knn.nprobe overrides)
+        Setting("knn.nprobe", 8, INDEX_SCOPE, parser=int,
+                validator=_positive("knn.nprobe")),
         # shard request cache default for size:0/agg-only requests
         # (IndicesRequestCache's index.requests.cache.enable); the
         # per-request ?request_cache= param overrides it either way

@@ -59,6 +59,7 @@ from ..ops import scoring
 from .mesh import DATA_AXIS, SHARD_AXIS, fold_factor, make_mesh
 from .sharded import (
     build_mesh_agg_step,
+    build_mesh_ann_step,
     build_mesh_knn_step,
     build_mesh_text_step,
 )
@@ -421,6 +422,150 @@ class MeshExecutor:
             }
             snap.knn[field] = view
             return view
+
+    def _ann_view(self, snap: _MeshSnapshot, field: str, spec) -> dict:
+        """Stacked IVF view: per-entry centroids (replicated scan),
+        cluster-major permuted blocks + CSR bounds (clusters stay
+        sharded with their entries). Reuses each entry's OWNING
+        executor's IvfSegmentIndex, so the mesh path probes the exact
+        same centroids/permutation as the per-shard path — parity by
+        construction. Any entry without an index (small-segment floor,
+        HBM degrade) raises MeshUnavailable and the per-shard
+        coordinator serves the request with its own exact floor."""
+        key = ("ann", field, spec)
+        view = snap.knn.get(key)
+        if view is not None:
+            return view
+        with self._lock:
+            view = snap.knn.get(key)
+            if view is not None:
+                return view
+            idxs = []
+            for sid, si in snap.entries:
+                idx = snap.executors[sid].ann_index(si, field, spec)
+                if idx is None:
+                    raise MeshUnavailable(
+                        f"entry [{sid}][{si}] has no IVF index for "
+                        f"[{field}] (exact floor / HBM degrade)"
+                    )
+                idxs.append(idx)
+            dims = idxs[0].dims
+            similarity = idxs[0].similarity
+            for idx in idxs:
+                if idx.dims != dims or idx.similarity != similarity:
+                    raise MeshUnavailable(
+                        f"vector field [{field}] has mixed dims/similarity"
+                    )
+            quant = bool(spec.quantized) and all(
+                i.host_qvecs_flat is not None for i in idxs
+            )
+            e_pad = snap.e_pad
+            nlist_max = max(i.nlist for i in idxs)
+            fmax = max(i.host_perm.shape[0] for i in idxs)
+            cmax = max(i.cmax for i in idxs)
+            cents = np.zeros((e_pad, nlist_max, dims), np.float32)
+            cvalid = np.zeros((e_pad, nlist_max), bool)
+            starts = np.zeros((e_pad, nlist_max), np.int32)
+            counts = np.zeros((e_pad, nlist_max), np.int32)
+            perm = np.zeros((e_pad, fmax), np.int32)
+            if quant:
+                vecs = np.zeros((e_pad, fmax, dims), np.int8)
+                scales = np.zeros((e_pad, fmax), np.float32)
+            else:
+                vdt = np.result_type(
+                    *[i.host_vecs_flat.dtype for i in idxs]
+                )
+                vecs = np.zeros((e_pad, fmax, dims), vdt)
+                scales = None
+            v2 = (
+                np.zeros((e_pad, fmax), np.float32)
+                if similarity == "l2_norm"
+                else None
+            )
+            cand = np.zeros((e_pad, fmax), bool)
+            n_per_entry = np.zeros(e_pad, np.int64)
+            live_host = np.asarray(jax.device_get(snap.live))
+            for e, ((sid, si), idx) in enumerate(zip(snap.entries, idxs)):
+                vf = snap.readers[sid].segments[si].vectors[field]
+                n = snap.readers[sid].segments[si].num_docs
+                nl = idx.nlist
+                F = idx.host_perm.shape[0]
+                cents[e, :nl] = idx.host_centroids
+                cvalid[e, :nl] = True
+                starts[e, :nl] = idx.host_starts
+                counts[e, :nl] = idx.host_counts
+                perm[e, :F] = idx.host_perm
+                if quant:
+                    vecs[e, :F] = idx.host_qvecs_flat
+                    scales[e, :F] = idx.host_scales_flat
+                else:
+                    vecs[e, :F] = idx.host_vecs_flat
+                if v2 is not None:
+                    hv = idx.host_vecs_flat.astype(np.float32)
+                    v2[e, :F] = (hv * hv).sum(axis=1)
+                base = vf.exists & live_host[e, :n]
+                # candidate mask permuted into flat slot order (pad
+                # slots stay False; the rank<count test masks them too)
+                cand[e, : idx.n] = base[idx.host_perm[: idx.n]]
+                n_per_entry[e] = n
+            nbytes = (
+                cents.nbytes + cvalid.nbytes + starts.nbytes
+                + counts.nbytes + perm.nbytes + vecs.nbytes
+                + cand.nbytes
+                + (scales.nbytes if scales is not None else 0)
+                + (v2.nbytes if v2 is not None else 0)
+            )
+            snap.charge(nbytes)
+            sh3 = NamedSharding(snap.mesh, P(SHARD_AXIS, None, None))
+            sh2 = NamedSharding(snap.mesh, P(SHARD_AXIS, None))
+            view = {
+                "centroids": jax.device_put(cents, sh3),
+                "cvalid": jax.device_put(cvalid, sh2),
+                "starts": jax.device_put(starts, sh2),
+                "counts": jax.device_put(counts, sh2),
+                "perm": jax.device_put(perm, sh2),
+                "vecs": jax.device_put(vecs, sh3),
+                "scales": (
+                    jax.device_put(scales, sh2) if scales is not None
+                    else None
+                ),
+                "v2": jax.device_put(v2, sh2) if v2 is not None else None,
+                "cand": jax.device_put(cand, sh2),
+                "dims": dims,
+                "similarity": similarity,
+                "cmax": cmax,
+                "nlists": [i.nlist for i in idxs],
+                "n_per_entry": n_per_entry,
+            }
+            snap.knn[key] = view
+            return view
+
+    def _ann_step(self, snap, field, spec, kc):
+        key = ("ann_step", field, spec, kc)
+        step = snap.steps.get(key)
+        if step is None:
+            with self._lock:
+                step = snap.steps.get(key)
+                if step is None:
+                    view = self._ann_view(snap, field, spec)
+                    step = build_mesh_ann_step(
+                        snap.mesh,
+                        view["centroids"],
+                        view["cvalid"],
+                        view["starts"],
+                        view["counts"],
+                        view["perm"],
+                        view["vecs"],
+                        view["scales"],
+                        view["v2"],
+                        view["cand"],
+                        view["similarity"],
+                        spec.nprobe,
+                        kc,
+                        view["cmax"],
+                    )
+                    snap.steps[key] = step
+        return step
 
     # ---- stacked aggregation views (lazy, per snapshot) ----
 
@@ -832,7 +977,18 @@ class MeshExecutor:
             # post-selection multiply — same host-merge rule as the
             # sequential collect
             raise MeshUnavailable("non-positive knn boost")
-        view = self._knn_view(snap, field)
+        spec = jobs[0].plan.ann  # shared: ann rides the group key
+        if spec is not None:
+            # IVF tier on the mesh: the `ann.probe` fault site fires
+            # here too (ctx mesh=1) — an injected error surfaces to
+            # _mesh_search, which degrades to the per-shard path (its
+            # own ann.probe checks then prove the exact fallback)
+            from ..common.faults import faults as _faults
+
+            _faults.check("ann.probe", field=field, mesh=1)
+            view = self._ann_view(snap, field, spec)
+        else:
+            view = self._knn_view(snap, field)
         dims = view["dims"]
         n_max = snap.n_docs_max
         rows = self._rows_for(snap, len(jobs))
@@ -849,6 +1005,25 @@ class MeshExecutor:
                     nc[e, ji] = min(j.plan.num_candidates, n)
             max_nc = max(max_nc, min(j.plan.num_candidates, n_max))
         kc = min(max(scoring.next_bucket(max_nc, 16), 16), n_max)
+        if spec is not None:
+            from ..ops import ivf
+            from ..search import ann as ann_mod
+
+            step = self._ann_step(snap, field, spec, kc)
+            with _LAUNCH_LOCK:
+                out = step(q, nc)
+            with self._lock:
+                self.stats["launches"] += 1
+                self.stats["jobs"] += len(jobs)
+            flops = sum(
+                ivf.ann_flops(
+                    len(jobs), nl, spec.nprobe, view["cmax"], dims
+                )
+                for nl in view["nlists"]
+            )
+            for nl in view["nlists"]:
+                ann_mod.note_search(spec.nprobe, nl, jobs=len(jobs))
+            return {"snap": snap, "out": out, "flops": flops, "rows": rows}
         step = self._knn_step(snap, field, kc)
         with _LAUNCH_LOCK:
             out = step(q, nc)

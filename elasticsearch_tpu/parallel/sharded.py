@@ -664,6 +664,167 @@ def build_mesh_knn_step(
     return step
 
 
+def build_mesh_ann_step(
+    mesh: Mesh,
+    centroids: jax.Array,  # f32 [E, nlist_max, d] (zero-padded entries)
+    cvalid: jax.Array,  # bool [E, nlist_max] real clusters
+    starts: jax.Array,  # i32 [E, nlist_max]
+    counts: jax.Array,  # i32 [E, nlist_max]
+    perm: jax.Array,  # i32 [E, Fmax] flat cluster-major slot → doc
+    vecs: jax.Array,  # [E, Fmax, d] permuted block (f32/f16, or int8)
+    scales: Optional[jax.Array],  # f32 [E, Fmax] int8 twin, or None
+    v2: Optional[jax.Array],  # f32 [E, Fmax] (l2 only), or None
+    cand: jax.Array,  # bool [E, Fmax] exists ∧ live in FLAT slot order
+    similarity: str,
+    nprobe: int,
+    kc: int,
+    cmax: int,
+):
+    """One SPMD IVF-probed kNN step: the centroid scan runs replicated
+    per entry (each device scans only its own entries' centroids — tiny
+    matmuls), cluster gathers stay device-local (clusters are sharded
+    with their entries), and the merge is the SAME all_gather + per-
+    (job, entry) num_candidates rank cut as build_mesh_knn_step, so the
+    collector (MeshExecutor.collect_knn) is shared verbatim.
+
+    fn(queries[B, d], nc[E, B]) →
+        (scores[B, slots], entry[B, slots], doc[B, slots], counts[B, E])
+    """
+    from ..ops.ivf import QCHUNK, _similarity_transform
+
+    kk = min(kc, nprobe * cmax)
+    off = jnp.arange(cmax, dtype=jnp.int32)
+    has_scales = scales is not None
+    has_v2 = v2 is not None
+
+    def body(cent_b, cv_b, st_b, ct_b, pm_b, vx_b, cd_b, queries, nc_b,
+             *extra):
+        ei = iter(extra)
+        sc_b = next(ei) if has_scales else None
+        v2_b = next(ei) if has_v2 else None
+        q = queries
+        if similarity == "cosine":
+            qn = jnp.linalg.norm(q, axis=1, keepdims=True)
+            q = q / jnp.where(qn == 0, 1.0, qn)
+
+        def entry(args):
+            cent_e, cv_e, st_e, ct_e, pm_e, vx_e, cd_e = args[:7]
+            rest = args[7:]
+            sc_e = rest[0] if has_scales else None
+            v2_e = rest[-1] if has_v2 else None
+            cdots = q @ cent_e.T  # [Bd, nlist_max]
+            if similarity == "l2_norm":
+                c2 = jnp.sum(cent_e * cent_e, axis=1)[None, :]
+                csel = -(c2 - 2.0 * cdots)
+            else:
+                csel = cdots
+            csel = jnp.where(cv_e[None, :], csel, -jnp.inf)
+            p = min(nprobe, int(cent_e.shape[0]))
+            _, cls = jax.lax.top_k(csel, p)  # [Bd, p]
+            P_ = p * cmax
+
+            def chunk(args):
+                qc, clsc = args  # [C, d], [C, p]
+                slot = (
+                    jnp.take(st_e, clsc)[:, :, None] + off[None, None, :]
+                ).reshape(qc.shape[0], P_)
+                ok = (
+                    off[None, None, :] < jnp.take(ct_e, clsc)[:, :, None]
+                ).reshape(qc.shape[0], P_)
+                docs = jnp.take(pm_e, slot)
+                vv = jnp.take(vx_e, slot, axis=0).astype(jnp.float32)
+                dots = jnp.einsum("cd,cpd->cp", qc, vv)
+                if sc_e is not None:
+                    dots = dots * jnp.take(sc_e, slot)
+                if similarity == "l2_norm":
+                    s = _similarity_transform(
+                        dots, similarity, q=qc, v2=jnp.take(v2_e, slot)
+                    )
+                else:
+                    s = _similarity_transform(dots, similarity)
+                mask = ok & jnp.take(cd_e, slot)
+                masked = jnp.where(mask, s.astype(jnp.float32), -jnp.inf)
+                sk, ik = jax.lax.top_k(masked, min(kk, P_))
+                dk = jnp.take_along_axis(docs, ik, axis=1)
+                return sk, jnp.where(jnp.isfinite(sk), dk, 0)
+
+            B = q.shape[0]
+            C = min(QCHUNK, B)
+            if B % C == 0 and B > C:
+                sk, dk = jax.lax.map(
+                    chunk,
+                    (q.reshape(B // C, C, -1), cls.reshape(B // C, C, -1)),
+                )
+                sk = sk.reshape(B, -1)
+                dk = dk.reshape(B, -1)
+            else:
+                sk, dk = chunk((q, cls))
+            if sk.shape[1] < kk:  # P_ < kk: pad to the shared width
+                padw = kk - sk.shape[1]
+                sk = jnp.pad(sk, ((0, 0), (0, padw)),
+                             constant_values=-jnp.inf)
+                dk = jnp.pad(dk, ((0, 0), (0, padw)))
+            return sk, dk
+
+        ins = [cent_b, cv_b, st_b, ct_b, pm_b, vx_b, cd_b]
+        if has_scales:
+            ins.append(sc_b)
+        if has_v2:
+            ins.append(v2_b)
+        s, d = jax.vmap(entry)(tuple(ins))  # [F, Bd, kk] ×2
+        gs = jax.lax.all_gather(s, SHARD_AXIS)  # [G, F, Bd, kk]
+        gd = jax.lax.all_gather(d, SHARD_AXIS)
+        gn = jax.lax.all_gather(nc_b, SHARD_AXIS)  # [G, F, Bd]
+        G, F, Bd, _ = gs.shape
+        slots = G * F * kk
+        gs2 = jnp.transpose(gs, (2, 0, 1, 3)).reshape(Bd, slots)
+        gd2 = jnp.transpose(gd, (2, 0, 1, 3)).reshape(Bd, slots)
+        nc2 = jnp.transpose(gn, (2, 0, 1)).reshape(Bd, G * F)
+        entry_of_slot = jnp.arange(slots, dtype=jnp.int32) // kk
+        rank_of_slot = jnp.arange(slots, dtype=jnp.int32) % kk
+        nc_slot = jnp.take(nc2, entry_of_slot, axis=1)
+        valid = jnp.isfinite(gs2) & (rank_of_slot[None, :] < nc_slot)
+        masked = jnp.where(valid, gs2, -jnp.inf)
+        ms, mi = jax.lax.top_k(masked, slots)
+        me = entry_of_slot[mi]
+        md = jnp.take_along_axis(gd2, mi, axis=1)
+        cnt = valid.reshape(Bd, G * F, kk).sum(axis=2, dtype=jnp.int32)
+        return ms, me, md, cnt
+
+    sh2 = P(SHARD_AXIS, None)
+    sh3 = P(SHARD_AXIS, None, None)
+    in_specs = [sh3, sh2, sh2, sh2, sh2, sh3, sh2,
+                P(DATA_AXIS, None), P(SHARD_AXIS, DATA_AXIS)]
+    extras = []
+    if has_scales:
+        extras.append(scales)
+        in_specs.append(sh2)
+    if has_v2:
+        extras.append(v2)
+        in_specs.append(sh2)
+    fn = shard_map(
+        body,
+        mesh=mesh,
+        in_specs=tuple(in_specs),
+        out_specs=(
+            P(DATA_AXIS, None),
+            P(DATA_AXIS, None),
+            P(DATA_AXIS, None),
+            P(DATA_AXIS, None),
+        ),
+        check_vma=False,
+    )
+
+    @jax.jit
+    def step(queries, nc):
+        return fn(
+            centroids, cvalid, starts, counts, perm, vecs, cand,
+            queries, nc, *extras,
+        )
+
+    return step
+
+
 def build_mesh_agg_step(
     mesh: Mesh,
     live: jax.Array,  # bool[E, Nmax] (live docs ∧ in-range padding mask)

@@ -676,6 +676,12 @@ class RestActions:
             for idx in self.cluster.indices.values()
             if getattr(idx, "_batcher", None) is not None
         )
+        # IVF ANN tier counters (search/ann.py): probe counts, clusters
+        # scanned vs total, exact-fallback/escape-hatch routings, index
+        # build wall time, and the `ann` HBM ledger bytes
+        from ..search.ann import stats_snapshot as ann_stats
+
+        knn_block = {"ann": ann_stats()}
         return 200, {
             "cluster_name": self.cluster.cluster_name,
             "nodes": {
@@ -711,6 +717,7 @@ class RestActions:
                     },
                     "pipeline": pipeline,
                     "aggs": aggs_block,
+                    "knn": knn_block,
                     # overload-protection block (search/admission.py):
                     # per-tenant queue depths, the adaptive concurrency
                     # limit, pressure tier, shed/brownout/retry-budget
@@ -1223,6 +1230,11 @@ class RestActions:
             )
         if "timeout" in qs:
             body["timeout"] = qs["timeout"][0]
+        if "exact" in qs:
+            # ANN escape hatch: ?exact=true routes every knn section of
+            # this request to the brute-force float oracle even on an
+            # index.knn.type=ivf index (rides the body to the shards)
+            body["exact"] = qs["exact"][0] not in ("false", "0")
         if "allow_degraded" in qs:
             # brownout opt-out: pins the request to full-fidelity
             # execution (it can still be shed outright under overload)

@@ -167,13 +167,17 @@ class ServePlan:
 @dataclass(frozen=True)
 class KnnPlan:
     """A bare top-level knn section (no filter/threshold): batched
-    brute-force matmul per segment (BASELINE config 4)."""
+    brute-force matmul per segment (BASELINE config 4), or — when `ann`
+    carries a resolved search/ann.AnnSpec — the IVF probed path over
+    the same launch/merge plumbing. `ann` rides the group key, so exact
+    and probed jobs (or different probe widths) never share a launch."""
 
     field: str
     vector: Tuple[float, ...]
     k: int
     num_candidates: int
     boost: float
+    ann: Optional[object] = None
 
 
 def _clause_terms(q, mappings, analysis) -> Optional[Tuple[str, List[str], float]]:
@@ -377,6 +381,7 @@ def extract_knn_plan(knn_sections, mappings) -> Optional[KnnPlan]:
         k=int(sec.k),
         num_candidates=int(sec.num_candidates),
         boost=float(sec.boost),
+        ann=getattr(sec, "ann", None),
     )
 
 
@@ -798,7 +803,7 @@ class QueryBatcher:
                         j.plan.combine, j.plan.tie, kb,
                     )
                 elif j.kind == "mesh_knn":
-                    key = (id(j.executor), "Mk", j.plan.field, kb)
+                    key = (id(j.executor), "Mk", j.plan.field, j.plan.ann, kb)
                 elif j.kind == "agg":
                     # device-aggregations family: jobs group by the
                     # compiled plan's structural signature so identical
@@ -806,8 +811,9 @@ class QueryBatcher:
                     key = (id(j.executor), "a", j.plan.sig, kb)
                 elif j.kind == "mesh_agg":
                     key = (id(j.executor), "Ma", j.plan.sig, kb)
-                else:  # knn
-                    key = (id(j.executor), "k", j.plan.field, kb)
+                else:  # knn (exact and IVF-probed jobs never share;
+                    # kb stays LAST — dispatch reads it as key[-1])
+                    key = (id(j.executor), "k", j.plan.field, j.plan.ann, kb)
                 groups.setdefault(key, []).append(j)
             ordered = sorted(
                 groups.items(), key=lambda kv: kv[0][1] == "m"
@@ -1546,15 +1552,29 @@ class QueryBatcher:
         rows = rows or BPAD
         staging = getattr(ex, "staging_slab", None)
         field = jobs[0].plan.field
+        spec = jobs[0].plan.ann  # shared: ann rides the group key
         items: List[Tuple] = []
         for si, seg in enumerate(reader.segments):
-            dv = ex.device_segments[si].vectors.get(field)
-            if dv is None:
+            if seg.vectors.get(field) is None:
                 continue
-            vectors, exists = dv
             vf = seg.vectors[field]
-            dims = int(vectors.shape[1])
             n = seg.num_docs
+            # IVF tier: probe-path failures (the `ann.probe` fault
+            # site, HBM degrade) fall back DETERMINISTICALLY to the
+            # exact brute-force launch below; segments under the
+            # small-segment floor never build an index and stay exact
+            idx = None
+            if spec is not None and getattr(ex, "ann_index", None):
+                from . import ann as ann_mod
+
+                try:
+                    if record:
+                        faults.check("ann.probe", field=field, segment=si)
+                    idx = ex.ann_index(si, field, spec)
+                except BaseException:
+                    ann_mod.note("exact_fallbacks")
+                    idx = None
+            dims = int(vf.vectors.shape[1])
             if staging is not None:
                 q = staging("knn_q", (rows, dims), np.float32)
                 valid = staging("knn_valid", (rows,), np.bool_)
@@ -1575,6 +1595,33 @@ class QueryBatcher:
                 max(n, 1),
             )
             live = reader.live_docs[si]
+            if idx is not None:
+                from ..ops import ivf
+
+                cand = None
+                if live is not None or not bool(vf.exists.all()):
+                    cand = vf.exists
+                    if live is not None:
+                        cand = cand & np.asarray(live)
+                s, d = ivf.ann_topk_batch(
+                    idx, np.asarray(q), np.asarray(valid), cand,
+                    spec.nprobe, kc, quantized=spec.quantized,
+                )
+                if record:
+                    from . import ann as ann_mod
+
+                    ann_mod.note_search(spec.nprobe, idx.nlist, jobs=nj)
+                    with self._lock:
+                        self.stats["launches"] += 1
+                        self.stats["fused_jobs"] += nj
+                    self._add_flops(
+                        ivf.ann_flops(
+                            nj, idx.nlist, spec.nprobe, idx.cmax, dims
+                        )
+                    )
+                items.append((si, n, s, d))
+                continue
+            vectors, exists = ex.device_segments[si].vectors[field]
             cand_mask = exists
             if live is not None:
                 cand_mask = cand_mask & np.asarray(live)

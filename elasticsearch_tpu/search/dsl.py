@@ -295,6 +295,10 @@ class KnnSection:
     filter: Optional[Query] = None
     boost: float = 1.0
     similarity: Optional[float] = None  # min-similarity cutoff
+    nprobe: Optional[int] = None  # per-request IVF probe override
+    # resolved search/ann.AnnSpec (set by IndexService when the index
+    # routes this section through the IVF tier; None = exact path)
+    ann: Optional[object] = None
 
 
 _SINGLE_KEY_ERR = "[%s] query malformed, no start_object after query name"
@@ -461,15 +465,47 @@ class KnnQueryWrapper(Query):
 def parse_knn(params: dict) -> KnnSection:
     if "field" not in params or "query_vector" not in params:
         raise QueryParseError("[knn] requires [field] and [query_vector]")
-    k = int(params.get("k", 10))
+    try:
+        k = int(params.get("k", 10))
+    except (TypeError, ValueError):
+        raise QueryParseError(f"[knn] failed to parse [k]: {params.get('k')!r}")
+    if k < 1:
+        raise QueryParseError(f"[knn] [k] must be greater than 0, got [{k}]")
+    try:
+        num_candidates = int(params.get("num_candidates", max(100, k)))
+    except (TypeError, ValueError):
+        raise QueryParseError(
+            "[knn] failed to parse [num_candidates]: "
+            f"{params.get('num_candidates')!r}"
+        )
+    if num_candidates < k:
+        # request-scoped 400 (KnnSearchBuilder's "[num_candidates] cannot
+        # be less than [k]"), not a server-side error downstream
+        raise QueryParseError(
+            f"[knn] [num_candidates] cannot be less than [k]; got "
+            f"num_candidates=[{num_candidates}], k=[{k}]"
+        )
+    nprobe = params.get("nprobe")
+    if nprobe is not None:
+        try:
+            nprobe = int(nprobe)
+        except (TypeError, ValueError):
+            raise QueryParseError(
+                f"[knn] failed to parse [nprobe]: {params.get('nprobe')!r}"
+            )
+        if nprobe < 1:
+            raise QueryParseError(
+                f"[knn] [nprobe] must be greater than 0, got [{nprobe}]"
+            )
     return KnnSection(
         field=params["field"],
         query_vector=[float(x) for x in params["query_vector"]],
         k=k,
-        num_candidates=int(params.get("num_candidates", max(100, k))),
+        num_candidates=num_candidates,
         filter=parse_query(params["filter"]) if params.get("filter") else None,
         boost=float(params.get("boost", 1.0)),
         similarity=params.get("similarity"),
+        nprobe=nprobe,
     )
 
 

@@ -209,6 +209,13 @@ class JaxExecutor:
         # bump — exactly the invalidation the agg plans need)
         self._agg_profiles: Dict[Tuple[int, str], object] = {}
         self._agg_cols: Dict[tuple, object] = {}
+        # IVF ANN tier (ops/ivf.py, search/ann.py): per-(segment, field,
+        # build-shape) cluster indexes, built lazily per executor
+        # generation — the same invalidation as the agg tables — and
+        # charged to the `ann` HbmLedger category. None caches a miss
+        # (small segment / budget degrade) so the exact path is chosen
+        # without re-locking per batch.
+        self._ann_indexes: Dict[tuple, object] = {}
         self._seg_weights: Dict[Tuple[int, str], np.ndarray] = {}
         self._df_maps: Dict[str, Dict[str, int]] = {}
         self._shard_dfs: Dict[Tuple[str, str], int] = {}
@@ -1741,28 +1748,119 @@ class JaxExecutor:
             total = score_mat.sum(axis=0)
         return mask, jnp.where(mask, total, 0.0)
 
+    # ---- IVF ANN tier (ops/ivf.py): per-segment cluster indexes ----
+
+    def ann_index(self, si: int, field: str, spec):
+        """Cached IvfSegmentIndex for one segment's vector column under
+        one build shape (spec.nlist / spec.quantized), or None when the
+        segment stays exact: below the small-segment floor, no vectors,
+        or the HBM ledger can't fit the build (degrade, never trip).
+        Built once per executor generation — a refresh/merge that
+        touches the shard regenerates the executor, which re-clusters
+        exactly like the agg tables re-profile."""
+        seg = self.reader.segments[si]
+        n = seg.num_docs
+        key = (
+            si, field, int(spec.nlist), bool(spec.quantized),
+            int(spec.min_docs),
+        )
+        if key in self._ann_indexes:
+            return self._ann_indexes[key]
+        with self._build_lock:
+            if key in self._ann_indexes:
+                return self._ann_indexes[key]
+            from ..common.memory import hbm_ledger
+            from ..ops import ivf
+            from . import ann as ann_mod
+
+            idx = None
+            vf = seg.vectors.get(field)
+            if vf is not None and n >= max(spec.min_docs, 2):
+                mat = (
+                    vf.unit_vectors
+                    if vf.similarity == "cosine"
+                    and vf.unit_vectors is not None
+                    else vf.vectors
+                )
+                nlist = spec.nlist or ivf.auto_nlist(n)
+                nlist = max(1, min(nlist, n))
+                est = ivf.IvfSegmentIndex.estimate_nbytes(
+                    n, int(mat.shape[1]), nlist, spec.quantized,
+                    itemsize=mat.dtype.itemsize,
+                )
+                if not hbm_ledger.would_fit(est):
+                    hbm_ledger.note_degraded()
+                else:
+                    # deterministic seed: a pure function of the build
+                    # shape, so re-runs (and the k-means determinism
+                    # test) reproduce the same centroids bit-for-bit
+                    seed = (si * 2654435761 + n * 97 + nlist) & 0x7FFFFFFF
+                    idx = ivf.IvfSegmentIndex(
+                        mat,
+                        vf.similarity,
+                        nlist,
+                        seed,
+                        quantized=spec.quantized,
+                    )
+                    self._charge("ann", idx.nbytes, False)
+                    ann_mod.note_build(idx.build_ms)
+            elif vf is not None and n:
+                ann_mod.note("small_segment_exact")
+            self._ann_indexes[key] = idx
+            return idx
+
     # ---- knn (device matmul + global top-k cut) ----
 
     def _knn_topk_global(self, sec: KnnSection) -> List[Tuple[jax.Array, jax.Array]]:
+        from ..common.faults import faults
+        from . import ann as ann_mod
+
+        spec = getattr(sec, "ann", None)
         per_seg = []
         for si, seg in enumerate(self.reader.segments):
             n = seg.num_docs
-            dv = self.device_segments[si].vectors.get(sec.field)
-            if dv is None:
+            if seg.vectors.get(sec.field) is None:
                 per_seg.append(
                     (jnp.zeros(n, bool), jnp.zeros(n, jnp.float32), None)
                 )
                 continue
-            vectors, exists = dv
             vf = seg.vectors[sec.field]
             q = jnp.asarray(np.asarray(sec.query_vector, np.float32))[None, :]
-            cand_mask = exists
+            cand_mask = jnp.asarray(vf.exists)
             if sec.filter is not None:
                 cand_mask = cand_mask & self.filter_mask(sec.filter, si)
             live = self.reader.live_docs[si]
             if live is not None:
                 cand_mask = cand_mask & jnp.asarray(live)
             k = min(sec.num_candidates, n)
+            idx = None
+            if spec is not None:
+                # probe-path failures (the `ann.probe` fault site, HBM
+                # degrade) fall back DETERMINISTICALLY to the exact
+                # brute-force oracle below — slow/approximate is
+                # acceptable, a failed request is not
+                try:
+                    faults.check("ann.probe", field=sec.field, segment=si)
+                    idx = self.ann_index(si, sec.field, spec)
+                except BaseException:
+                    ann_mod.note("exact_fallbacks")
+                    idx = None
+            if idx is not None:
+                from ..ops import ivf
+
+                top_s, top_d = ivf.ann_topk_batch(
+                    idx,
+                    np.asarray(sec.query_vector, np.float32)[None, :],
+                    np.ones(1, bool),
+                    cand_mask,
+                    spec.nprobe,
+                    k,
+                    quantized=spec.quantized,
+                )
+                ann_mod.note_search(spec.nprobe, idx.nlist)
+                per_seg.append((cand_mask, top_s[0], top_d[0]))
+                continue
+            vectors, _exists = self.device_segments[si].vectors[sec.field]
             top_s, top_d = scoring.knn_topk(q, vectors, cand_mask, vf.similarity, k)
             per_seg.append((cand_mask, top_s[0], top_d[0]))
         # global k cut across segments

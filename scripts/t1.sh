@@ -10,8 +10,11 @@
 # closed-loop QPS gates for the pad-bucket launch ladder). T1_AGGS=1
 # additionally runs the device-aggregations smoke (scripts/aggs_smoke.sh:
 # exact host/device agg parity always; the >= 5x cold-agg throughput
-# gate engages on hosts with >= 8 cores). The combined
-# exit code fails if any enabled run fails.
+# gate engages on hosts with >= 8 cores). T1_ANN=1 additionally runs
+# the IVF ANN smoke (scripts/ann_smoke.sh: recall >= 0.95@k=10 vs the
+# exact oracle + bit-for-bit ?exact=true/floor gates always; the >= 5x
+# device-kernel gate always; the >= 5x end-to-end QPS gate on >= 8-core
+# hosts). The combined exit code fails if any enabled run fails.
 set -o pipefail; rm -f /tmp/_t1.log; timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' --continue-on-collection-errors -p no:cacheprovider -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log; rc=${PIPESTATUS[0]}; echo DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' /tmp/_t1.log | tr -cd . | wc -c)
 if [ "${T1_MESH:-0}" = "1" ]; then
     echo "--- T1_MESH: mesh-marked tests on the forced 8-device host platform ---"
@@ -33,5 +36,11 @@ if [ "${T1_AGGS:-0}" = "1" ]; then
     bash scripts/aggs_smoke.sh
     aggs_rc=$?
     [ "$rc" -eq 0 ] && rc=$aggs_rc
+fi
+if [ "${T1_ANN:-0}" = "1" ]; then
+    echo "--- T1_ANN: IVF ANN smoke (recall + exact-oracle + speedup gates) ---"
+    bash scripts/ann_smoke.sh
+    ann_rc=$?
+    [ "$rc" -eq 0 ] && rc=$ann_rc
 fi
 exit $rc

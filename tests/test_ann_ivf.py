@@ -1,0 +1,525 @@
+"""Device-side IVF ANN tier (ISSUE 9): clustered vector index with
+probed search, recall gates, and the exact brute-force path as oracle.
+
+The recall machinery: every gate compares the probed path against the
+exact path on a SEEDED clustered corpus (mixture of Gaussian centers —
+the shape real embedding spaces have, and the regime where IVF's
+cluster-locality assumption is meaningful). Configurations covered:
+single/multi-segment, filtered (live ∧ filter bitset), quantized-int8,
+per-request nprobe/?exact=true controls, the small-segment exact floor,
+k-means build determinism, and (under the forced 8-device CPU platform)
+the mesh SPMD probe path's bit-exact agreement with the per-shard path.
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+from elasticsearch_tpu.cluster.indices import IndexService
+from elasticsearch_tpu.search import ann as ann_mod
+from elasticsearch_tpu.search import dsl
+
+DIMS = 32
+N_CENTERS = 24
+K = 10
+
+
+@pytest.fixture(autouse=True)
+def _ann_floor():
+    """Test corpora are small; lower the small-segment exact floor so
+    the IVF tier actually engages (individual tests raise it back to
+    prove the floor)."""
+    old = os.environ.get(ann_mod.ANN_MIN_DOCS_ENV)
+    os.environ[ann_mod.ANN_MIN_DOCS_ENV] = "64"
+    yield
+    if old is None:
+        os.environ.pop(ann_mod.ANN_MIN_DOCS_ENV, None)
+    else:
+        os.environ[ann_mod.ANN_MIN_DOCS_ENV] = old
+
+
+def clustered_vectors(n, seed, noise=0.5):
+    """Unit vectors drawn around N_CENTERS shared centers: clustered
+    enough that IVF recall is meaningful, spread enough (noise) that
+    int8 quantization can't reorder the top-k wholesale."""
+    rng = np.random.default_rng(seed)
+    centers = np.random.default_rng(99).normal(size=(N_CENTERS, DIMS))
+    asg = rng.integers(0, N_CENTERS, size=n)
+    v = centers[asg] + noise * rng.normal(size=(n, DIMS))
+    return (v / np.linalg.norm(v, axis=1, keepdims=True)).astype(np.float32)
+
+
+WORDS = ["alpha", "beta", "gamma", "delta"]
+
+
+def make_service(name, backend="jax", shards=1, extra=None):
+    settings = {"number_of_shards": shards, "search.backend": backend}
+    settings.update(extra or {})
+    return IndexService(
+        name,
+        settings=settings,
+        mappings_json={
+            "properties": {
+                "body": {"type": "text"},
+                "vec": {
+                    "type": "dense_vector",
+                    "dims": DIMS,
+                    "similarity": "cosine",
+                },
+            }
+        },
+    )
+
+
+def fill(svcs, vecs, batches=1):
+    """Indexes the same docs into every service; batches > 1 refreshes
+    between slices so each shard holds multiple segments."""
+    n = len(vecs)
+    per = -(-n // batches)
+    for b in range(batches):
+        for i in range(b * per, min((b + 1) * per, n)):
+            doc = {
+                "body": WORDS[i % 4],
+                "vec": [float(x) for x in vecs[i]],
+            }
+            for svc in svcs:
+                svc.index_doc(str(i), dict(doc))
+        for svc in svcs:
+            svc.refresh()
+
+
+def queries(vecs, n_q, seed=11, noise=0.05):
+    rng = np.random.default_rng(seed)
+    picks = rng.choice(len(vecs), size=n_q, replace=False)
+    q = vecs[picks] + noise * rng.normal(size=(n_q, DIMS))
+    return (q / np.linalg.norm(q, axis=1, keepdims=True)).astype(np.float32)
+
+
+def knn_body(qv, k=K, nc=200, **extra):
+    sec = {
+        "field": "vec",
+        "query_vector": [float(x) for x in qv],
+        "k": k,
+        "num_candidates": nc,
+    }
+    sec.update(extra)
+    return {"knn": sec, "size": k}
+
+
+def hit_pairs(resp):
+    return [(h["_id"], h["_score"]) for h in resp["hits"]["hits"]]
+
+
+def mean_recall(svc, oracle, qs, k=K, **extra):
+    recs = []
+    for qv in qs:
+        a = {h["_id"] for h in svc.search(knn_body(qv, k=k, **extra))["hits"]["hits"]}
+        e = {h["_id"] for h in oracle.search(knn_body(qv, k=k))["hits"]["hits"]}
+        recs.append(len(a & e) / max(1, len(e)))
+    return float(np.mean(recs))
+
+
+IVF = {"knn.type": "ivf", "knn.nlist": 24, "knn.nprobe": 8}
+
+
+class TestRecallGates:
+    def test_single_segment_recall(self):
+        vecs = clustered_vectors(1500, seed=1)
+        svc = make_service("ivf-s1", extra=IVF)
+        ora = make_service("ivf-s1-np", backend="numpy")
+        try:
+            fill([svc, ora], vecs)
+            before = ann_mod.stats_snapshot()
+            rec = mean_recall(svc, ora, queries(vecs, 16))
+            after = ann_mod.stats_snapshot()
+            assert rec >= 0.95
+            # the probes actually ran (not a silent exact routing)
+            assert after["ann_searches"] > before["ann_searches"]
+            assert after["builds"] >= before["builds"] + 1
+            assert after["ledger_bytes"] > 0
+            assert after["clusters_scanned"] > before["clusters_scanned"]
+            assert after["clusters_total"] > before["clusters_total"]
+        finally:
+            svc.close()
+            ora.close()
+
+    def test_multi_segment_multi_shard_recall(self):
+        vecs = clustered_vectors(1600, seed=2)
+        svc = make_service("ivf-ms", shards=2, extra=IVF)
+        ora = make_service("ivf-ms-np", shards=2, backend="numpy")
+        try:
+            fill([svc, ora], vecs, batches=3)  # 3 segments per shard
+            rec = mean_recall(svc, ora, queries(vecs, 12))
+            assert rec >= 0.95
+        finally:
+            svc.close()
+            ora.close()
+
+    def test_filtered_and_deleted_recall(self):
+        """live ∧ filter bitset: the probed path must honor the same
+        candidate mask as the exact path — every hit satisfies the
+        filter and survives deletes, at oracle-level recall."""
+        vecs = clustered_vectors(1500, seed=3)
+        svc = make_service("ivf-f", extra=IVF)
+        ora = make_service("ivf-f-np", backend="numpy")
+        try:
+            fill([svc, ora], vecs)
+            for i in range(0, 1500, 7):  # delete every 7th doc
+                svc.delete_doc(str(i))
+                ora.delete_doc(str(i))
+            svc.refresh()
+            ora.refresh()
+            filt = {"term": {"body": "alpha"}}
+            recs = []
+            for qv in queries(vecs, 10, seed=13):
+                body = knn_body(qv, nc=300, filter=filt)
+                a = svc.search(dict(body))["hits"]["hits"]
+                e = ora.search(dict(body))["hits"]["hits"]
+                # exactness of the mask: hits are alpha docs (i%4==0)
+                # that were not deleted (i%7!=0)
+                for h in a:
+                    i = int(h["_id"])
+                    assert i % 4 == 0 and i % 7 != 0
+                recs.append(
+                    len({h["_id"] for h in a} & {h["_id"] for h in e})
+                    / max(1, len(e))
+                )
+            assert float(np.mean(recs)) >= 0.95
+        finally:
+            svc.close()
+            ora.close()
+
+    def test_quantized_int8_recall(self):
+        vecs = clustered_vectors(1500, seed=4)
+        svc = make_service(
+            "ivf-q8", extra={**IVF, "knn.quantization": "int8"}
+        )
+        ora = make_service("ivf-q8-np", backend="numpy")
+        try:
+            fill([svc, ora], vecs)
+            rec = mean_recall(svc, ora, queries(vecs, 16, seed=17))
+            assert rec >= 0.95
+        finally:
+            svc.close()
+            ora.close()
+
+
+class TestExactOracleControls:
+    def test_exact_escape_hatch_bit_for_bit(self):
+        """?exact=true on an ivf index reproduces the exact brute-force
+        path BIT-FOR-BIT (same ids, same float scores), and matches the
+        numpy oracle's ids."""
+        vecs = clustered_vectors(1200, seed=5)
+        svc = make_service("ivf-esc", extra=IVF)
+        exact = make_service("ivf-esc-exact")  # knn.type defaults exact
+        ora = make_service("ivf-esc-np", backend="numpy")
+        try:
+            fill([svc, exact, ora], vecs)
+            before = ann_mod.stats_snapshot()
+            for qv in queries(vecs, 6, seed=19):
+                body = knn_body(qv)
+                a = hit_pairs(svc.search({**body, "exact": True}))
+                b = hit_pairs(exact.search(dict(body)))
+                assert a == b  # bit-for-bit: scores AND order
+                o = [h["_id"] for h in ora.search(dict(body))["hits"]["hits"]]
+                assert [i for i, _ in a] == o
+            after = ann_mod.stats_snapshot()
+            assert after["exact_searches"] >= before["exact_searches"] + 6
+        finally:
+            svc.close()
+            exact.close()
+            ora.close()
+
+    def test_small_segment_floor_stays_exact(self):
+        """Segments below ES_TPU_ANN_MIN_DOCS never build an index —
+        an ivf index over a tiny corpus is bit-for-bit the exact path,
+        so correctness never depends on cluster quality."""
+        os.environ[ann_mod.ANN_MIN_DOCS_ENV] = "100000"
+        vecs = clustered_vectors(600, seed=6)
+        svc = make_service("ivf-floor", extra=IVF)
+        exact = make_service("ivf-floor-exact")
+        try:
+            fill([svc, exact], vecs)
+            before = ann_mod.stats_snapshot()
+            for qv in queries(vecs, 4, seed=23):
+                body = knn_body(qv)
+                assert hit_pairs(svc.search(dict(body))) == hit_pairs(
+                    exact.search(dict(body))
+                )
+            after = ann_mod.stats_snapshot()
+            assert after["ann_searches"] == before["ann_searches"]
+            assert (
+                after["small_segment_exact"] > before["small_segment_exact"]
+            )
+        finally:
+            svc.close()
+            exact.close()
+
+    def test_per_request_nprobe_override(self):
+        """nprobe == nlist scans every cluster — recall 1.0 vs the
+        exact path by construction; nprobe=1 still returns k hits."""
+        vecs = clustered_vectors(1200, seed=7)
+        svc = make_service("ivf-np", extra=IVF)
+        exact = make_service("ivf-np-exact")
+        try:
+            fill([svc, exact], vecs)
+            qs = queries(vecs, 6, seed=29)
+            full = mean_recall(svc, exact, qs, nprobe=24)
+            assert full == 1.0
+            for qv in qs[:3]:
+                r = svc.search(knn_body(qv, nprobe=1))
+                assert len(r["hits"]["hits"]) == K
+        finally:
+            svc.close()
+            exact.close()
+
+
+class TestBuildMachinery:
+    def test_kmeans_build_deterministic(self):
+        """The same segment clustered twice (fresh executors) produces
+        bit-identical centroids, permutation, and search results."""
+        from elasticsearch_tpu.ops import ivf
+
+        vecs = clustered_vectors(800, seed=8)
+        c1, a1 = ivf.kmeans(vecs, 16, seed=42)
+        c2, a2 = ivf.kmeans(vecs, 16, seed=42)
+        assert np.array_equal(c1, c2) and np.array_equal(a1, a2)
+        i1 = ivf.IvfSegmentIndex(vecs, "cosine", 16, seed=42)
+        i2 = ivf.IvfSegmentIndex(vecs, "cosine", 16, seed=42)
+        assert np.array_equal(
+            np.asarray(i1.centroids), np.asarray(i2.centroids)
+        )
+        assert np.array_equal(np.asarray(i1.perm), np.asarray(i2.perm))
+
+    def test_rebuild_on_refresh_and_ledger_release(self):
+        """A refresh regenerates the executor; the IVF index rebuilds
+        for the new generation and close() releases the `ann` ledger
+        bytes."""
+        from elasticsearch_tpu.common.memory import hbm_ledger
+
+        vecs = clustered_vectors(900, seed=9)
+        svc = make_service("ivf-gen", extra=IVF)
+        try:
+            fill([svc], vecs)
+            qv = queries(vecs, 1, seed=31)[0]
+            svc.search(knn_body(qv))
+            builds0 = ann_mod.stats_snapshot()["builds"]
+            ann_bytes = hbm_ledger.stats()["by_category"].get("ann", 0)
+            assert ann_bytes > 0
+            svc.index_doc("extra", {
+                "body": "alpha", "vec": [float(x) for x in vecs[0]],
+            })
+            svc.refresh()
+            svc.search(knn_body(qv))
+            assert ann_mod.stats_snapshot()["builds"] > builds0
+        finally:
+            svc.close()
+        assert hbm_ledger.stats()["by_category"].get("ann", 0) == 0
+
+    def test_hbm_budget_degrades_to_exact(self, monkeypatch):
+        """An index build that would not fit the HBM ledger degrades to
+        the exact path instead of tripping the breaker."""
+        from elasticsearch_tpu.common import memory
+        from elasticsearch_tpu.ops import ivf
+
+        vecs = clustered_vectors(700, seed=10)
+        svc = make_service("ivf-hbm", extra=IVF)
+        exact = make_service("ivf-hbm-exact")
+        try:
+            fill([svc, exact], vecs)
+            # an absurd build estimate makes ONLY the IVF build fail
+            # the ledger precheck (the exact path's uploads still fit)
+            monkeypatch.setattr(
+                ivf.IvfSegmentIndex, "estimate_nbytes",
+                staticmethod(lambda *a, **k: 1 << 60),
+            )
+            degraded0 = memory.hbm_ledger.stats()["degraded_allocations"]
+            qv = queries(vecs, 1, seed=37)[0]
+            assert hit_pairs(svc.search(knn_body(qv))) == hit_pairs(
+                exact.search(knn_body(qv))
+            )
+            assert (
+                memory.hbm_ledger.stats()["degraded_allocations"]
+                > degraded0
+            )
+        finally:
+            svc.close()
+            exact.close()
+
+
+class TestValidation:
+    def test_num_candidates_lt_k_is_400(self):
+        with pytest.raises(dsl.QueryParseError, match="num_candidates"):
+            dsl.parse_knn({
+                "field": "vec", "query_vector": [0.0] * DIMS,
+                "k": 10, "num_candidates": 5,
+            })
+
+    def test_k_and_nprobe_bounds_are_400(self):
+        with pytest.raises(dsl.QueryParseError, match=r"\[k\]"):
+            dsl.parse_knn({
+                "field": "vec", "query_vector": [0.0] * DIMS, "k": 0,
+            })
+        with pytest.raises(dsl.QueryParseError, match="nprobe"):
+            dsl.parse_knn({
+                "field": "vec", "query_vector": [0.0] * DIMS,
+                "k": 2, "num_candidates": 10, "nprobe": 0,
+            })
+        with pytest.raises(dsl.QueryParseError, match="num_candidates"):
+            dsl.parse_knn({
+                "field": "vec", "query_vector": [0.0] * DIMS,
+                "k": 2, "num_candidates": "nan",
+            })
+
+    def test_service_surfaces_parse_error_not_500(self):
+        """Through the full service path the malformed section raises
+        the request-scoped QueryParseError (rest/server.py maps it to a
+        400 x_content_parse_exception) instead of a downstream
+        server-side failure."""
+        from elasticsearch_tpu.cluster.service import ClusterService
+
+        c = ClusterService()
+        try:
+            c.create_index("v400", {
+                "mappings": {"properties": {"vec": {
+                    "type": "dense_vector", "dims": 4,
+                }}},
+            })
+            idx = c.indices["v400"]
+            idx.index_doc("a", {"vec": [0.1, 0.2, 0.3, 0.4]})
+            idx.refresh()
+            with pytest.raises(dsl.QueryParseError):
+                idx.search({"knn": {
+                    "field": "vec", "query_vector": [0.1] * 4,
+                    "k": 10, "num_candidates": 3,
+                }})
+        finally:
+            c.close()
+
+    def test_k_above_num_docs_clamps_not_500(self):
+        """k / num_candidates above the corpus size clamp (no
+        server-side error) on both the exact and the ivf path."""
+        vecs = clustered_vectors(200, seed=12)
+        svc = make_service("ivf-clamp", extra=IVF)
+        exact = make_service("ivf-clamp-exact")
+        try:
+            fill([svc, exact], vecs)
+            qv = queries(vecs, 1, seed=41)[0]
+            # exact: the clamp returns every doc
+            r = exact.search(knn_body(qv, k=500, nc=5000))
+            assert len(r["hits"]["hits"]) == 200
+            assert r["hits"]["total"]["value"] == 200
+            # ivf at partial nprobe: no error, hits bounded by the
+            # scanned clusters; a full scan (nprobe=nlist) returns all
+            r = svc.search(knn_body(qv, k=500, nc=5000))
+            assert 0 < len(r["hits"]["hits"]) <= 200
+            r = svc.search(knn_body(qv, k=500, nc=5000, nprobe=24))
+            assert len(r["hits"]["hits"]) == 200
+        finally:
+            svc.close()
+            exact.close()
+
+
+class TestObservability:
+    def test_nodes_stats_knn_ann_block(self):
+        from elasticsearch_tpu.cluster.service import ClusterService
+        from elasticsearch_tpu.rest.actions import RestActions
+
+        c = ClusterService()
+        try:
+            c.create_index("annstats", {
+                "settings": {
+                    "search.backend": "jax", "knn.type": "ivf",
+                    "knn.nlist": 8,
+                },
+                "mappings": {"properties": {"vec": {
+                    "type": "dense_vector", "dims": DIMS,
+                    "similarity": "cosine",
+                }}},
+            })
+            idx = c.indices["annstats"]
+            vecs = clustered_vectors(300, seed=14)
+            for i, v in enumerate(vecs):
+                idx.index_doc(str(i), {"vec": [float(x) for x in v]})
+            idx.refresh()
+            idx.search(knn_body(vecs[0]))
+            actions = RestActions(c)
+            _, resp = actions.nodes_stats(None, {}, {})
+            blk = resp["nodes"]["node-0"]["knn"]["ann"]
+            assert set(blk) >= {
+                "ann_searches", "exact_searches", "small_segment_exact",
+                "exact_fallbacks", "probes", "clusters_scanned",
+                "clusters_total", "builds", "build_ms", "ledger_bytes",
+            }
+            assert blk["ann_searches"] >= 1
+            assert blk["builds"] >= 1
+            assert blk["ledger_bytes"] > 0
+        finally:
+            c.close()
+
+    def test_ivf_index_setting_validation(self):
+        from elasticsearch_tpu.common.settings import (
+            SettingsError,
+            validate_index_settings,
+        )
+
+        out = validate_index_settings(
+            {"knn.type": "ivf", "knn.nlist": 64, "knn.nprobe": 4},
+            creating=True,
+        )
+        assert out["knn.type"] == "ivf"
+        with pytest.raises(SettingsError):
+            validate_index_settings({"knn.type": "hnsw"}, creating=True)
+        with pytest.raises(SettingsError):
+            validate_index_settings({"knn.nprobe": 0}, creating=True)
+
+
+@pytest.mark.mesh
+class TestMeshAnn:
+    def test_mesh_ann_bit_exact_vs_per_shard(self):
+        """The SPMD probe path (centroid scan per entry, clusters
+        sharded, all_gather + k-way merge) agrees BIT-FOR-BIT with the
+        per-shard ANN path: both probe the same per-segment indexes."""
+        old = os.environ.get("ES_TPU_MESH")
+        vecs = clustered_vectors(1200, seed=15)
+        svc = make_service(
+            "ivf-mesh", shards=4,
+            extra={"knn.type": "ivf", "knn.nlist": 8, "knn.nprobe": 4},
+        )
+        try:
+            fill([svc], vecs)
+            qs = queries(vecs, 6, seed=43)
+            os.environ["ES_TPU_MESH"] = "force"
+            mesh_hits = [hit_pairs(svc.search(knn_body(q))) for q in qs]
+            assert svc.mesh_executor().stats["routed"] >= 1
+            os.environ["ES_TPU_MESH"] = "off"
+            shard_hits = [hit_pairs(svc.search(knn_body(q))) for q in qs]
+            assert mesh_hits == shard_hits
+        finally:
+            if old is None:
+                os.environ.pop("ES_TPU_MESH", None)
+            else:
+                os.environ["ES_TPU_MESH"] = old
+            svc.close()
+
+    def test_mesh_ann_recall_gate(self):
+        old = os.environ.get("ES_TPU_MESH")
+        vecs = clustered_vectors(1200, seed=16)
+        svc = make_service(
+            "ivf-mesh-r", shards=4,
+            extra={"knn.type": "ivf", "knn.nlist": 8, "knn.nprobe": 4},
+        )
+        ora = make_service("ivf-mesh-np", shards=4, backend="numpy")
+        try:
+            fill([svc, ora], vecs)
+            os.environ["ES_TPU_MESH"] = "force"
+            rec = mean_recall(svc, ora, queries(vecs, 10, seed=47))
+            assert rec >= 0.95
+        finally:
+            if old is None:
+                os.environ.pop("ES_TPU_MESH", None)
+            else:
+                os.environ["ES_TPU_MESH"] = old
+            svc.close()
+            ora.close()

@@ -420,6 +420,87 @@ class TestBatcherFaults:
             faults.clear()
             svc.close()
 
+    def test_ann_probe_fault_falls_back_to_exact(self):
+        """The `ann.probe` site fires on the IVF probe path: an injected
+        error must exercise the deterministic IVF→exact brute-force
+        fallback — bit-for-bit the exact path's answer, zero shard
+        failures, fallback counter bumped (mirrors the `aggs.collect`
+        device→host pattern)."""
+        import numpy as np
+
+        from elasticsearch_tpu.search import ann as ann_mod
+
+        def build_ivf(name, extra):
+            svc = IndexService(
+                name,
+                settings={
+                    "number_of_shards": 2, "search.backend": "jax",
+                    **extra,
+                },
+                mappings_json={"properties": {"vec": {
+                    "type": "dense_vector", "dims": 8,
+                    "similarity": "cosine",
+                }}},
+            )
+            rng = np.random.default_rng(7)
+            for i in range(400):
+                v = rng.normal(size=8)
+                v /= np.linalg.norm(v)
+                svc.index_doc(str(i), {"vec": [float(x) for x in v]})
+            svc.refresh()
+            return svc
+
+        old = os.environ.get(ann_mod.ANN_MIN_DOCS_ENV)
+        os.environ[ann_mod.ANN_MIN_DOCS_ENV] = "32"
+        ivf_svc = build_ivf(
+            "af-ann", {"knn.type": "ivf", "knn.nlist": 8, "knn.nprobe": 2}
+        )
+        exact_svc = build_ivf("af-ann-exact", {})
+        try:
+            rng = np.random.default_rng(9)
+            qv = rng.normal(size=8)
+            qv /= np.linalg.norm(qv)
+            body = {"knn": {
+                "field": "vec", "query_vector": [float(x) for x in qv],
+                "k": 5, "num_candidates": 50,
+            }, "size": 5}
+            expected = [
+                (h["_id"], h["_score"])
+                for h in exact_svc.search(dict(body))["hits"]["hits"]
+            ]
+            # error kind on EVERY probe: the whole request serves exact
+            faults.configure(
+                {"rules": [{"site": "ann.probe", "kind": "error"}]}
+            )
+            before = ann_mod.stats_snapshot()
+            resp = ivf_svc.search(dict(body))
+            after = ann_mod.stats_snapshot()
+            got = [
+                (h["_id"], h["_score"]) for h in resp["hits"]["hits"]
+            ]
+            assert got == expected
+            assert resp["_shards"]["failed"] == 0
+            assert after["exact_fallbacks"] > before["exact_fallbacks"]
+            # delay kind: slow, not wrong — the probed path still serves
+            faults.configure(
+                {"rules": [{"site": "ann.probe", "kind": "delay",
+                            "delay_ms": 30}]}
+            )
+            before = ann_mod.stats_snapshot()
+            resp2 = ivf_svc.search(dict(body))
+            after = ann_mod.stats_snapshot()
+            assert len(resp2["hits"]["hits"]) == 5
+            assert resp2["_shards"]["failed"] == 0
+            assert after["ann_searches"] > before["ann_searches"]
+        finally:
+            faults.clear()
+            if old is None:
+                os.environ.pop(ann_mod.ANN_MIN_DOCS_ENV, None)
+            else:
+                os.environ[ann_mod.ANN_MIN_DOCS_ENV] = old
+            ivf_svc.close()
+            exact_svc.close()
+
 
 class TestTimeouts:
     # the budget must cover an honest warm shard query on the backend

@@ -224,8 +224,11 @@ class TestCrossSegmentMerge:
             )
 
     def test_knn_multi_segment_parity(self, service):
+        # nc == k exercises the per-segment candidate rank cut (each
+        # segment can contribute at most nc, fewer than k x segments);
+        # nc < k is now a request-scoped 400 (KnnSearchBuilder parity)
         rng = np.random.default_rng(11)
-        for nc in (7, 100):
+        for nc in (8, 100):
             v = [float(x) for x in rng.normal(size=DIMS)]
             body = {
                 "knn": {"field": "vec", "query_vector": v, "k": 8,
