@@ -1098,6 +1098,293 @@ def run_ann_config(configs):
         svc_exact.close()
 
 
+# ---------------------------------------------------------------------------
+# rag_rerank config: the end-to-end RAG scenario — filtered hybrid
+# retrieval (bm25 + kNN under a keyword filter, RRF-fused) → device
+# late-interaction rerank → fetch (ISSUE 10)
+# ---------------------------------------------------------------------------
+
+RR_DOCS = int(os.environ.get("BENCH_RERANK_DOCS", min(N_DOCS, 200_000)))
+RR_DIMS = int(os.environ.get("BENCH_RERANK_DIMS", 64))
+RR_TOKENS = int(os.environ.get("BENCH_RERANK_TOKENS", 4))
+RR_QUERIES = min(N_QUERIES_SECONDARY, 512)
+RR_EVAL = int(os.environ.get("BENCH_RERANK_EVAL", 24))
+
+
+def build_rerank_services():
+    """(jax service, numpy oracle service, query texts, query token
+    matrices, doc token tensor) over a shared corpus carrying text +
+    dense vectors + a rank_vectors token column. Doc token rows are
+    drawn around per-doc topic centers and queries around the same
+    centers, so the second stage has real signal to reorder on."""
+    from elasticsearch_tpu.cluster.indices import IndexService
+    from elasticsearch_tpu.index.segment import (
+        MultiVectorField,
+        OrdinalField,
+        Segment,
+        VectorField,
+    )
+
+    rng = np.random.default_rng(SEED + 57)
+    log(f"[rag_rerank] building {RR_DOCS}-doc corpus "
+        f"({RR_TOKENS}x{RR_DIMS} tokens/doc)…")
+    lengths = rng.integers(AVG_LEN[0], AVG_LEN[1], size=RR_DOCS)
+    body_pf, body_df = build_postings(rng, 20_000, lengths, n_docs=RR_DOCS)
+    centers = rng.normal(size=(64, RR_DIMS)).astype(np.float32)
+    topic = rng.integers(0, 64, size=RR_DOCS)
+    vecs = centers[topic][:, :RR_DIMS] + 0.6 * rng.normal(
+        size=(RR_DOCS, RR_DIMS)
+    ).astype(np.float32)
+    vecs /= np.linalg.norm(vecs, axis=1, keepdims=True)
+    toks = centers[topic][:, None, :] + 0.8 * rng.normal(
+        size=(RR_DOCS, RR_TOKENS, RR_DIMS)
+    ).astype(np.float32)
+    cat_ords = rng.integers(0, 8, size=RR_DOCS).astype(np.int32)
+    cat_field = OrdinalField(
+        ord_terms=[f"cat{j}" for j in range(8)],
+        ords=cat_ords,
+        mv_ords=cat_ords.copy(),
+        mv_offsets=np.arange(RR_DOCS + 1, dtype=np.int32),
+    )
+    # keyword postings for the term-filter legs (tf=1 per doc)
+    from elasticsearch_tpu.index.segment import SegmentBuilder
+
+    cat_inv = {
+        f"cat{j}": {
+            int(d): 1 for d in np.nonzero(cat_ords == j)[0]
+        }
+        for j in range(8)
+    }
+    cat_pf = SegmentBuilder._build_postings(
+        cat_inv, np.ones(RR_DOCS, np.int64), RR_DOCS, RR_DOCS
+    )
+    exists = np.ones(RR_DOCS, bool)
+    mvf = MultiVectorField(
+        tok_vectors=toks.reshape(-1, RR_DIMS).astype(np.float32),
+        tok_offsets=(
+            np.arange(RR_DOCS + 1, dtype=np.int64) * RR_TOKENS
+        ).astype(np.int32),
+        exists=exists.copy(),
+        similarity="dot_product",
+    )
+    seg = Segment(
+        num_docs=RR_DOCS,
+        doc_ids=[str(i) for i in range(RR_DOCS)],
+        sources=[{"cat": f"cat{int(c)}"} for c in cat_ords],
+        postings={"body": body_pf, "cat": cat_pf},
+        numerics={},
+        ordinals={"cat": cat_field},
+        vectors={
+            "vec": VectorField(
+                vectors=vecs, exists=exists, similarity="cosine",
+                unit_vectors=vecs,
+            )
+        },
+        multi_vectors={"toks": mvf},
+    )
+
+    def svc_of(name, backend):
+        svc = IndexService(
+            name,
+            settings={"number_of_shards": 1, "search.backend": backend},
+            mappings_json={
+                "properties": {
+                    "body": {"type": "text"},
+                    "cat": {"type": "keyword"},
+                    "vec": {
+                        "type": "dense_vector", "dims": RR_DIMS,
+                        "similarity": "cosine",
+                    },
+                    "toks": {
+                        "type": "rank_vectors", "dims": RR_DIMS,
+                        "similarity": "dot_product",
+                    },
+                }
+            },
+        )
+        eng = svc.shards[0]
+        eng.segments = [seg]
+        eng.live_docs = [None]
+        eng.seg_versions = [np.ones(RR_DOCS, np.int64)]
+        eng.seg_seqnos = [np.arange(RR_DOCS, dtype=np.int64)]
+        eng.seg_names = ["seg_0_0"]
+        eng._next_seq = RR_DOCS
+        # the rescore phase resolves fused candidates back to
+        # (segment, doc) identity through the engine's id locations
+        eng._locations = {str(i): (0, i) for i in range(RR_DOCS)}
+        eng.change_generation += 1
+        return svc
+
+    texts = make_query_texts(body_df, RR_QUERIES, seed=23, hi=6000)
+    # query tokens drawn around corpus topic centers (the "rerank has
+    # signal" regime); 3 tokens per query
+    qtopic = rng.integers(0, 64, size=RR_QUERIES)
+    qtoks = centers[qtopic][:, None, :] + 0.6 * rng.normal(
+        size=(RR_QUERIES, 3, RR_DIMS)
+    ).astype(np.float32)
+    qvec = centers[qtopic] + 0.4 * rng.normal(
+        size=(RR_QUERIES, RR_DIMS)
+    ).astype(np.float32)
+    qvec /= np.linalg.norm(qvec, axis=1, keepdims=True)
+    return (
+        svc_of("bench-rerank", "jax"),
+        svc_of("bench-rerank-np", "numpy"),
+        texts, qtoks, qvec, toks, cat_ords,
+    )
+
+
+def _ndcg_at_10(ranked_ids, grades):
+    dcg = 0.0
+    for i, doc in enumerate(ranked_ids[:10]):
+        g = grades.get(doc, 0)
+        dcg += (2**g - 1) / np.log2(i + 2)
+    ideal = sorted(grades.values(), reverse=True)[:10]
+    idcg = sum((2**g - 1) / np.log2(i + 2) for i, g in enumerate(ideal))
+    return dcg / idcg if idcg > 0 else 0.0
+
+
+def run_rerank_config():
+    from elasticsearch_tpu.models import rerank as rerank_model
+
+    svc, svc_np, texts, qtoks, qvec, doc_toks, cat_ords = (
+        build_rerank_services()
+    )
+    try:
+        def body_of(i, rescore=True, source=False):
+            b = {
+                "retriever": {"rrf": {
+                    "rank_window_size": 100,
+                    "retrievers": [
+                        {"standard": {
+                            "query": {"match": {"body": texts[i]}},
+                            "filter": {
+                                "term": {"cat": f"cat{i % 8}"}
+                            },
+                        }},
+                        {"knn": {
+                            "field": "vec",
+                            "query_vector": [float(x) for x in qvec[i]],
+                            "k": 50, "num_candidates": 200,
+                            "filter": {"term": {"cat": f"cat{i % 8}"}},
+                        }},
+                    ],
+                }},
+                "size": K,
+                "_source": bool(source),
+            }
+            if rescore:
+                b["rescore"] = {
+                    "window_size": 100,
+                    "query": {
+                        "rescore_query": {"rank_vectors": {
+                            "field": "toks",
+                            "query_vectors": qtoks[i].tolist(),
+                        }},
+                        "query_weight": 1.0,
+                        "rescore_query_weight": 1.0,
+                    },
+                }
+            return b
+
+        first_bodies = [
+            body_of(i, rescore=False) for i in range(RR_QUERIES)
+        ]
+        rr_bodies = [
+            body_of(i, rescore=True, source=True)
+            for i in range(RR_QUERIES)
+        ]
+        log("[rag_rerank] warmup/compile (rerank column + maxsim)…")
+        for b in rr_bodies[:4]:
+            svc.search(dict(b))
+        for b in first_bodies[:4]:
+            svc.search(dict(b))
+        # leg + rerank timing windows
+        with svc._rrf_lock:
+            rrf0 = dict(svc.rrf_stats)
+        rs0 = rerank_model.stats_snapshot()
+        first_qps, first_p50, first_p99, _ = run_load(svc, first_bodies)
+        rr_qps, rr_p50, rr_p99, _ = run_load(svc, rr_bodies)
+        rs1 = rerank_model.stats_snapshot()
+        with svc._rrf_lock:
+            rrf1 = dict(svc.rrf_stats)
+        n_resc = max(rs1["device_rescores"] - rs0["device_rescores"], 1)
+        rerank_ms = (rs1["kernel_ms"] - rs0["kernel_ms"]) / n_resc
+        n_rrf = max(rrf1["searches"] - rrf0["searches"], 1)
+        leg_ms = {
+            "bm25_leg_ms": round(
+                (rrf1["bm25_leg_ms"] - rrf0["bm25_leg_ms"]) / n_rrf, 2
+            ),
+            "knn_leg_ms": round(
+                (rrf1["knn_leg_ms"] - rrf0["knn_leg_ms"]) / n_rrf, 2
+            ),
+            "fuse_ms": round(
+                (rrf1["fuse_ms"] - rrf0["fuse_ms"]) / n_rrf, 3
+            ),
+        }
+        # ---- NDCG@10 vs the TRUE maxsim ordering (host float, full
+        # corpus, filter-respecting): grades 3/2/1 for true top
+        # 10/50/200 within the query's filter slice ----
+        ndcg_first = []
+        ndcg_rerank = []
+        parity_ok = True
+        for i in range(min(RR_EVAL, RR_QUERIES)):
+            q = qtoks[i]  # [3, d]
+            sims = np.einsum("qd,ntd->qnt", q, doc_toks).max(
+                axis=2
+            ).sum(axis=0)  # true maxsim per doc
+            sims = np.where(cat_ords == (i % 8), sims, -np.inf)
+            order = np.argsort(-sims)
+            grades = {}
+            for r, doc in enumerate(order[:200]):
+                grades[str(int(doc))] = (
+                    3 if r < 10 else (2 if r < 50 else 1)
+                )
+            a = svc.search(body_of(i, rescore=True))
+            f = svc.search(body_of(i, rescore=False))
+            o = svc_np.search(body_of(i, rescore=True))
+            ids_a = [h["_id"] for h in a["hits"]["hits"]]
+            ids_o = [h["_id"] for h in o["hits"]["hits"]]
+            if ids_a != ids_o:
+                parity_ok = False
+            ndcg_rerank.append(_ndcg_at_10(ids_a, grades))
+            ndcg_first.append(
+                _ndcg_at_10([h["_id"] for h in f["hits"]["hits"]], grades)
+            )
+        block = {
+            "kind": "filtered_hybrid_rrf_plus_rescore",
+            "n_docs": RR_DOCS,
+            "qps": round(rr_qps, 1),
+            "p50_ms": round(rr_p50, 2),
+            "p99_ms": round(rr_p99, 2),
+            "first_stage_qps": round(first_qps, 1),
+            "first_stage_p50_ms": round(first_p50, 2),
+            "rerank_ms": round(rerank_ms, 2),
+            **leg_ms,
+            "ndcg_at_10": round(float(np.mean(ndcg_rerank)), 4),
+            "first_stage_ndcg_at_10": round(
+                float(np.mean(ndcg_first)), 4
+            ),
+            "oracle_parity": parity_ok,
+            "rescore_stats": {
+                k: rs1[k]
+                for k in ("device_rescores", "host_rescores",
+                          "skipped", "fallbacks", "ledger_bytes")
+            },
+        }
+        log(
+            f"[rag_rerank] {rr_qps:.1f} QPS p50={rr_p50:.2f}ms "
+            f"(first stage {first_qps:.1f} QPS) rerank={rerank_ms:.2f}ms "
+            f"legs: bm25={leg_ms['bm25_leg_ms']}ms "
+            f"knn={leg_ms['knn_leg_ms']}ms | "
+            f"NDCG@10 {block['first_stage_ndcg_at_10']} → "
+            f"{block['ndcg_at_10']} (oracle_parity={parity_ok})"
+        )
+        return block
+    finally:
+        svc.close()
+        svc_np.close()
+
+
 def main():
     t0 = time.perf_counter()
     # closed-loop sections measure RAW serving capacity: the admission
@@ -1384,6 +1671,15 @@ def main():
     configs["knn"]["kind"] = "exact_brute_force"
     ann_block = run_ann_config(configs)
     configs["ann_knn"] = ann_block
+
+    # ---- rag_rerank: the end-to-end RAG scenario — filtered hybrid
+    # retrieval (bm25 + kNN under a keyword filter, RRF-fused) feeding
+    # the device late-interaction reranker over the fused top-k, then
+    # fetch. rerank_ms sits next to the per-leg times; NDCG@10 against
+    # the TRUE maxsim ordering shows what the second stage buys over
+    # the first; hard gates live in scripts/rerank_smoke.sh. ----
+    if os.environ.get("BENCH_RERANK", "1") != "0":
+        configs["rag_rerank"] = run_rerank_config()
 
     # single-thread oracle (GIL-free per-core honesty number)
     o1_qps, _, _, _ = run_load(svc_np, bodies["match"][:24], threads=1)

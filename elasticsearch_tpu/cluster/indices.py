@@ -1216,6 +1216,29 @@ class IndexService:
 
                 PROFILE_CTX.reset(prof_token)
 
+        # ---- rescore phase (search/rescorer.py): second-stage
+        # late-interaction reranking of the top window_size candidates,
+        # BETWEEN merge and fetch — on the jax backend the maxsim
+        # kernel rides the batcher's `rerank` job family over the
+        # still-device-resident rank_vectors column (one launch + one
+        # packed download per group); sources are fetched only for the
+        # re-sorted page. Any rerank-path failure keeps the
+        # first-stage ranking (deterministic fallback, never a failed
+        # request). ----
+        if (
+            "rescore" in body
+            and sort_specs is None
+            and td is not None
+            and td.hits
+        ):
+            from ..search import rescorer
+
+            rescore_spec = rescorer.parse_rescore(body, validate_size=False)
+            if rescore_spec is not None:
+                td = self._apply_rescore(
+                    ex, rescore_spec, td, sid, shard_deadline, task
+                )
+
         # ---- folded fetch phase: sources + highlight for this shard's
         # candidates (FetchPhase, SURVEY.md §3.3) ----
         _check_shard_deadline()
@@ -1859,7 +1882,7 @@ class IndexService:
         {
             "query", "knn", "size", "from", "_source",
             "track_total_hits", "allow_partial_search_results",
-            "allow_degraded",
+            "allow_degraded", "rescore",
         }
     )
 
@@ -1934,6 +1957,36 @@ class IndexService:
             kind = "mesh_knn"
         if plan is None:
             return None
+        if "rescore" in body:
+            # fused mesh rescore: only flat match plans carry it (knn +
+            # rescore stays on the shard path), and only when the
+            # reranker is actually on (mode off = the escape hatch)
+            from ..common.settings import rerank_mode
+            from ..models import rerank as rerank_model
+            from ..search import rescorer
+
+            if kind != "mesh_match":
+                return None
+            spec = rescorer.parse_rescore(body, validate_size=False)
+            if spec is not None:
+                model = rerank_model.resolve_model(
+                    self.mappings, self.settings, spec.field
+                )
+                if model is None:
+                    raise dsl.QueryParseError(
+                        f"[rescore] field [{spec.field}] is not mapped "
+                        "as [rank_vectors]"
+                    )
+                if rerank_mode() == "off":
+                    rerank_model.note("skipped")
+                else:
+                    # rides the MatchPlan into the batcher group key:
+                    # different specs / page sizes never share a launch
+                    # (MatchPlan is frozen — attach out-of-band)
+                    object.__setattr__(plan, "rescore", (model, spec))
+                    object.__setattr__(
+                        plan, "rescore_sig", (model, spec, from_ + size)
+                    )
         from ..parallel.mesh_executor import MeshUnavailable
         from ..tasks import TaskCancelledException
 
@@ -2158,6 +2211,20 @@ class IndexService:
         QueryPhaseResultConsumer split). ``extra_filter`` supports
         filtered aliases (AliasFilter ANDed into the query)."""
         body = body or {}
+        if "rescore" in body:
+            from ..search import rescorer
+
+            # coordinator-side request validation (KnnSearchBuilder
+            # style): malformed rescore elements 400 here, before any
+            # shard work
+            rescorer.parse_rescore(body)
+            if pinned_executors is not None:
+                # QueryRescorer parity: rescore over a scroll / PIT
+                # context is a request error, not a server-side one
+                raise dsl.QueryParseError(
+                    "Cannot use [rescore] option in conjunction with "
+                    "[scroll] or a point in time."
+                )
         if "retriever" in body:
             return self._retriever_search(body, extra_filter), None, []
         rank = body.get("rank")
@@ -2377,6 +2444,188 @@ class IndexService:
                 out[fname] = frags
         return out
 
+    def _apply_rescore(self, ex, spec, td, sid, shard_deadline, task):
+        """Applies one shard's rescore phase to its first-stage
+        TopDocs. numpy backend → the host float oracle; jax backend →
+        the batcher `rerank` job family (maxsim kernel, ops/rerank.py).
+        Degrade contract: HBM degrade-to-skip and ES_TPU_RERANK=off
+        keep the first-stage order (counted `skipped`); any rerank-path
+        failure — injected `rerank.score` fault, closed batcher, device
+        error — keeps the first-stage order bit-for-bit (counted
+        `fallbacks`). Timeout / task-cancel / 429 keep their
+        request-scoped semantics."""
+        from ..common.settings import rerank_mode
+        from ..models import rerank as rerank_model
+        from ..search import rescorer
+        from ..search.batcher import EsRejectedExecutionError
+        from ..tasks import TaskCancelledException
+
+        model = rerank_model.resolve_model(
+            self.mappings, self.settings, spec.field
+        )
+        if model is None:
+            raise dsl.QueryParseError(
+                f"[rescore] field [{spec.field}] is not mapped as "
+                "[rank_vectors]"
+            )
+        mode = rerank_mode()
+        if mode == "off":
+            rerank_model.note("skipped")
+            return td
+        if isinstance(ex, NumpyExecutor):
+            # the numpy backend IS the float oracle
+            return rescorer.host_rescore_topdocs(ex.reader, model, spec, td)
+        plan = rescorer.build_plan(
+            ex.reader, model, spec,
+            [(h.score, h.segment, h.local_doc) for h in td.hits],
+        )
+        try:
+            job = self._batcher.submit_nowait(
+                ex, plan, len(td.hits), kind="rerank",
+                deadline=shard_deadline,
+            )
+            got = self._wait_batched(job, sid, shard_deadline, task)
+        except (
+            SearchTimeoutError,
+            TaskCancelledException,
+            EsRejectedExecutionError,
+        ):
+            raise  # request-scoped semantics — no silent rerun
+        except BaseException:
+            rerank_model.note("fallbacks")
+            return td
+        tag, scores, perm, kernel_ms = got
+        if tag != "ok":
+            if mode == "force":
+                raise RuntimeError(
+                    "[rescore] rerank column unavailable under "
+                    "ES_TPU_RERANK=force"
+                )
+            rerank_model.note("skipped")
+            return td
+        rerank_model.note_rescore(
+            min(spec.window_size, len(td.hits)), device=True,
+            kernel_ms=kernel_ms,
+        )
+        return rescorer.apply_perm_to_topdocs(td, scores, perm)
+
+    def _rescore_ranked(self, spec, ranked: List[tuple]) -> List[tuple]:
+        """Rescore phase for the retriever/rrf coordinator path over a
+        fused ranked [(doc_id, score)] list. Single-local-shard jax
+        indices rerank on device (the fused top-k stays identity-exact
+        through `_locations`); everything else — multi-shard, numpy —
+        uses the host oracle. Same degrade contract as
+        `_apply_rescore`."""
+        import numpy as np
+
+        from ..common.settings import rerank_mode
+        from ..models import rerank as rerank_model
+        from ..search import rescorer
+        from ..search.batcher import EsRejectedExecutionError, QueryBatcher
+        from ..search.executor_jax import JaxExecutor
+        from ..tasks import TaskCancelledException
+
+        model = rerank_model.resolve_model(
+            self.mappings, self.settings, spec.field
+        )
+        if model is None:
+            raise dsl.QueryParseError(
+                f"[rescore] field [{spec.field}] is not mapped as "
+                "[rank_vectors]"
+            )
+        mode = rerank_mode()
+        if mode == "off":
+            rerank_model.note("skipped")
+            return ranked
+        window = min(int(spec.window_size), len(ranked))
+        # device path: one local jax shard → the fused candidates keep
+        # exact (segment, doc) identity via the engine's id locations
+        if (
+            self.routing is None
+            and self.num_shards == 1
+            and str(self.settings.get("search.backend")) == "jax"
+        ):
+            try:
+                eng = self.local_shard(0)
+                ex = self._executor(eng)
+            except KeyError:
+                ex = None
+            if ex is not None and isinstance(ex, JaxExecutor):
+                cands = []
+                for doc_id, score in ranked:
+                    loc = eng._locations.get(doc_id)
+                    if loc is None:
+                        cands = None
+                        break
+                    cands.append((float(score), int(loc[0]), int(loc[1])))
+                if cands is not None:
+                    plan = rescorer.build_plan(ex.reader, model, spec, cands)
+                    try:
+                        job = self._batcher.submit_nowait(
+                            ex, plan, len(cands), kind="rerank"
+                        )
+                        got = QueryBatcher.wait(job)
+                    except (
+                        TaskCancelledException, EsRejectedExecutionError
+                    ):
+                        raise
+                    except BaseException:
+                        rerank_model.note("fallbacks")
+                        return ranked
+                    tag, scores, perm, kernel_ms = got
+                    if tag == "ok":
+                        rerank_model.note_rescore(
+                            window, device=True, kernel_ms=kernel_ms
+                        )
+                        out = []
+                        for s, p in zip(scores, perm):
+                            if not np.isfinite(s):
+                                break
+                            out.append((ranked[int(p)][0], float(s)))
+                        return out
+                    if mode == "force":
+                        raise RuntimeError(
+                            "[rescore] rerank column unavailable under "
+                            "ES_TPU_RERANK=force"
+                        )
+                    rerank_model.note("skipped")
+                    return ranked
+        # host oracle path (multi-shard / numpy backend)
+        qtoks = rerank_model.prepare_query_vectors(
+            spec.query_vectors, model.dims, model.similarity
+        )
+        blended = []
+        for doc_id, score in ranked[:window]:
+            msim = 0.0
+            try:
+                eng = self.shard_for(doc_id)
+                loc = eng._locations.get(doc_id)
+                if loc is not None:
+                    reader = self._executor(eng).reader
+                    mvf = reader.segments[loc[0]].multi_vectors.get(
+                        model.field
+                    )
+                    if mvf is not None:
+                        s0 = int(mvf.tok_offsets[loc[1]])
+                        s1 = int(mvf.tok_offsets[loc[1] + 1])
+                        msim = rerank_model.host_maxsim(
+                            qtoks, mvf.tok_vectors[s0:s1]
+                        )
+            except KeyError:
+                pass  # shard not local: candidate keeps first stage
+            blended.append(
+                float(
+                    np.float32(spec.query_weight) * np.float32(score)
+                    + np.float32(spec.rescore_query_weight)
+                    * np.float32(msim)
+                )
+            )
+        order = sorted(range(window), key=lambda i: (-blended[i], i))
+        rerank_model.note_rescore(window, device=False)
+        return [
+            (ranked[i][0], blended[i]) for i in order
+        ] + list(ranked[window:])
+
     def _retriever_search(
         self, body: dict, extra_filter: Optional[dict] = None
     ) -> dict:
@@ -2403,6 +2652,15 @@ class IndexService:
         ranked = self._run_retriever(
             body["retriever"], window, size, extra_filter
         )
+        if "rescore" in body and ranked:
+            from ..search import rescorer
+
+            rescore_spec = rescorer.parse_rescore(body)
+            if rescore_spec is not None:
+                # second stage over the FUSED candidates (the RAG
+                # shape: filtered hybrid retrieval → rerank → fetch);
+                # sources are fetched below, after the window re-sort
+                ranked = self._rescore_ranked(rescore_spec, ranked)
         page = ranked[from_ : from_ + size]
         from ..search.executor import filter_source
 

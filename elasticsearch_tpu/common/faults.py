@@ -33,6 +33,11 @@ Schedule shape (env `ES_TPU_FAULTS`, or `POST /_internal/faults`):
     carries field/segment; error kind proves the deterministic
     IVF→exact brute-force fallback, delay kind the slow-not-wrong
     contract)
+  - ``rerank.score``        (second-stage maxsim rescore dispatch —
+    ctx carries field (+ mesh=1 on the SPMD path); error kind proves
+    the deterministic rerank→first-stage-order fallback (the request
+    keeps its first-stage ranking bit-for-bit and the `fallbacks`
+    counter increments), delay kind the slow-not-wrong contract)
 * ``match``: exact-equality filters over the ctx kwargs the site passes
   (string-compared, so {"shard": 1} matches shard=1).
 * ``kind``: ``error`` (raise InjectedFault, 500-shaped), ``drop``

@@ -19,8 +19,11 @@ mesh-parallel serving stacks (parallel/mesh_executor.py: per-snapshot
 device views of an index's live (shard, segment) entries, charged at
 build and released on generation rebuild/close; a stack that cannot fit
 DEGRADES the request to the single-device path instead of tripping the
-breaker). Per-category bytes surface as child breakers in
-`_nodes/stats` (child_breakers())."""
+breaker). `rerank` holds the second-stage reranker's shard-level
+`rank_vectors` token columns (search/rescorer.py; a column that cannot
+fit DEGRADES TO SKIP — the request keeps its first-stage ranking).
+Per-category bytes surface as child breakers in `_nodes/stats`
+(child_breakers())."""
 
 from __future__ import annotations
 

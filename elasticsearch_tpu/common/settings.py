@@ -201,6 +201,30 @@ def device_aggs_mode() -> str:
     return v if v in ("auto", "force", "off") else "auto"
 
 
+# ---- second-stage reranking knobs (search/rescorer.py) ----
+#
+# ES_TPU_RERANK:  "auto" (default) — `rescore` bodies on the jax backend
+#                 run the late-interaction maxsim kernel on device over
+#                 the fused top-k (ops/rerank.py); any rerank-path
+#                 failure degrades to the FIRST-STAGE ranking (never a
+#                 failed request), and an HBM budget breach skips the
+#                 rerank column build (degrade-to-skip). "force" — a
+#                 silently-skipped device rerank (missing column,
+#                 budget degrade) RAISES instead (the bench/CI routing
+#                 assertion mode; runtime faults still fall back to the
+#                 first-stage order). "off" — rescore sections are
+#                 accepted but not executed (the ?rescore=false escape
+#                 hatch applied node-wide).
+
+RERANK_ENV = "ES_TPU_RERANK"
+
+
+def rerank_mode() -> str:
+    """Second-stage rerank routing mode: "auto" | "force" | "off"."""
+    v = os.environ.get(RERANK_ENV, "auto").strip().lower()
+    return v if v in ("auto", "force", "off") else "auto"
+
+
 # ---- admission-control knobs (search/admission.py) ----
 #
 # ES_TPU_ADMISSION:            "on" (default) | "off" — the per-node
@@ -350,6 +374,12 @@ INDEX_SETTINGS: Dict[str, Setting] = {
         # default probe width (per-request knn.nprobe overrides)
         Setting("knn.nprobe", 8, INDEX_SCOPE, parser=int,
                 validator=_positive("knn.nprobe")),
+        # second-stage reranker token storage (search/rescorer.py):
+        # int8 mirrors the kNN quantization path — per-token symmetric
+        # scales, 4x less HBM per maxsim gather
+        Setting("rerank.quantization", "none", INDEX_SCOPE,
+                validator=_one_of("rerank.quantization",
+                                  ("none", "int8"))),
         # shard request cache default for size:0/agg-only requests
         # (IndicesRequestCache's index.requests.cache.enable); the
         # per-request ?request_cache= param overrides it either way

@@ -33,6 +33,7 @@ HALF_FLOAT = "half_float"
 BOOLEAN = "boolean"
 DATE = "date"
 DENSE_VECTOR = "dense_vector"
+RANK_VECTORS = "rank_vectors"
 GEO_POINT = "geo_point"
 NESTED = "nested"
 PERCOLATOR = "percolator"
@@ -119,8 +120,8 @@ class Mappings:
 
     def _add_field(self, path: str, ftype: str, cfg: dict):
         known = (
-            TEXT, KEYWORD, BOOLEAN, DATE, DENSE_VECTOR, GEO_POINT, NESTED,
-            PERCOLATOR,
+            TEXT, KEYWORD, BOOLEAN, DATE, DENSE_VECTOR, RANK_VECTORS,
+            GEO_POINT, NESTED, PERCOLATOR,
         ) + NUMERIC_TYPES
         if ftype not in known:
             raise MappingParseError(f"No handler for type [{ftype}] declared on field [{path}]")
@@ -293,7 +294,7 @@ class Mappings:
         entry: dict = {"type": f.type}
         if f.type == TEXT and f.analyzer != "standard":
             entry["analyzer"] = f.analyzer
-        if f.type == DENSE_VECTOR:
+        if f.type in (DENSE_VECTOR, RANK_VECTORS):
             entry["dims"] = f.dims
             entry["similarity"] = f.similarity
         if f.ignore_above is not None:
@@ -340,6 +341,9 @@ class ParsedDocument:
     numeric_values: Dict[str, List[float]] = field(default_factory=dict)
     # field → vector
     vectors: Dict[str, List[float]] = field(default_factory=dict)
+    # field → per-doc token-embedding matrix (rank_vectors: one row per
+    # token, the late-interaction reranker's document side)
+    multi_vectors: Dict[str, List[List[float]]] = field(default_factory=dict)
     # field → field length (token count incl. duplicates) for norms
     field_lengths: Dict[str, int] = field(default_factory=dict)
 
@@ -576,3 +580,33 @@ class DocumentParser:
             if not f.dims:
                 f.dims = len(vec)
             out.vectors[path] = vec
+        elif f.type == RANK_VECTORS:
+            # one matrix per doc: [[...], ...] (a flat vector is accepted
+            # as a one-token matrix). Rows all share the mapped dims —
+            # the padded per-segment column needs a rectangular gather.
+            rows = values
+            if rows and all(
+                isinstance(x, (int, float)) and not isinstance(x, bool)
+                for x in rows
+            ):
+                rows = [rows]
+            mat: List[List[float]] = []
+            for row in rows:
+                if row is None:
+                    continue
+                if not isinstance(row, (list, tuple)):
+                    raise MappingParseError(
+                        f"rank_vectors field [{path}] must hold an array "
+                        "of vectors"
+                    )
+                vec = [float(x) for x in row]
+                if f.dims and len(vec) != f.dims:
+                    raise MappingParseError(
+                        f"The [{path}] field has dims [{f.dims}] but an "
+                        f"indexed vector has [{len(vec)}] dimensions"
+                    )
+                if not f.dims:
+                    f.dims = len(vec)
+                mat.append(vec)
+            if mat:
+                out.multi_vectors[path] = mat

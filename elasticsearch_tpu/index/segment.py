@@ -44,7 +44,14 @@ from typing import Dict, List, Optional, Sequence, Tuple
 import numpy as np
 
 from ..utils.smallfloat import encode_norms
-from .mapping import DENSE_VECTOR, KEYWORD, TEXT, Mappings, ParsedDocument
+from .mapping import (
+    DENSE_VECTOR,
+    KEYWORD,
+    RANK_VECTORS,
+    TEXT,
+    Mappings,
+    ParsedDocument,
+)
 
 TILE = 128  # TPU lane width; one tile = one row of the postings arrays
 INVALID_DOC = -1
@@ -144,6 +151,26 @@ class VectorField:
     unit_vectors: Optional[np.ndarray] = None  # normalized copy for cosine
 
 
+@dataclass
+class MultiVectorField:
+    """Per-doc token-embedding matrices (`rank_vectors`) in a flat CSR
+    layout: doc d owns token rows tok_offsets[d] : tok_offsets[d+1] of
+    tok_vectors. The late-interaction reranker gathers whole per-doc
+    blocks, so rows stay contiguous per doc; cosine fields store rows
+    unit-normalized at build (maxsim over unit rows = cosine maxsim)."""
+
+    tok_vectors: np.ndarray  # float32[total_tokens, dims]
+    tok_offsets: np.ndarray  # int32[N+1]
+    exists: np.ndarray  # bool[N]
+    similarity: str
+
+    @property
+    def max_tokens(self) -> int:
+        if len(self.tok_offsets) <= 1:
+            return 0
+        return int(np.diff(self.tok_offsets).max())
+
+
 class Segment:
     """An immutable searchable segment of N documents (local ids 0..N-1)."""
 
@@ -157,6 +184,7 @@ class Segment:
         ordinals: Dict[str, OrdinalField],
         vectors: Dict[str, VectorField],
         generation: int = 0,
+        multi_vectors: Optional[Dict[str, MultiVectorField]] = None,
     ):
         self.num_docs = num_docs
         self.doc_ids = doc_ids  # _id per local doc
@@ -165,6 +193,7 @@ class Segment:
         self.numerics = numerics
         self.ordinals = ordinals
         self.vectors = vectors
+        self.multi_vectors = multi_vectors or {}
         self.generation = generation
 
     # ---------- persistence ----------
@@ -181,6 +210,7 @@ class Segment:
             "numerics": sorted(self.numerics),
             "ordinals": sorted(self.ordinals),
             "vectors": {},
+            "multi_vectors": {},
         }
         arrays: Dict[str, np.ndarray] = {}
 
@@ -244,6 +274,15 @@ class Segment:
             manifest["vectors"][fname] = {"key": key, "similarity": vf.similarity}
             put(f"vec.{key}.vectors", vf.vectors)
             put(f"vec.{key}.exists", vf.exists)
+        for fname, mvf in self.multi_vectors.items():
+            key = _fkey(fname)
+            manifest["multi_vectors"][fname] = {
+                "key": key,
+                "similarity": mvf.similarity,
+            }
+            put(f"mvec.{key}.tok_vectors", mvf.tok_vectors)
+            put(f"mvec.{key}.tok_offsets", mvf.tok_offsets)
+            put(f"mvec.{key}.exists", mvf.exists)
 
         np.savez(os.path.join(path, "arrays.npz"), **arrays)
         fsync_path(os.path.join(path, "arrays.npz"))
@@ -358,6 +397,15 @@ class Segment:
             if vf.similarity == "cosine":
                 vf.unit_vectors = _unit_normalize(vf.vectors)
             vectors[fname] = vf
+        multi_vectors = {}
+        for fname, meta in manifest.get("multi_vectors", {}).items():
+            key = meta["key"]
+            multi_vectors[fname] = MultiVectorField(
+                tok_vectors=data[f"mvec.{key}.tok_vectors"],
+                tok_offsets=data[f"mvec.{key}.tok_offsets"],
+                exists=data[f"mvec.{key}.exists"],
+                similarity=meta["similarity"],
+            )
         return cls(
             num_docs=manifest["num_docs"],
             doc_ids=docs["doc_ids"],
@@ -367,6 +415,7 @@ class Segment:
             ordinals=ordinals,
             vectors=vectors,
             generation=manifest.get("generation", 0),
+            multi_vectors=multi_vectors,
         )
 
 
@@ -509,6 +558,51 @@ class SegmentBuilder:
                 vf.unit_vectors = _unit_normalize(mat)
             vectors[fname] = vf
 
+        # ---- rank_vectors: per-doc token matrices, flat CSR layout ----
+        multi_vectors: Dict[str, MultiVectorField] = {}
+        mv_fields = sorted({f for d in docs for f in d.multi_vectors})
+        for fname in mv_fields:
+            mf = self.mappings.get(fname)
+            dims = (
+                mf.dims
+                if mf and mf.dims
+                else len(
+                    next(
+                        row
+                        for d in docs
+                        for m in (d.multi_vectors.get(fname),)
+                        if m
+                        for row in m[:1]
+                    )
+                )
+            )
+            sim = mf.similarity if mf else "cosine"
+            offsets = np.zeros(n + 1, dtype=np.int32)
+            chunks: List[np.ndarray] = []
+            exists = np.zeros(n, dtype=bool)
+            total = 0
+            for local_id, d in enumerate(docs):
+                mat = d.multi_vectors.get(fname)
+                if mat:
+                    arr = np.asarray(mat, dtype=np.float32)
+                    if sim == "cosine":
+                        arr = _unit_normalize(arr)
+                    chunks.append(arr)
+                    total += len(arr)
+                    exists[local_id] = True
+                offsets[local_id + 1] = total
+            tok = (
+                np.concatenate(chunks, axis=0)
+                if chunks
+                else np.zeros((0, dims), np.float32)
+            )
+            multi_vectors[fname] = MultiVectorField(
+                tok_vectors=tok,
+                tok_offsets=offsets,
+                exists=exists,
+                similarity=sim,
+            )
+
         return Segment(
             num_docs=n,
             doc_ids=[d.doc_id for d in docs],
@@ -518,6 +612,7 @@ class SegmentBuilder:
             ordinals=ordinals,
             vectors=vectors,
             generation=self.generation,
+            multi_vectors=multi_vectors,
         )
 
     @staticmethod

@@ -61,6 +61,7 @@ from .sharded import (
     build_mesh_agg_step,
     build_mesh_ann_step,
     build_mesh_knn_step,
+    build_mesh_rerank_step,
     build_mesh_text_step,
 )
 
@@ -744,6 +745,121 @@ class MeshExecutor:
                     snap.steps[key] = step
         return step
 
+    def _rerank_view(self, snap: _MeshSnapshot, model) -> dict:
+        """Stacked `rank_vectors` view for one RerankModel: per-entry
+        CSR bounds over LOCAL doc ids plus each entry's flat token
+        block (tail-padded with `tmax` zero rows, the ops/ivf gather
+        trick), int8 + per-token scales for quantized models. Entries
+        without the field read as zero-token docs (maxsim 0) — exactly
+        the per-shard column's semantics."""
+        key = ("rerank", model)
+        view = snap.text.get(key)
+        if view is not None:
+            return view
+        with self._lock:
+            view = snap.text.get(key)
+            if view is not None:
+                return view
+            from ..models import rerank as rerank_model
+
+            n_max = snap.n_docs_max
+            tmax = 1
+            flat_max = 1
+            mvfs = []
+            for sid, si in snap.entries:
+                mvf = snap.readers[sid].segments[si].multi_vectors.get(
+                    model.field
+                )
+                mvfs.append(mvf)
+                if mvf is not None and len(mvf.tok_vectors):
+                    tmax = max(tmax, mvf.max_tokens)
+                    flat_max = max(flat_max, int(len(mvf.tok_vectors)))
+            dims = int(model.dims) or next(
+                (
+                    int(m.tok_vectors.shape[1])
+                    for m in mvfs
+                    if m is not None and len(m.tok_vectors)
+                ),
+                1,
+            )
+            fmax = flat_max + tmax
+            starts = np.zeros((snap.e_pad, n_max), np.int32)
+            counts = np.zeros((snap.e_pad, n_max), np.int32)
+            toks = np.zeros((snap.e_pad, fmax, dims), np.float32)
+            for e, mvf in enumerate(mvfs):
+                if mvf is None or not len(mvf.tok_vectors):
+                    continue
+                n = len(mvf.tok_offsets) - 1
+                offs = mvf.tok_offsets.astype(np.int64)
+                starts[e, :n] = offs[:-1]
+                counts[e, :n] = np.diff(offs)
+                toks[e, : len(mvf.tok_vectors)] = mvf.tok_vectors
+            scales_dev = None
+            if model.quantized:
+                flat = toks.reshape(-1, dims)
+                qv, scales = rerank_model.quantize_tokens(flat)
+                toks_q = qv.reshape(snap.e_pad, fmax, dims)
+                scales = scales.reshape(snap.e_pad, fmax)
+                nbytes = (
+                    starts.nbytes + counts.nbytes + toks_q.nbytes
+                    + scales.nbytes
+                )
+                snap.charge(nbytes)
+                sh3 = NamedSharding(snap.mesh, P(SHARD_AXIS, None, None))
+                sh2 = NamedSharding(snap.mesh, P(SHARD_AXIS, None))
+                toks_dev = jax.device_put(toks_q, sh3)
+                scales_dev = jax.device_put(scales, sh2)
+            else:
+                nbytes = starts.nbytes + counts.nbytes + toks.nbytes
+                snap.charge(nbytes)
+                sh3 = NamedSharding(snap.mesh, P(SHARD_AXIS, None, None))
+                sh2 = NamedSharding(snap.mesh, P(SHARD_AXIS, None))
+                toks_dev = jax.device_put(toks, sh3)
+            view = {
+                "starts": jax.device_put(
+                    starts, NamedSharding(snap.mesh, P(SHARD_AXIS, None))
+                ),
+                "counts": jax.device_put(
+                    counts, NamedSharding(snap.mesh, P(SHARD_AXIS, None))
+                ),
+                "toks": toks_dev,
+                "scales": scales_dev,
+                "tmax": int(tmax),
+                "dims": dims,
+            }
+            snap.text[key] = view
+            return view
+
+    def _rerank_step(self, snap, field, kb, t_shape, with_cnt, model,
+                     k_req, window, qb):
+        key = ("rerank", field, model, kb, t_shape, with_cnt, k_req,
+               window, qb)
+        step = snap.steps.get(key)
+        if step is None:
+            with self._lock:
+                step = snap.steps.get(key)
+                if step is None:
+                    view = self._text_view(snap, field)
+                    rview = self._rerank_view(snap, model)
+                    step = build_mesh_rerank_step(
+                        snap.mesh,
+                        view["doc_ids"],
+                        view["tfs"],
+                        view["inv_norm"],
+                        snap.live,
+                        rview["starts"],
+                        rview["counts"],
+                        rview["toks"],
+                        rview["scales"],
+                        kb,
+                        k_req,
+                        window,
+                        rview["tmax"],
+                        with_cnt=with_cnt,
+                    )
+                    snap.steps[key] = step
+        return step
+
     def _knn_step(self, snap, field, kc):
         key = ("knn", field, kc)
         step = snap.steps.get(key)
@@ -899,6 +1015,12 @@ class MeshExecutor:
         msm = np.ones(rows, np.int32)
         msm[: len(jobs)] = [j.plan.msm for j in jobs]
         with_cnt = any(j.plan.msm > 1 for j in jobs)
+        rescore = getattr(jobs[0].plan, "rescore", None)
+        if rescore is not None:
+            return self._dispatch_match_rescore(
+                snap, jobs, field, kb, rows, ti, tw, tv, msm, with_cnt,
+                slots, rescore,
+            )
         step = self._text_step(
             snap, (field,), kb, (T,), with_cnt, False, "sum", 0.0
         )
@@ -909,6 +1031,61 @@ class MeshExecutor:
             self.stats["jobs"] += len(jobs)
         flops = scoring.text_plan_flops(slots, 0, 0)
         return {"snap": snap, "out": out, "flops": flops, "rows": rows}
+
+    def _dispatch_match_rescore(self, snap, jobs, field, kb, rows,
+                                ti, tw, tv, msm, with_cnt, slots,
+                                rescore):
+        """The fused first-stage + rerank SPMD launch: each entry
+        rescores its own local top-k BEFORE the all_gather, so the ICI
+        carries already-reranked candidates. Routing precondition: one
+        live segment per shard — that makes the per-entry window
+        identical to the per-shard path's post-merge window, so the
+        two paths agree bit-for-bit."""
+        from ..common.faults import faults as _faults
+        from ..models import rerank as rerank_model
+        from ..ops import rerank as rerank_ops
+
+        model, spec = rescore
+        _faults.check("rerank.score", field=model.field, mesh=1)
+        sids = [sid for sid, _si in snap.entries]
+        if len(set(sids)) != len(sids):
+            raise MeshUnavailable(
+                "mesh rescore needs one live segment per shard"
+            )
+        rview = self._rerank_view(snap, model)
+        k_req = int(jobs[0].k)
+        window = min(int(spec.window_size), k_req)
+        qv = rerank_model.prepare_query_vectors(
+            spec.query_vectors, model.dims, model.similarity
+        )
+        qb = max(4, scoring.next_bucket(max(len(qv), 1), 4))
+        qtoks = np.zeros((rows, qb, rview["dims"]), np.float32)
+        qvalid = np.zeros((rows, qb), bool)
+        qtoks[:, : len(qv)] = qv[None, :, :]
+        qvalid[:, : len(qv)] = True
+        weights = np.asarray(
+            [spec.query_weight, spec.rescore_query_weight], np.float32
+        )
+        T = int(ti.shape[2])
+        step = self._rerank_step(
+            snap, field, kb, T, with_cnt, model, k_req, window, qb
+        )
+        with _LAUNCH_LOCK:
+            out = step(ti, tw, tv, msm, qtoks, qvalid, weights)
+        with self._lock:
+            self.stats["launches"] += 1
+            self.stats["jobs"] += len(jobs)
+        flops = scoring.text_plan_flops(slots, 0, 0) + (
+            rerank_ops.rerank_flops(
+                len(jobs), qb, min(kb, snap.n_docs_max),
+                rview["tmax"], rview["dims"],
+            )
+            * snap.e_pad
+        )
+        return {
+            "snap": snap, "out": out, "flops": flops, "rows": rows,
+            "rescored": (model, spec, window),
+        }
 
     def dispatch_serve(self, jobs, kb: int):
         snap = self.ensure_snapshot()
@@ -950,6 +1127,11 @@ class MeshExecutor:
     def _collect_text(self, jobs, pend):
         snap = pend["snap"]
         ms, me, md, tot = jax.device_get(pend["out"])
+        rescored = pend.get("rescored")
+        if rescored is not None:
+            from ..models import rerank as rerank_model
+
+            _model, _spec, window = rescored
         for ji, j in enumerate(jobs):
             finite = np.isfinite(ms[ji])
             hits = [
@@ -960,6 +1142,8 @@ class MeshExecutor:
                     md[ji][finite][: j.k],
                 )
             ]
+            if rescored is not None:
+                rerank_model.note_rescore(window, device=True)
             j.result = MeshTopDocs(
                 total=int(tot[ji]),
                 relation="eq",

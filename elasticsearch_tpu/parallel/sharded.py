@@ -570,6 +570,147 @@ def build_mesh_text_step(
     return step
 
 
+def build_mesh_rerank_step(
+    mesh: Mesh,
+    doc_ids: jax.Array,  # [E, Tmax, TILE] stacked postings tiles
+    tfs: jax.Array,
+    inv_norm: jax.Array,  # [E, Nmax]
+    live: jax.Array,  # bool[E, Nmax]
+    rr_starts: jax.Array,  # i32 [E, Nmax] local doc → flat token row
+    rr_counts: jax.Array,  # i32 [E, Nmax]
+    rr_toks: jax.Array,  # [E, Fmax, d] f32 (or int8 with scales)
+    rr_scales: Optional[jax.Array],  # f32 [E, Fmax] or None
+    kb: int,  # local candidate page (compile bucket >= k_req)
+    k_req: int,  # the request page (from + size): per-entry page cut
+    window: int,  # rescore window, already clamped to k_req
+    tmax: int,  # max tokens per doc (gather width)
+    *,
+    with_cnt: bool,
+):
+    """One SPMD first-stage + RERANK step: per-entry BM25 scoring and
+    local top-k exactly like build_mesh_text_step (single field), then
+    the maxsim rescore runs LOCALLY per entry — each entry's
+    rank_vectors tokens are sharded with it — so the ICI all_gather
+    carries already-reranked candidates. With one live segment per
+    shard (the routing precondition), each entry's local stream equals
+    the per-shard path's post-rescore page: positions < window are
+    re-sorted by blended score, positions [window, k_req) keep first
+    stage, positions >= k_req are dropped (the shard page cut).
+
+    fn(ti, tw, tv, msm[B], qtoks[B, Qt, d], qvalid[B, Qt],
+       weights[2]) →
+        (scores[B, slots], entry[B, slots], doc[B, slots], totals[B])
+    The merged stream comes back FULLY ordered (score desc, slot asc =
+    (entry, post-rescore rank) asc — the coordinator's (-score, shard,
+    rank) tie-break) rather than cut at a global k, mirroring
+    build_mesh_knn_step.
+    """
+    from ..ops.rerank import blend_and_sort, maxsim_candidates
+
+    n_docs = int(inv_norm.shape[1])
+    kk = min(kb, n_docs)
+    wc = min(window, k_req, kk)
+    has_scales = rr_scales is not None
+
+    def body(d_b, t_b, i_b, live_b, st_b, ct_b, tk_b, sc_b, ti, tw, tv,
+             msm, qtoks, qvalid, weights):
+        def entry(args):
+            dids, tfs_, inv, live_e, st_e, ct_e, tk_e, sc_e, ti_e, tw_e, tv_e = args
+            Bd = ti_e.shape[0]
+            nt = dids.shape[0]
+            rows_d = dids[jnp.clip(ti_e, 0, nt - 1)]  # [Bd, T, 128]
+            rows_t = tfs_[jnp.clip(ti_e, 0, nt - 1)]
+            valid = (rows_d >= 0) & tv_e[:, :, None]
+            tgt, s = bm25_tile_contrib(
+                rows_d, rows_t, tw_e[:, :, None], valid, inv, n_docs
+            )
+            acc = jnp.zeros((Bd, n_docs + 1), jnp.float32)
+            acc = jax.vmap(
+                lambda a, d, v: a.at[d.ravel()].add(v.ravel())
+            )(acc, tgt, s)
+            acc = acc[:, :n_docs]
+            if with_cnt:
+                cnt = jnp.zeros((Bd, n_docs + 1), jnp.int32)
+                cnt = jax.vmap(
+                    lambda c, d, v: c.at[d.ravel()].add(
+                        v.ravel().astype(jnp.int32)
+                    )
+                )(cnt, tgt, valid)
+                mask = cnt[:, :n_docs] >= jnp.maximum(msm, 1)[:, None]
+            else:
+                mask = acc > 0
+            mask = mask & live_e[None, :]
+            masked = jnp.where(mask, acc, -jnp.inf)
+            s_e, d_e = jax.lax.top_k(masked, kk)
+            # ---- local rescore, before the gather: page cut at k_req,
+            # maxsim over this entry's token block, window re-sort ----
+            pos = jnp.arange(kk, dtype=jnp.int32)
+            keep = jnp.isfinite(s_e) & (pos[None, :] < k_req)
+            msim = maxsim_candidates(
+                qtoks, qvalid, st_e, ct_e, tk_e,
+                sc_e if has_scales else None,
+                jnp.where(keep, d_e, 0), tmax,
+            )
+            first = jnp.where(keep, s_e, -jnp.inf)
+            scores, perm = blend_and_sort(msim, first, keep, weights, wc)
+            d_sorted = jnp.take_along_axis(d_e, perm, axis=1)
+            return scores, d_sorted, mask.sum(axis=1, dtype=jnp.int32)
+
+        per_entry = (
+            d_b, t_b, i_b, live_b, st_b, ct_b, tk_b, sc_b, ti, tw, tv,
+        )
+        s, d, t = jax.vmap(entry)(per_entry)  # [F, Bd, kk] ×2, [F, Bd]
+        gs = jax.lax.all_gather(s, SHARD_AXIS)  # [G, F, Bd, kk]
+        gd = jax.lax.all_gather(d, SHARD_AXIS)
+        G, F, Bd, _ = gs.shape
+        slots = G * F * kk
+        gs2 = jnp.transpose(gs, (2, 0, 1, 3)).reshape(Bd, slots)
+        gd2 = jnp.transpose(gd, (2, 0, 1, 3)).reshape(Bd, slots)
+        entry_of_slot = jnp.arange(slots, dtype=jnp.int32) // kk
+        ms, mi = jax.lax.top_k(gs2, slots)
+        me = entry_of_slot[mi]
+        md = jnp.take_along_axis(gd2, mi, axis=1)
+        totals = jax.lax.psum(t.sum(axis=0), SHARD_AXIS)
+        return ms, me, md, totals
+
+    p3 = P(SHARD_AXIS, None, None)
+    p2 = P(SHARD_AXIS, None)
+    p_plan = P(SHARD_AXIS, DATA_AXIS, None)
+    p_out = P(DATA_AXIS, None)
+    in_specs = (
+        p3, p3, p2, p2,  # text view + live
+        p2, p2, p3,  # rerank starts/counts/toks
+        p2,  # scales (per-entry dummy when the model is float)
+        p_plan, p_plan, p_plan,  # tile plans
+        P(DATA_AXIS),  # msm
+        P(DATA_AXIS, None, None),  # qtoks
+        P(DATA_AXIS, None),  # qvalid
+        P(),  # weights (replicated)
+    )
+    fn = shard_map(
+        body,
+        mesh=mesh,
+        in_specs=in_specs,
+        out_specs=(p_out, p_out, p_out, P(DATA_AXIS)),
+        check_vma=False,
+    )
+
+    dummy_scales = (
+        jnp.zeros((int(doc_ids.shape[0]), 1), jnp.float32)
+        if rr_scales is None
+        else rr_scales
+    )
+
+    @jax.jit
+    def step(ti, tw, tv, msm, qtoks, qvalid, weights):
+        return fn(
+            doc_ids, tfs, inv_norm, live, rr_starts, rr_counts, rr_toks,
+            dummy_scales, ti, tw, tv, msm, qtoks, qvalid, weights,
+        )
+
+    return step
+
+
 def build_mesh_knn_step(
     mesh: Mesh,
     vectors: jax.Array,  # [E, Nmax, dims] stacked (original dtype)

@@ -682,6 +682,18 @@ class RestActions:
         from ..search.ann import stats_snapshot as ann_stats
 
         knn_block = {"ann": ann_stats()}
+        # second-stage reranking counters (models/rerank.py):
+        # device/host rescores, degrade-to-skip and first-stage
+        # fallbacks, maxsim kernel wall time, the window-size
+        # histogram, and the `rerank` HBM ledger bytes
+        from ..models.rerank import stats_snapshot as rescore_stats
+
+        rescore_block = rescore_stats()
+        rescore_block["batched_jobs"] = sum(
+            getattr(idx, "_batcher", None).stats.get("rerank_jobs", 0)
+            for idx in self.cluster.indices.values()
+            if getattr(idx, "_batcher", None) is not None
+        )
         return 200, {
             "cluster_name": self.cluster.cluster_name,
             "nodes": {
@@ -718,6 +730,7 @@ class RestActions:
                     "pipeline": pipeline,
                     "aggs": aggs_block,
                     "knn": knn_block,
+                    "rescore": rescore_block,
                     # overload-protection block (search/admission.py):
                     # per-tenant queue depths, the adaptive concurrency
                     # limit, pressure tier, shed/brownout/retry-budget
@@ -1235,6 +1248,12 @@ class RestActions:
             # this request to the brute-force float oracle even on an
             # index.knn.type=ivf index (rides the body to the shards)
             body["exact"] = qs["exact"][0] not in ("false", "0")
+        if "rescore" in qs and qs["rescore"][0] in ("false", "0"):
+            # second-stage escape hatch: ?rescore=false strips the
+            # body's rescore element so the request serves the pure
+            # first-stage ranking (the per-request form of
+            # ES_TPU_RERANK=off)
+            body.pop("rescore", None)
         if "allow_degraded" in qs:
             # brownout opt-out: pins the request to full-fidelity
             # execution (it can still be shed outright under overload)

@@ -578,7 +578,8 @@ def apply_brownout(body: dict, tier: int) -> tuple:
         DFS global-stats round and exact total tracking (capped at the
         ES default 10_000), profile output.
       2 shrink_window — halve retriever rank_window_size, halve kNN
-        num_candidates (floor k), cap terms-agg cardinality at 16.
+        num_candidates (floor k), halve the rescore window_size (floor
+        the requested page), cap terms-agg cardinality at 16.
       3 cache_only — agg-only (size:0) bodies must answer from the
         shard request cache; a miss is shed instead of computed.
         Non-agg requests keep their tier-2 degradation.
@@ -614,6 +615,16 @@ def apply_brownout(body: dict, tier: int) -> tuple:
                 if isinstance(knn, list)
                 else shrink_knn(knn)
             )
+        resc = out.get("rescore")
+        if isinstance(resc, dict):
+            # shrink the second-stage rerank window (never below the
+            # requested page, which would 400 at parse)
+            floor = int(out.get("size", 10)) + int(out.get("from", 0))
+            win = int(resc.get("window_size", 10))
+            if win > max(floor, 1) and win > 10:
+                resc = {**resc, "window_size": max(win // 2, floor, 10)}
+                out["rescore"] = resc
+                actions.append("rescore_window_halved")
         ret = out.get("retriever")
         if isinstance(ret, dict) and "rrf" in ret:
             rrf = dict(ret["rrf"])

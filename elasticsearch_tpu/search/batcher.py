@@ -514,6 +514,10 @@ class QueryBatcher:
             # device-aggregations job family (size:0/agg bodies riding
             # the dispatch/collect pipeline as segment-sum launches)
             "agg_jobs": 0,
+            # second-stage rerank job family (rescore bodies riding the
+            # dispatch/collect pipeline as maxsim launches between
+            # merge and fetch)
+            "rerank_jobs": 0,
         }
         # per-bucket launch histogram + occupancy sums (guarded by
         # self._lock; surfaced via batching_stats() → _nodes/stats):
@@ -530,7 +534,7 @@ class QueryBatcher:
         self._warm_inflight = 0
         # family → groups currently dispatched-but-not-collected,
         # across ALL workers (guarded by self._lock)
-        self._inflight = {"text": 0, "knn": 0, "agg": 0}
+        self._inflight = {"text": 0, "knn": 0, "agg": 0, "rerank": 0}
         # per-device roofline accounting (straggler visibility): device
         # id → [inflight_groups, busy_t0, busy_s, flops]; single-device
         # groups attribute to device 0, mesh groups to every device in
@@ -796,7 +800,13 @@ class QueryBatcher:
                         j.plan.combine, j.plan.tie, kb,
                     )
                 elif j.kind == "mesh_match":
-                    key = (id(j.executor), "Mm", j.plan.field, kb)
+                    # a fused mesh rescore rides the plan (rescore_sig
+                    # None for plain match): different specs / page
+                    # sizes never share an SPMD launch
+                    key = (
+                        id(j.executor), "Mm", j.plan.field,
+                        getattr(j.plan, "rescore_sig", None), kb,
+                    )
                 elif j.kind == "mesh_serve":
                     key = (
                         id(j.executor), "Ms", j.plan.fields,
@@ -809,6 +819,11 @@ class QueryBatcher:
                     # compiled plan's structural signature so identical
                     # dashboard shapes share one dispatch slot
                     key = (id(j.executor), "a", j.plan.sig, kb)
+                elif j.kind == "rerank":
+                    # second-stage rerank family: jobs share a maxsim
+                    # launch when model, padded window/query-token
+                    # shapes, static window, and blend weights agree
+                    key = (id(j.executor), "r", j.plan.sig, kb)
                 elif j.kind == "mesh_agg":
                     key = (id(j.executor), "Ma", j.plan.sig, kb)
                 else:  # knn (exact and IVF-probed jobs never share;
@@ -825,6 +840,8 @@ class QueryBatcher:
                     fam = "knn"
                 elif kind in ("a", "Ma"):
                     fam = "agg"
+                elif kind == "r":
+                    fam = "rerank"
                 else:
                     fam = "text"
                 # pad-bucket ladder: the group's launch width is the
@@ -876,6 +893,14 @@ class QueryBatcher:
                         ctx.pending.append(
                             (key, jobs, fam,
                              self._dispatch_agg_group(jobs), dev_ids)
+                        )
+                        dispatched = True
+                    elif kind == "r":
+                        self._record_bucket(rows, len(jobs))
+                        ctx.pending.append(
+                            (key, jobs, fam,
+                             self._dispatch_rerank_group(jobs, rows=rows),
+                             dev_ids)
                         )
                         dispatched = True
                     else:
@@ -943,6 +968,8 @@ class QueryBatcher:
                         self._collect_knn_group(jobs, pend)
                     elif kind == "a":
                         self._collect_agg_group(jobs, pend)
+                    elif kind == "r":
+                        self._collect_rerank_group(jobs, pend)
                     elif kind in ("Mm", "Ms"):
                         t0 = time.perf_counter()
                         jobs[0].executor.collect_match(jobs, pend)
@@ -1538,6 +1565,94 @@ class QueryBatcher:
                 self._add_stall(time.perf_counter() - t0)
             except BaseException as e:
                 j.error = e
+            j.event.set()
+
+    def _dispatch_rerank_group(self, jobs: List[_Job],
+                               rows: Optional[int] = None) -> Tuple:
+        """Launches one maxsim rescore kernel for a group of same-sig
+        rerank jobs (search/rescorer.RerankPlan) WITHOUT host sync; the
+        one packed download happens at collect. The `rerank.score`
+        fault site fires here — an injected error surfaces to exactly
+        this group's waiters, whose requests then keep their
+        first-stage ranking (the deterministic rerank fallback). A
+        missing column (HBM degrade-to-skip) completes the group with a
+        "skip" marker instead of device work."""
+        from ..ops import rerank as rerank_ops
+
+        ex = jobs[0].executor
+        plan0 = jobs[0].plan
+        nj = len(jobs)
+        rows = rows or BPAD
+        faults.check("rerank.score", field=plan0.field, jobs=nj)
+        col = ex.rerank_column(plan0.model)
+        if col is None:
+            return ("skip", None, 0.0)
+        wb, qb = plan0.wb, plan0.qb
+        dims = col["dims"]
+        staging = getattr(ex, "staging_slab", None)
+        if staging is not None:
+            qtoks = staging("rerank_q", (rows, qb, dims), np.float32)
+            qvalid = staging("rerank_qv", (rows, qb), np.bool_)
+            docs = staging("rerank_d", (rows, wb), np.int32)
+            first = staging("rerank_s", (rows, wb), np.float32)
+            valid = staging("rerank_v", (rows, wb), np.bool_)
+        else:
+            qtoks = np.zeros((rows, qb, dims), np.float32)
+            qvalid = np.zeros((rows, qb), bool)
+            docs = np.zeros((rows, wb), np.int32)
+            first = np.zeros((rows, wb), np.float32)
+            valid = np.zeros((rows, wb), bool)
+        # staging buffers are reused: fully rewrite every plane
+        qtoks[:] = 0.0
+        qvalid[:] = False
+        docs[:] = 0
+        first[:] = -np.inf
+        valid[:] = False
+        for ji, j in enumerate(jobs):
+            p = j.plan
+            qtoks[ji, : len(p.qtoks)] = p.qtoks
+            qvalid[ji, : len(p.qtoks)] = True
+            w = len(p.first)
+            docs[ji, :w] = p.gdocs.astype(np.int32)
+            first[ji, :w] = p.first
+            valid[ji, :w] = True
+        t0 = time.perf_counter()
+        out = rerank_ops.maxsim_rescore_batch(
+            qtoks, qvalid, col["starts"], col["counts"], col["toks"],
+            col["scales"], docs, first, valid,
+            plan0.spec.query_weight, plan0.spec.rescore_query_weight,
+            col["tmax"], plan0.win_static,
+        )
+        with self._lock:
+            self.stats["launches"] += 1
+            self.stats["rerank_jobs"] += nj
+        self._add_flops(
+            rerank_ops.rerank_flops(nj, qb, wb, col["tmax"], dims)
+        )
+        return ("ok", out, t0)
+
+    def _collect_rerank_group(self, jobs: List[_Job], pend: Tuple):
+        """Host side: the ONE packed download, then each waiter gets
+        its (scores, perm, kernel_ms) triple — the shard applies the
+        permutation to its first-stage TopDocs before fetch."""
+        from ..ops import rerank as rerank_ops
+
+        tag, out, t0 = pend
+        if tag == "skip":
+            for j in jobs:
+                if not j.event.is_set():
+                    j.result = ("skip", None, None, 0.0)
+                    j.event.set()
+            return
+        t1 = time.perf_counter()
+        scores, perm = rerank_ops.unpack_rescore(out)
+        self._add_stall(time.perf_counter() - t1)
+        kernel_ms = (time.perf_counter() - t0) * 1000.0
+        for ji, j in enumerate(jobs):
+            if j.event.is_set():
+                continue
+            w = len(j.plan.first)
+            j.result = ("ok", scores[ji][:w], perm[ji][:w], kernel_ms)
             j.event.set()
 
     def _dispatch_knn_group(self, jobs: List[_Job],

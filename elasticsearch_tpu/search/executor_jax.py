@@ -216,6 +216,12 @@ class JaxExecutor:
         # (small segment / budget degrade) so the exact path is chosen
         # without re-locking per batch.
         self._ann_indexes: Dict[tuple, object] = {}
+        # second-stage reranker columns (search/rescorer.py): per-model
+        # shard-level concatenated `rank_vectors` token arrays, built
+        # lazily per executor generation and charged to the `rerank`
+        # HbmLedger category; a build that would not fit DEGRADES TO
+        # SKIP (None cached — the request keeps its first-stage order)
+        self._rerank_columns: Dict[tuple, object] = {}
         self._seg_weights: Dict[Tuple[int, str], np.ndarray] = {}
         self._df_maps: Dict[str, Dict[str, int]] = {}
         self._shard_dfs: Dict[Tuple[str, str], int] = {}
@@ -1808,6 +1814,99 @@ class JaxExecutor:
                 ann_mod.note("small_segment_exact")
             self._ann_indexes[key] = idx
             return idx
+
+    # ---- second-stage rerank column (flat rank_vectors gather arrays) ----
+
+    def rerank_column(self, model):
+        """Device-resident shard-level `rank_vectors` column for one
+        RerankModel: per-doc CSR bounds over the GLOBAL doc encoding
+        (segment-base + local doc — the same bases rescorer.build_plan
+        uses) plus the flat token matrix, tail-padded with `tmax` zero
+        rows so the maxsim gather never reads out of bounds. int8
+        models store quantized rows + per-token scales
+        (models/rerank.quantize_tokens). Charged to the `rerank`
+        HbmLedger category; a build that would not fit degrades to
+        SKIP (returns None — first-stage ranking survives). Cached per
+        executor generation, exactly like the agg tables and IVF
+        indexes."""
+        key = ("rerank", model)
+        if key in self._rerank_columns:
+            return self._rerank_columns[key]
+        with self._build_lock:
+            if key in self._rerank_columns:
+                return self._rerank_columns[key]
+            from ..common.memory import hbm_ledger
+            from ..models import rerank as rerank_model
+
+            n_total = sum(s.num_docs for s in self.reader.segments)
+            starts = np.zeros(max(n_total, 1), np.int32)
+            counts = np.zeros(max(n_total, 1), np.int32)
+            chunks: List[np.ndarray] = []
+            tmax = 1
+            base = 0
+            flat = 0
+            for seg in self.reader.segments:
+                mvf = seg.multi_vectors.get(model.field)
+                n = seg.num_docs
+                if mvf is not None and len(mvf.tok_vectors):
+                    offs = mvf.tok_offsets.astype(np.int64)
+                    starts[base : base + n] = flat + offs[:-1]
+                    counts[base : base + n] = np.diff(offs)
+                    chunks.append(mvf.tok_vectors)
+                    flat += int(offs[-1])
+                    tmax = max(tmax, mvf.max_tokens)
+                base += n
+            dims = int(model.dims) or (
+                int(chunks[0].shape[1]) if chunks else 1
+            )
+            toks_host = (
+                np.concatenate(chunks, axis=0)
+                if chunks
+                else np.zeros((0, dims), np.float32)
+            )
+            pad = np.zeros((tmax, toks_host.shape[1]), toks_host.dtype)
+            toks_host = np.concatenate([toks_host, pad], axis=0)
+            est = (
+                starts.nbytes
+                + counts.nbytes
+                + toks_host.nbytes
+                + (
+                    # int8 twin replaces the f32 rows but adds scales
+                    toks_host.shape[0] * 4
+                    if model.quantized
+                    else 0
+                )
+            )
+            if not hbm_ledger.would_fit(est):
+                # degrade-to-skip: reranking is an optimization of the
+                # ranking, never worth failing (or OOMing) the request
+                hbm_ledger.note_degraded()
+                rerank_model.note("skipped")
+                self._rerank_columns[key] = None
+                return None
+            scales_dev = None
+            if model.quantized:
+                qv, scales = rerank_model.quantize_tokens(toks_host)
+                toks_dev = jax.device_put(qv, self.device)
+                scales_dev = jax.device_put(scales, self.device)
+                nbytes = int(qv.nbytes + scales.nbytes)
+            else:
+                toks_dev = jax.device_put(
+                    toks_host.astype(np.float32), self.device
+                )
+                nbytes = int(toks_host.nbytes)
+            col = {
+                "starts": jax.device_put(starts, self.device),
+                "counts": jax.device_put(counts, self.device),
+                "toks": toks_dev,
+                "scales": scales_dev,
+                "tmax": int(tmax),
+                "dims": int(toks_host.shape[1]),
+                "nbytes": int(nbytes + starts.nbytes + counts.nbytes),
+            }
+            self._charge("rerank", col["nbytes"], False)
+            self._rerank_columns[key] = col
+            return col
 
     # ---- knn (device matmul + global top-k cut) ----
 

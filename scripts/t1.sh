@@ -14,6 +14,9 @@
 # the IVF ANN smoke (scripts/ann_smoke.sh: recall >= 0.95@k=10 vs the
 # exact oracle + bit-for-bit ?exact=true/floor gates always; the >= 5x
 # device-kernel gate always; the >= 5x end-to-end QPS gate on >= 8-core
+# hosts). T1_RERANK=1 additionally runs the second-stage rerank smoke
+# (scripts/rerank_smoke.sh: NDCG@10 >= first-stage + host-oracle parity
+# gates always; the >= 3x device-vs-host-rescore gate on >= 8-core
 # hosts). The combined exit code fails if any enabled run fails.
 set -o pipefail; rm -f /tmp/_t1.log; timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' --continue-on-collection-errors -p no:cacheprovider -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log; rc=${PIPESTATUS[0]}; echo DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' /tmp/_t1.log | tr -cd . | wc -c)
 if [ "${T1_MESH:-0}" = "1" ]; then
@@ -42,5 +45,11 @@ if [ "${T1_ANN:-0}" = "1" ]; then
     bash scripts/ann_smoke.sh
     ann_rc=$?
     [ "$rc" -eq 0 ] && rc=$ann_rc
+fi
+if [ "${T1_RERANK:-0}" = "1" ]; then
+    echo "--- T1_RERANK: second-stage rerank smoke (NDCG + oracle parity) ---"
+    bash scripts/rerank_smoke.sh
+    rerank_rc=$?
+    [ "$rc" -eq 0 ] && rc=$rerank_rc
 fi
 exit $rc

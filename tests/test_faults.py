@@ -501,6 +501,90 @@ class TestBatcherFaults:
             ivf_svc.close()
             exact_svc.close()
 
+    def test_rerank_score_fault_falls_back_to_first_stage(self):
+        """The `rerank.score` site fires on the second-stage maxsim
+        dispatch: an injected error must exercise the deterministic
+        rerank→first-stage-order fallback — the response is the plain
+        (un-rescored) first-stage ranking BIT-FOR-BIT, zero shard
+        failures, `fallbacks` counter bumped; a delay is slow, not
+        wrong."""
+        import numpy as np
+
+        from elasticsearch_tpu.models import rerank as rerank_model
+
+        svc = IndexService(
+            "af-rerank",
+            settings={"number_of_shards": 1, "search.backend": "jax"},
+            mappings_json={"properties": {
+                "body": {"type": "text"},
+                "toks": {"type": "rank_vectors", "dims": 8,
+                         "similarity": "dot_product"},
+            }},
+        )
+        try:
+            rng = np.random.default_rng(7)
+            words = ["alpha beta", "alpha gamma", "beta", "alpha"]
+            for i in range(50):
+                svc.index_doc(str(i), {
+                    "body": words[i % 4],
+                    "toks": rng.normal(size=(2, 8)).round(3).tolist(),
+                })
+            svc.refresh()
+            qv = rng.normal(size=(3, 8)).round(3).tolist()
+            plain_body = {
+                "query": {"match": {"body": "alpha"}}, "size": 10,
+            }
+            body = {
+                **plain_body,
+                "rescore": {
+                    "window_size": 20,
+                    "query": {
+                        "rescore_query": {"rank_vectors": {
+                            "field": "toks", "query_vectors": qv,
+                        }},
+                        "query_weight": 0.0,
+                        "rescore_query_weight": 1.0,
+                    },
+                },
+            }
+            first_stage = [
+                (h["_id"], h["_score"])
+                for h in svc.search(dict(plain_body))["hits"]["hits"]
+            ]
+            rescored = [
+                (h["_id"], h["_score"])
+                for h in svc.search(dict(body))["hits"]["hits"]
+            ]
+            assert rescored != first_stage  # the rerank actually bites
+            # error kind: the request keeps the FIRST-STAGE ranking
+            faults.configure(
+                {"rules": [{"site": "rerank.score", "kind": "error"}]}
+            )
+            before = rerank_model.stats_snapshot()
+            resp = svc.search(dict(body))
+            after = rerank_model.stats_snapshot()
+            got = [
+                (h["_id"], h["_score"]) for h in resp["hits"]["hits"]
+            ]
+            assert got == first_stage  # bit-for-bit first stage
+            assert resp["_shards"]["failed"] == 0
+            assert after["fallbacks"] > before["fallbacks"]
+            # delay kind: slow, not wrong — the rescored answer returns
+            faults.configure(
+                {"rules": [{"site": "rerank.score", "kind": "delay",
+                            "delay_ms": 30}]}
+            )
+            t0 = time.monotonic()
+            resp2 = svc.search(dict(body))
+            assert time.monotonic() - t0 >= 0.03
+            got2 = [
+                (h["_id"], h["_score"]) for h in resp2["hits"]["hits"]
+            ]
+            assert got2 == rescored
+        finally:
+            faults.clear()
+            svc.close()
+
 
 class TestTimeouts:
     # the budget must cover an honest warm shard query on the backend

@@ -1,0 +1,750 @@
+"""TPU-resident second-stage reranking (ISSUE 10): the `rescore` phase
+running late-interaction (ColBERT-style maxsim) scoring on device over
+the fused top-k, before fetch.
+
+Coverage: maxsim kernel parity vs the numpy float oracle (float and
+int8 storage, full + partial windows, every row bucket of the launch
+ladder), hybrid_rrf→rescore end-to-end, mesh-vs-per-shard bit-exact
+parity on the forced 8-device CPU platform, `rerank` ledger release on
+generation bump, HBM degrade-to-skip, brownout window shrink, the
+?rescore=false escape hatch, request-scoped DSL validation, and the
+`rescore` observability block.
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+from elasticsearch_tpu.cluster.indices import IndexService
+from elasticsearch_tpu.models import rerank as rerank_model
+from elasticsearch_tpu.search import dsl, rescorer
+
+DIMS = 8
+
+MAPPINGS = {
+    "properties": {
+        "body": {"type": "text"},
+        "vec": {
+            "type": "dense_vector", "dims": DIMS, "similarity": "cosine",
+        },
+        "toks": {
+            "type": "rank_vectors", "dims": DIMS,
+            "similarity": "dot_product",
+        },
+    }
+}
+
+WORDS = ["alpha beta", "alpha gamma", "beta gamma", "alpha beta gamma"]
+
+
+def make_service(name, backend="jax", shards=1, extra=None):
+    settings = {"number_of_shards": shards, "search.backend": backend}
+    settings.update(extra or {})
+    return IndexService(name, settings=settings, mappings_json=MAPPINGS)
+
+
+def fill(svcs, n=80, seed=3, batches=1):
+    rng = np.random.default_rng(seed)
+    per = -(-n // batches)
+    for b in range(batches):
+        for i in range(b * per, min((b + 1) * per, n)):
+            nt = 1 + i % 4
+            v = rng.normal(size=DIMS)
+            v /= np.linalg.norm(v)
+            doc = {
+                "body": WORDS[i % 4],
+                "vec": [float(x) for x in v],
+                "toks": rng.normal(size=(nt, DIMS)).round(3).tolist(),
+            }
+            for svc in svcs:
+                svc.index_doc(str(i), dict(doc))
+        for svc in svcs:
+            svc.refresh()
+    return rng
+
+
+def qvecs(rng, n_tok=3):
+    return rng.normal(size=(n_tok, DIMS)).round(3).tolist()
+
+
+def rescore_block(qv, window=20, qw=0.5, rw=2.0, field="toks"):
+    return {
+        "window_size": window,
+        "query": {
+            "rescore_query": {
+                "rank_vectors": {"field": field, "query_vectors": qv}
+            },
+            "query_weight": qw,
+            "rescore_query_weight": rw,
+        },
+    }
+
+
+def hit_pairs(resp):
+    return [(h["_id"], h["_score"]) for h in resp["hits"]["hits"]]
+
+
+# ---------------------------------------------------------------------------
+# kernel parity vs the numpy oracle
+# ---------------------------------------------------------------------------
+
+
+class TestMaxsimKernelParity:
+    def _flat_column(self, rng, n_docs, quantized=False):
+        """A synthetic flat rank_vectors column: ragged token counts
+        (incl. token-less docs) in the executor's gather layout."""
+        import jax.numpy as jnp
+
+        counts = rng.integers(0, 5, size=n_docs).astype(np.int32)
+        starts = np.zeros(n_docs, np.int32)
+        np.cumsum(counts[:-1], out=starts[1:])
+        total = int(counts.sum())
+        tmax = max(int(counts.max()), 1)
+        toks = rng.normal(size=(total + tmax, DIMS)).astype(np.float32)
+        toks[total:] = 0.0
+        scales = None
+        toks_dev = jnp.asarray(toks)
+        if quantized:
+            qv8, sc = rerank_model.quantize_tokens(toks)
+            toks_dev = jnp.asarray(qv8)
+            scales = jnp.asarray(sc)
+            host = (qv8, sc)
+        else:
+            host = toks
+        return {
+            "starts": jnp.asarray(starts),
+            "counts": jnp.asarray(counts),
+            "toks": toks_dev,
+            "scales": scales,
+            "host": host,
+            "host_counts": counts,
+            "host_starts": starts,
+            "tmax": tmax,
+            "quantized": quantized,
+        }
+
+    def _oracle(self, col, qtoks, doc):
+        s0 = int(col["host_starts"][doc])
+        c = int(col["host_counts"][doc])
+        if col["quantized"]:
+            qv8, sc = col["host"]
+            return rerank_model.host_maxsim_quantized(
+                qtoks, qv8[s0 : s0 + c], sc[s0 : s0 + c]
+            )
+        return rerank_model.host_maxsim(qtoks, col["host"][s0 : s0 + c])
+
+    @pytest.mark.parametrize("quantized", [False, True])
+    @pytest.mark.parametrize("rows", [1, 4, 8, 16, 32])
+    def test_kernel_parity_every_row_bucket(self, rows, quantized):
+        """Device maxsim+blend+sort vs the numpy float path: every
+        ladder bucket, ragged token counts, partial candidate rows,
+        float AND int8 storage."""
+        from elasticsearch_tpu.ops import rerank as rerank_ops
+
+        rng = np.random.default_rng(17 + rows)
+        n_docs = 120
+        col = self._flat_column(rng, n_docs, quantized=quantized)
+        wb, qb = 16, 4
+        window = 16
+        qtoks = np.zeros((rows, qb, DIMS), np.float32)
+        qvalid = np.zeros((rows, qb), bool)
+        docs = np.zeros((rows, wb), np.int32)
+        first = np.full((rows, wb), -np.inf, np.float32)
+        valid = np.zeros((rows, wb), bool)
+        n_real_rows = max(1, rows - 1)  # one padded row when rows > 1
+        widths = []
+        for r in range(n_real_rows):
+            nq = 1 + r % qb
+            qtoks[r, :nq] = rng.normal(size=(nq, DIMS)).astype(np.float32)
+            qvalid[r, :nq] = True
+            w = wb if r % 2 == 0 else 5  # full + partial windows
+            widths.append(w)
+            picks = rng.choice(n_docs, size=w, replace=False)
+            docs[r, :w] = picks
+            first[r, :w] = np.sort(
+                rng.normal(size=w).astype(np.float32)
+            )[::-1]
+            valid[r, :w] = True
+        out = rerank_ops.maxsim_rescore_batch(
+            qtoks, qvalid, col["starts"], col["counts"], col["toks"],
+            col["scales"], docs, first, valid,
+            0.7, 1.3, col["tmax"], window,
+        )
+        scores, perm = rerank_ops.unpack_rescore(out)
+        for r in range(n_real_rows):
+            w = widths[r]
+            nq = 1 + r % qb
+            blended = np.asarray(
+                [
+                    np.float32(0.7) * first[r, i]
+                    + np.float32(1.3)
+                    * np.float32(
+                        self._oracle(col, qtoks[r, :nq], int(docs[r, i]))
+                    )
+                    for i in range(min(w, window))
+                ]
+            )
+            order = sorted(
+                range(len(blended)), key=lambda i: (-blended[i], i)
+            )
+            exp_scores = list(blended[order]) + list(
+                first[r, min(w, window) : w]
+            )
+            exp_perm = order + list(range(min(w, window), w))
+            got_s = scores[r][: len(exp_scores)]
+            got_p = perm[r][: len(exp_perm)]
+            assert list(got_p) == exp_perm, f"row {r} perm mismatch"
+            np.testing.assert_allclose(
+                got_s, exp_scores, rtol=2e-5, atol=1e-5
+            )
+            # padding (if any) sorts below every real candidate
+            assert not np.isfinite(scores[r][w:]).any()
+
+
+# ---------------------------------------------------------------------------
+# end-to-end: plain search and hybrid rrf
+# ---------------------------------------------------------------------------
+
+
+class TestEndToEnd:
+    def test_match_rescore_device_vs_host_oracle(self):
+        svc = make_service("rr-e2e", "jax")
+        ora = make_service("rr-e2e-np", backend="numpy")
+        try:
+            rng = fill([svc, ora], n=80, batches=2)
+            before = rerank_model.stats_snapshot()
+            for seed in (5, 6, 7):
+                q = np.random.default_rng(seed)
+                body = {
+                    "query": {"match": {"body": "alpha"}},
+                    "size": 10,
+                    "rescore": rescore_block(qvecs(q)),
+                }
+                a = hit_pairs(svc.search(dict(body)))
+                b = hit_pairs(ora.search(dict(body)))
+                assert [i for i, _ in a] == [i for i, _ in b]
+                np.testing.assert_allclose(
+                    [s for _, s in a], [s for _, s in b], rtol=2e-5
+                )
+            after = rerank_model.stats_snapshot()
+            assert after["device_rescores"] >= before["device_rescores"] + 3
+            assert after["host_rescores"] >= before["host_rescores"] + 3
+            assert after["ledger_bytes"] > 0
+        finally:
+            svc.close()
+            ora.close()
+
+    def test_rescore_changes_ranking_and_totals_survive(self):
+        """The second stage actually reorders (the test corpus is built
+        so maxsim disagrees with BM25), and totals/relation are the
+        first stage's — rescoring the window never changes hit
+        counting."""
+        svc = make_service("rr-order", "jax")
+        try:
+            fill([svc], n=60)
+            rng = np.random.default_rng(11)
+            body_plain = {
+                "query": {"match": {"body": "alpha"}}, "size": 10,
+            }
+            plain = svc.search(dict(body_plain))
+            body = {
+                **body_plain,
+                "rescore": rescore_block(qvecs(rng), qw=0.0, rw=1.0),
+            }
+            resc = svc.search(dict(body))
+            assert (
+                resc["hits"]["total"] == plain["hits"]["total"]
+            )
+            assert [h["_id"] for h in resc["hits"]["hits"]] != [
+                h["_id"] for h in plain["hits"]["hits"]
+            ]
+        finally:
+            svc.close()
+
+    def test_hybrid_rrf_rescore_end_to_end(self):
+        """The RAG shape: hybrid bm25+knn rrf fusion → device rerank →
+        fetch. Device path parity vs the numpy oracle, and the rerank
+        job family actually ran (one maxsim launch, counted)."""
+        svc = make_service("rr-rrf", "jax")
+        ora = make_service("rr-rrf-np", backend="numpy")
+        try:
+            rng = fill([svc, ora], n=80)
+            qv = qvecs(rng)
+            vec = rng.normal(size=DIMS)
+            vec /= np.linalg.norm(vec)
+            body = {
+                "retriever": {"rrf": {
+                    "rank_window_size": 40,
+                    "retrievers": [
+                        {"standard": {
+                            "query": {"match": {"body": "alpha"}}
+                        }},
+                        {"knn": {
+                            "field": "vec",
+                            "query_vector": [float(x) for x in vec],
+                            "k": 20, "num_candidates": 40,
+                        }},
+                    ],
+                }},
+                "size": 10,
+                "rescore": rescore_block(qv, window=20, qw=1.0, rw=1.0),
+            }
+            before = rerank_model.stats_snapshot()
+            jobs0 = svc._batcher.stats["rerank_jobs"]
+            a = hit_pairs(svc.search(dict(body)))
+            b = hit_pairs(ora.search(dict(body)))
+            assert [i for i, _ in a] == [i for i, _ in b]
+            np.testing.assert_allclose(
+                [s for _, s in a], [s for _, s in b], rtol=2e-5
+            )
+            after = rerank_model.stats_snapshot()
+            assert after["device_rescores"] > before["device_rescores"]
+            # the maxsim ran as a batcher `rerank` job (the device
+            # step between merge and fetch), not on the host
+            assert svc._batcher.stats["rerank_jobs"] > jobs0
+        finally:
+            svc.close()
+            ora.close()
+
+    def test_int8_index_setting_end_to_end(self):
+        """index.rerank.quantization=int8 serves rescore from the int8
+        twin: same ids at the top (the corpus is spread enough), and
+        scores within quantization distance of the float path."""
+        svc = make_service(
+            "rr-q8", "jax", extra={"rerank.quantization": "int8"}
+        )
+        flt = make_service("rr-q8-f", "jax")
+        try:
+            rng = fill([svc, flt], n=60)
+            body = {
+                "query": {"match": {"body": "alpha"}},
+                "size": 5,
+                "rescore": rescore_block(qvecs(rng), qw=0.0, rw=1.0),
+            }
+            a = hit_pairs(svc.search(dict(body)))
+            b = hit_pairs(flt.search(dict(body)))
+            np.testing.assert_allclose(
+                [s for _, s in a], [s for _, s in b], rtol=0.05,
+                atol=0.05,
+            )
+        finally:
+            svc.close()
+            flt.close()
+
+    def test_multi_shard_rescore_matches_oracle(self):
+        svc = make_service("rr-ms", "jax", shards=2)
+        ora = make_service("rr-ms-np", backend="numpy", shards=2)
+        try:
+            rng = fill([svc, ora], n=90, batches=2)
+            body = {
+                "query": {"match": {"body": "beta"}},
+                "size": 10,
+                "rescore": rescore_block(qvecs(rng)),
+            }
+            a = hit_pairs(svc.search(dict(body)))
+            b = hit_pairs(ora.search(dict(body)))
+            assert [i for i, _ in a] == [i for i, _ in b]
+            np.testing.assert_allclose(
+                [s for _, s in a], [s for _, s in b], rtol=2e-5
+            )
+        finally:
+            svc.close()
+            ora.close()
+
+
+# ---------------------------------------------------------------------------
+# degrade contract: ledger, HBM skip, escape hatches, brownout
+# ---------------------------------------------------------------------------
+
+
+class TestDegradeContract:
+    def test_ledger_release_on_generation_bump_and_close(self):
+        from elasticsearch_tpu.common.memory import hbm_ledger
+
+        svc = make_service("rr-gen", "jax")
+        try:
+            rng = fill([svc], n=60)
+            body = {
+                "query": {"match": {"body": "alpha"}},
+                "size": 5,
+                "rescore": rescore_block(qvecs(rng)),
+            }
+            svc.search(dict(body))
+            bytes0 = hbm_ledger.stats()["by_category"].get("rerank", 0)
+            assert bytes0 > 0
+            # a refresh regenerates the executor; the superseded
+            # column's charge is released, the new generation recharges
+            svc.index_doc("extra", {
+                "body": "alpha",
+                "toks": [[0.1] * DIMS],
+            })
+            svc.refresh()
+            svc.search(dict(body))
+            bytes1 = hbm_ledger.stats()["by_category"].get("rerank", 0)
+            assert bytes1 > 0
+        finally:
+            svc.close()
+        assert hbm_ledger.stats()["by_category"].get("rerank", 0) == 0
+
+    def test_hbm_budget_degrades_to_skip(self):
+        """A rerank column that would not fit the ledger SKIPS the
+        second stage (first-stage ranking, `skipped` + degraded
+        counters) instead of tripping the breaker or failing."""
+        from elasticsearch_tpu.common.memory import hbm_ledger
+
+        svc = make_service("rr-hbm", "jax")
+        try:
+            rng = fill([svc], n=60)
+            qv = qvecs(rng)
+            plain = hit_pairs(svc.search(
+                {"query": {"match": {"body": "alpha"}}, "size": 10}
+            ))
+            old_budget = hbm_ledger.budget
+            try:
+                hbm_ledger.budget = hbm_ledger.used + 64
+                degraded0 = hbm_ledger.stats()["degraded_allocations"]
+                skipped0 = rerank_model.stats_snapshot()["skipped"]
+                resc = hit_pairs(svc.search({
+                    "query": {"match": {"body": "alpha"}},
+                    "size": 10,
+                    "rescore": rescore_block(qv),
+                }))
+                assert resc == plain  # first-stage order, bit-for-bit
+                assert (
+                    hbm_ledger.stats()["degraded_allocations"] > degraded0
+                )
+                assert (
+                    rerank_model.stats_snapshot()["skipped"] > skipped0
+                )
+            finally:
+                hbm_ledger.budget = old_budget
+        finally:
+            svc.close()
+
+    def test_rescore_false_escape_hatch(self):
+        """?rescore=false through the REST layer strips the second
+        stage: the response is the first-stage response."""
+        from elasticsearch_tpu.cluster.service import ClusterService
+        from elasticsearch_tpu.rest.actions import RestActions
+
+        c = ClusterService()
+        try:
+            c.create_index("rr-esc", {
+                "settings": {"search.backend": "jax"},
+                "mappings": MAPPINGS,
+            })
+            idx = c.indices["rr-esc"]
+            rng = np.random.default_rng(3)
+            for i in range(40):
+                idx.index_doc(str(i), {
+                    "body": WORDS[i % 4],
+                    "toks": rng.normal(size=(2, DIMS)).round(3).tolist(),
+                })
+            idx.refresh()
+            actions = RestActions(c)
+            qv = qvecs(rng)
+            body = {
+                "query": {"match": {"body": "alpha"}},
+                "size": 10,
+                "rescore": rescore_block(qv, qw=0.0, rw=1.0),
+            }
+            _, with_rescore = actions.search(
+                dict(body), {"index": "rr-esc"}, {}
+            )
+            _, without = actions.search(
+                dict(body), {"index": "rr-esc"}, {"rescore": ["false"]}
+            )
+            _, plain = actions.search(
+                {"query": {"match": {"body": "alpha"}}, "size": 10},
+                {"index": "rr-esc"}, {},
+            )
+            assert hit_pairs(without) == hit_pairs(plain)
+            assert hit_pairs(with_rescore) != hit_pairs(plain)
+        finally:
+            c.close()
+
+    def test_rerank_mode_off_keeps_first_stage(self):
+        old = os.environ.get("ES_TPU_RERANK")
+        svc = make_service("rr-off", "jax")
+        try:
+            rng = fill([svc], n=40)
+            qv = qvecs(rng)
+            plain = hit_pairs(svc.search(
+                {"query": {"match": {"body": "alpha"}}, "size": 10}
+            ))
+            os.environ["ES_TPU_RERANK"] = "off"
+            skipped0 = rerank_model.stats_snapshot()["skipped"]
+            resc = hit_pairs(svc.search({
+                "query": {"match": {"body": "alpha"}},
+                "size": 10,
+                "rescore": rescore_block(qv, qw=0.0, rw=1.0),
+            }))
+            assert resc == plain
+            assert rerank_model.stats_snapshot()["skipped"] > skipped0
+        finally:
+            if old is None:
+                os.environ.pop("ES_TPU_RERANK", None)
+            else:
+                os.environ["ES_TPU_RERANK"] = old
+            svc.close()
+
+    def test_brownout_tier2_shrinks_rescore_window(self):
+        from elasticsearch_tpu.search.admission import apply_brownout
+
+        body = {
+            "query": {"match": {"body": "alpha"}},
+            "size": 10,
+            "rescore": rescore_block([[0.0] * DIMS], window=100),
+        }
+        out, actions = apply_brownout(dict(body), 2)
+        assert out["rescore"]["window_size"] == 50
+        assert "rescore_window_halved" in actions
+        # the floor: never shrinks below the requested page
+        body["rescore"]["window_size"] = 12
+        out, actions = apply_brownout(dict(body), 2)
+        assert out["rescore"]["window_size"] >= 10
+        # tier 0/1 leave the window alone
+        body["rescore"]["window_size"] = 100
+        out, _ = apply_brownout(dict(body), 1)
+        assert out["rescore"]["window_size"] == 100
+
+
+# ---------------------------------------------------------------------------
+# request-scoped DSL validation (satellite 1)
+# ---------------------------------------------------------------------------
+
+
+class TestValidation:
+    def _body(self, **over):
+        b = {
+            "query": {"match": {"body": "alpha"}},
+            "size": 10,
+            "rescore": rescore_block([[0.0] * DIMS], window=20),
+        }
+        b.update(over)
+        return b
+
+    def test_window_size_below_one_is_400(self):
+        with pytest.raises(dsl.QueryParseError, match="window_size"):
+            rescorer.parse_rescore(
+                self._body(rescore=rescore_block([[0.0] * DIMS], window=0))
+            )
+
+    def test_window_smaller_than_page_is_400(self):
+        with pytest.raises(dsl.QueryParseError, match="window_size"):
+            rescorer.parse_rescore(
+                self._body(
+                    size=30,
+                    rescore=rescore_block([[0.0] * DIMS], window=20),
+                )
+            )
+        # from_ counts toward the page
+        with pytest.raises(dsl.QueryParseError, match="window_size"):
+            rescorer.parse_rescore(
+                {**self._body(), "from": 15}
+            )
+
+    def test_missing_query_is_400(self):
+        with pytest.raises(dsl.QueryParseError, match="query"):
+            rescorer.parse_rescore(
+                self._body(rescore={"window_size": 20})
+            )
+
+    def test_unsupported_rescore_query_is_400(self):
+        with pytest.raises(dsl.QueryParseError, match="rank_vectors"):
+            rescorer.parse_rescore(self._body(rescore={
+                "window_size": 20,
+                "query": {"rescore_query": {"match": {"body": "x"}}},
+            }))
+
+    def test_malformed_vectors_are_400(self):
+        with pytest.raises(dsl.QueryParseError, match="query_vectors"):
+            rescorer.parse_rescore(self._body(rescore={
+                "window_size": 20,
+                "query": {"rescore_query": {"rank_vectors": {
+                    "field": "toks", "query_vectors": [],
+                }}},
+            }))
+        with pytest.raises(dsl.QueryParseError, match="dimension"):
+            rescorer.parse_rescore(self._body(rescore={
+                "window_size": 20,
+                "query": {"rescore_query": {"rank_vectors": {
+                    "field": "toks",
+                    "query_vectors": [[0.0] * 4, [0.0] * 8],
+                }}},
+            }))
+
+    def test_sort_plus_rescore_is_400(self):
+        with pytest.raises(dsl.QueryParseError, match="sort"):
+            rescorer.parse_rescore(self._body(sort=[{"body": "asc"}]))
+
+    def test_unmapped_field_is_400_through_service(self):
+        svc = make_service("rr-val", "jax")
+        try:
+            fill([svc], n=20)
+            with pytest.raises(dsl.QueryParseError, match="rank_vectors"):
+                svc.search(self._body(rescore=rescore_block(
+                    [[0.0] * DIMS], field="nope",
+                )))
+        finally:
+            svc.close()
+
+    def test_rescore_over_scroll_and_pit_is_400(self):
+        from elasticsearch_tpu.cluster.service import ClusterService
+
+        c = ClusterService()
+        try:
+            c.create_index("rr-scroll", {
+                "settings": {"search.backend": "jax"},
+                "mappings": MAPPINGS,
+            })
+            idx = c.indices["rr-scroll"]
+            rng = np.random.default_rng(3)
+            for i in range(10):
+                idx.index_doc(str(i), {
+                    "body": WORDS[i % 4],
+                    "toks": rng.normal(size=(2, DIMS)).round(3).tolist(),
+                })
+            idx.refresh()
+            with pytest.raises(dsl.QueryParseError, match="scroll"):
+                c.create_scroll("rr-scroll", self._body(), "1m")
+            pit = c.open_pit("rr-scroll", "1m")
+            try:
+                with pytest.raises(dsl.QueryParseError, match="scroll"):
+                    c.pit_search({**self._body(), "pit": {"id": pit["id"]}})
+            finally:
+                c.close_pit(pit["id"])
+        finally:
+            c.close()
+
+
+# ---------------------------------------------------------------------------
+# observability
+# ---------------------------------------------------------------------------
+
+
+class TestObservability:
+    def test_nodes_stats_rescore_block(self):
+        from elasticsearch_tpu.cluster.service import ClusterService
+        from elasticsearch_tpu.rest.actions import RestActions
+
+        c = ClusterService()
+        try:
+            c.create_index("rr-stats", {
+                "settings": {"search.backend": "jax"},
+                "mappings": MAPPINGS,
+            })
+            idx = c.indices["rr-stats"]
+            rng = np.random.default_rng(3)
+            for i in range(40):
+                idx.index_doc(str(i), {
+                    "body": WORDS[i % 4],
+                    "toks": rng.normal(size=(2, DIMS)).round(3).tolist(),
+                })
+            idx.refresh()
+            idx.search({
+                "query": {"match": {"body": "alpha"}},
+                "size": 5,
+                "rescore": rescore_block(qvecs(rng)),
+            })
+            actions = RestActions(c)
+            _, resp = actions.nodes_stats(None, {}, {})
+            blk = resp["nodes"]["node-0"]["rescore"]
+            assert set(blk) >= {
+                "device_rescores", "host_rescores", "skipped",
+                "fallbacks", "kernel_ms", "windows", "ledger_bytes",
+                "batched_jobs",
+            }
+            assert blk["device_rescores"] >= 1
+            assert blk["ledger_bytes"] > 0
+            assert blk["batched_jobs"] >= 1
+            assert blk["windows"]  # the window histogram populated
+        finally:
+            c.close()
+
+    def test_rerank_quantization_setting_validation(self):
+        from elasticsearch_tpu.common.settings import (
+            SettingsError,
+            validate_index_settings,
+        )
+
+        out = validate_index_settings(
+            {"rerank.quantization": "int8"}, creating=True
+        )
+        assert out["rerank.quantization"] == "int8"
+        with pytest.raises(SettingsError):
+            validate_index_settings(
+                {"rerank.quantization": "fp4"}, creating=True
+            )
+
+
+# ---------------------------------------------------------------------------
+# mesh SPMD path (forced 8-device CPU platform)
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.mesh
+class TestMeshRerank:
+    def _env(self, value):
+        old = os.environ.get("ES_TPU_MESH")
+        if value is None:
+            os.environ.pop("ES_TPU_MESH", None)
+        else:
+            os.environ["ES_TPU_MESH"] = value
+        return old
+
+    @pytest.mark.parametrize("quantized", [False, True])
+    def test_mesh_rescore_bit_exact_vs_per_shard(self, quantized):
+        """The fused mesh first-stage + local-rerank-before-all_gather
+        step agrees BIT-FOR-BIT with the per-shard path (one live
+        segment per shard — the routing precondition)."""
+        extra = (
+            {"rerank.quantization": "int8"} if quantized else None
+        )
+        svc = make_service(
+            f"rr-mesh-{int(quantized)}", "jax", shards=4, extra=extra
+        )
+        old = self._env("force")
+        try:
+            rng = fill([svc], n=120)
+            bodies = [
+                {
+                    "query": {"match": {"body": w.split()[0]}},
+                    "size": 10,
+                    "rescore": rescore_block(
+                        qvecs(np.random.default_rng(s)), window=20
+                    ),
+                }
+                for s, w in enumerate(WORDS[:3])
+            ]
+            routed0 = svc.mesh_executor().stats["routed"]
+            mesh_hits = [hit_pairs(svc.search(dict(b))) for b in bodies]
+            assert svc.mesh_executor().stats["routed"] > routed0
+            self._env("off")
+            shard_hits = [hit_pairs(svc.search(dict(b))) for b in bodies]
+            assert mesh_hits == shard_hits
+        finally:
+            self._env(old)
+            svc.close()
+
+    def test_mesh_rescore_multi_segment_falls_back(self):
+        """Shards with more than one live segment cannot take the
+        per-entry window fusion — the request must transparently fall
+        back to the per-shard path with identical results."""
+        svc = make_service("rr-mesh-ms", "jax", shards=2)
+        old = self._env("force")
+        try:
+            rng = fill([svc], n=80, batches=2)  # 2 segments per shard
+            body = {
+                "query": {"match": {"body": "alpha"}},
+                "size": 10,
+                "rescore": rescore_block(qvecs(rng), window=20),
+            }
+            a = hit_pairs(svc.search(dict(body)))
+            self._env("off")
+            b = hit_pairs(svc.search(dict(body)))
+            assert a == b
+        finally:
+            self._env(old)
+            svc.close()
