@@ -370,6 +370,12 @@ class IndexService:
         for s in range(n):
             if not self._owns(s):
                 continue
+            if self._torn_transfer(s):
+                # the node died MID-peer-recovery: the shard dir is a
+                # half-copied transfer (the `_recovering` marker is
+                # still present), not a crash-consistent commit — no
+                # engine open may touch it; peer recovery re-wipes it
+                continue
             shard_path = (
                 os.path.join(base_path, str(s)) if base_path is not None else None
             )
@@ -377,6 +383,7 @@ class IndexService:
                 self.mappings, self.analysis, path=shard_path, shard_id=s,
                 primary_term=self._primary_term(s),
                 codec=str(self.settings.get("codec", "default")),
+                **self._durability_opts(),
             )
         # executor cache: shard id → (change_generation, executor)
         self._executors: Dict[int, tuple] = {}
@@ -443,6 +450,66 @@ class IndexService:
     def _primary_term(self, sid: int) -> int:
         e = self._entry(sid)
         return 1 if e is None else e["primary_term"]
+
+    def _needs_peer_recovery(self, sid: int) -> bool:
+        """True when this node's copy is an out-of-sync replica — the
+        shape peer recovery owns end to end (wipe → transfer → install)."""
+        e = self._entry(sid)
+        return (
+            e is not None
+            and e["primary"] not in (None, self.local_node)
+            and self.local_node in e["replicas"]
+            and self.local_node not in e["in_sync"]
+        )
+
+    def _marker_path(self, sid: int) -> Optional[str]:
+        if self.base_path is None:
+            return None
+        return os.path.join(self.base_path, str(sid), "_recovering")
+
+    def _torn_transfer(self, sid: int) -> bool:
+        """True when the shard dir is a half-copied peer-recovery
+        transfer (the `_recovering` marker survives a crash between the
+        wipe and the transfer completing). Unlike a crashed WRITE — the
+        commit protocol keeps those recoverable — a torn transfer is
+        garbage no engine open may touch; peer recovery re-wipes it."""
+        marker = self._marker_path(sid)
+        return marker is not None and os.path.exists(marker)
+
+    def _durability_opts(self) -> dict:
+        """index.translog.* settings → ShardEngine kwargs (previously
+        every engine silently ran at the 'request' default regardless
+        of the index setting)."""
+        from ..search.failures import parse_timeout
+
+        interval = parse_timeout(
+            self.settings.get("translog.sync_interval", "5s")
+        )
+        return {
+            "durability": str(
+                self.settings.get("translog.durability", "request")
+            ),
+            "sync_interval": 5.0 if interval is None else interval,
+        }
+
+    def apply_translog_settings(self) -> None:
+        """Pushes dynamic index.translog.* changes into OPEN engines —
+        the settings are dynamic, so without this a live flip to
+        `request` durability would silently keep the async loss window
+        until the next restart/recovery."""
+        opts = self._durability_opts()
+        for eng in self._local.values():
+            tl = eng.translog
+            if tl is None:
+                continue
+            with eng._lock:
+                if tl.durability != opts["durability"]:
+                    if opts["durability"] == "request":
+                        # close the volatile window at the flip, not at
+                        # the next (fsynced) append
+                        tl.sync()
+                    tl.durability = opts["durability"]
+                tl.sync_interval = opts["sync_interval"]
 
     def _owner(self, sid: int) -> Optional[str]:
         """PRIMARY node id for a shard (write routing), or None in
@@ -558,6 +625,12 @@ class IndexService:
         local = dict(self._local)
         for sid in range(self.num_shards):
             if self._owns(sid) and sid not in local:
+                if self._needs_peer_recovery(sid):
+                    # peer recovery wipes the directory and installs the
+                    # engine itself; opening the leftover (possibly
+                    # half-transferred) files here raced the in-flight
+                    # transfer and could crash the state-apply thread
+                    continue
                 shard_path = (
                     os.path.join(self.base_path, str(sid))
                     if self.base_path is not None
@@ -567,6 +640,7 @@ class IndexService:
                     self.mappings, self.analysis, path=shard_path, shard_id=sid,
                     primary_term=self._primary_term(sid),
                     codec=str(self.settings.get("codec", "default")),
+                    **self._durability_opts(),
                 )
             elif not self._owns(sid) and sid in local:
                 eng = local.pop(sid)
@@ -588,18 +662,14 @@ class IndexService:
 
     def recovery_needed(self) -> List[int]:
         """Locally-assigned replica shards that are not yet in-sync —
-        the set the owning node must peer-recover from their primaries."""
-        out: List[int] = []
-        for sid in self._local:
-            e = self._entry(sid)
-            if (
-                e is not None
-                and e["primary"] not in (None, self.local_node)
-                and self.local_node in e["replicas"]
-                and self.local_node not in e["in_sync"]
-            ):
-                out.append(sid)
-        return out
+        the set the owning node must peer-recover from their primaries.
+        Deliberately NOT keyed off self._local: engines for these copies
+        are no longer opened eagerly (the recovery installs them), so
+        the routing table is the only truth."""
+        return [
+            sid for sid in range(self.num_shards)
+            if self._needs_peer_recovery(sid)
+        ]
 
 
     def local_shard(self, sid: int) -> ShardEngine:
@@ -771,6 +841,26 @@ class IndexService:
         except (FileNotFoundError, json.JSONDecodeError):
             return None
 
+    def _release_serving_resources(self) -> None:
+        """Tears down the process-local serving machinery shared by
+        close() and crash(): batcher threads, the mesh view, the
+        executors' HBM ledger charges (postings, doc values, norms, agg
+        columns, …) — a closed index keeps no device residency; before
+        this, every index close leaked its executors' ledger bytes for
+        the life of the process — and this index's cache entries."""
+        self._batcher.close()
+        if self._mesh is not None:
+            self._mesh.close()
+        with self._executor_lock:
+            execs, self._executors = dict(self._executors), {}
+        for _gen, ex in execs.values():
+            if hasattr(ex, "close"):
+                ex.close()
+        from ..search.query_cache import filter_cache, request_cache
+
+        filter_cache.clear([self.uuid])
+        request_cache.clear([self.uuid])
+
     def close(self) -> None:
         # flushAndClose semantics (InternalEngine.close): make everything
         # durable, trim the WAL, persist metadata. Only local shards —
@@ -780,23 +870,21 @@ class IndexService:
         self._persist_meta()
         for s in self.shards:
             s.close()
-        self._batcher.close()
-        if self._mesh is not None:
-            self._mesh.close()
-        # release the executors' HBM ledger charges (postings, doc
-        # values, norms, agg columns, …): a closed index keeps no
-        # device residency — before this, every index close leaked its
-        # executors' ledger bytes for the life of the process
-        with self._executor_lock:
-            execs, self._executors = dict(self._executors), {}
-        for _gen, ex in execs.values():
-            if hasattr(ex, "close"):
-                ex.close()
-        # drop this index's cache entries (and their ledger charges)
-        from ..search.query_cache import filter_cache, request_cache
+        self._release_serving_resources()
 
-        filter_cache.clear([self.uuid])
-        request_cache.clear([self.uuid])
+    def crash(self) -> None:
+        """Simulated power loss for the whole index (durability
+        harness): engines are abandoned WITHOUT flush/close — their
+        translogs drop any acked-but-unfsynced tail — while the
+        process-local serving machinery a dead box takes with it anyway
+        is still released so the surviving test process stays hermetic.
+        Disk state is exactly what a dead box would leave behind."""
+        for s in self.shards:
+            try:
+                s.crash()
+            except Exception:
+                pass
+        self._release_serving_resources()
 
     def clear_caches(self, query: bool = True, request: bool = True) -> int:
         """POST {index}/_cache/clear: drops this index's filter-bitset
@@ -3098,6 +3186,13 @@ class IndexService:
             import shutil
 
             shutil.rmtree(shard_path, ignore_errors=True)
+        # the `_recovering` marker makes a crash mid-transfer detectable:
+        # until finish_peer_recovery removes it, the directory contents
+        # are a half-copied transfer no engine open may trust
+        os.makedirs(shard_path, exist_ok=True)
+        with open(os.path.join(shard_path, "_recovering"), "w",
+                  encoding="utf-8") as f:
+            f.write(self.local_node or "")
         return shard_path
 
     def finish_peer_recovery(self, sid: int) -> ShardEngine:
@@ -3108,10 +3203,18 @@ class IndexService:
             if self.base_path is not None
             else None
         )
+        if shard_path is not None:
+            # the transfer is complete: the directory now holds a copy
+            # of the primary's crash-consistent commit, safe to open
+            try:
+                os.remove(os.path.join(shard_path, "_recovering"))
+            except OSError:
+                pass
         eng = ShardEngine(
             self.mappings, self.analysis, path=shard_path, shard_id=sid,
             primary_term=self._primary_term(sid),
             codec=str(self.settings.get("codec", "default")),
+            **self._durability_opts(),
         )
         local = dict(self._local)
         local[sid] = eng

@@ -36,7 +36,9 @@ import uuid as _uuidlib
 from typing import Any, Dict, List, Optional, Tuple
 
 from ..common import deep_merge
+from ..common.faults import InjectedFault, faults
 from ..common.settings import SettingsError, validate_index_settings
+from ..index.translog import bump_durability_stat
 from ..index.mapping import MappingParseError, Mappings
 from .indices import (
     ACTION_CTX_CLOSE,
@@ -222,6 +224,7 @@ class DistributedClusterService(ClusterService):
                     if not k.startswith("analysis.")
                 }
                 idx.settings.update(flat)
+                idx.apply_translog_settings()
                 idx.apply_routing(routing)
             needs = idx.recovery_needed()
             if needs:
@@ -458,6 +461,28 @@ class TpuNode:
             self._fd_thread.join(timeout=5.0)
         self.cluster.close()
         self.transport.close()
+
+    def crash(self):
+        """Simulated power loss: the counterpart of close() for the
+        durability harness. Engines are abandoned WITHOUT flush/close
+        (their translogs drop any acked-but-unfsynced tail, no manifest
+        is written, no WAL is trimmed), while the process-local pieces a
+        dead box takes with it anyway — transport, fd loop, batcher
+        threads, device ledger charges — are torn down so the surviving
+        test process stays hermetic. Restarting a node on the same
+        data_path afterwards exercises the real recovery path."""
+        if self._closed:
+            return
+        self._closed = True
+        self._fd_stop.set()
+        if self._fd_thread is not None:
+            self._fd_thread.join(timeout=5.0)
+        self.transport.close()
+        for idx in list(self.cluster.indices.values()):
+            try:
+                idx.crash()
+            except Exception:
+                pass
 
     @property
     def address(self) -> Tuple[str, int]:
@@ -950,6 +975,13 @@ class TpuNode:
             )
         local_seq = int(p["local_seq"])
         with eng._lock:
+            # at-least-once delivery: a re-delivered finalize (the target
+            # retried after a dropped ack) is answered idempotently — the
+            # tracked set is a set, the ops diff is recomputed, and the
+            # target's seqno dedup no-ops the replay. Count it so the
+            # stats block makes redeliveries visible.
+            if p["target"] in idx._tracked.get(sid, set()):
+                bump_durability_stat("finalize_redelivered")
             idx.add_tracked(sid, p["target"])
             ops: List[dict] = []
             for doc_id, ve in eng._versions.items():
@@ -994,16 +1026,27 @@ class TpuNode:
     def _run_recoveries(self, index_name: str, sids: List[int]):
         for sid in sids:
             try:
-                self._recover_shard(index_name, sid)
-            except Exception:
-                # a failed recovery leaves the copy out of the in-sync
-                # set; the next routing change re-triggers it
-                pass
+                # a transient failure (primary briefly unreachable, an
+                # injected recovery.transfer fault) used to strand the
+                # copy out of the in-sync set until the NEXT routing
+                # change; retry in place first
+                for attempt in range(3):
+                    try:
+                        self._recover_shard(index_name, sid,
+                                            first_attempt=attempt == 0)
+                        break
+                    except Exception:
+                        if attempt == 2 or self._closed:
+                            bump_durability_stat("recoveries_failed")
+                            break
+                        bump_durability_stat("recovery_retries")
+                        time.sleep(0.2)
             finally:
                 with self._recovery_lock:
                     self._recovering.discard((index_name, sid))
 
-    def _recover_shard(self, index_name: str, sid: int):
+    def _recover_shard(self, index_name: str, sid: int,
+                       first_attempt: bool = True):
         idx = self.cluster.indices.get(index_name)
         if idx is None:
             return
@@ -1015,6 +1058,16 @@ class TpuNode:
         ):
             return
         primary = entry["primary"]
+        if first_attempt:
+            # retries of the same recovery are counted in
+            # recovery_retries, not as fresh starts — so the lifecycle
+            # invariant started == completed + failed holds
+            bump_durability_stat("recoveries_started")
+        # phase-1 transfer failing (network, primary mid-restart, an
+        # injected fault) must leave the copy OUT of the in-sync set —
+        # the retry loop / next routing change re-runs the whole phase
+        faults.check("recovery.transfer", index=index_name, shard=sid,
+                     node=self.name)
         out = self.remote_call(
             primary,
             "internal:recovery/start",
@@ -1029,7 +1082,10 @@ class TpuNode:
                 os.makedirs(os.path.dirname(full), exist_ok=True)
                 with open(full, "wb") as f:
                     f.write(base64.b64decode(b64))
+            bump_durability_stat("recovered_files", len(out["files"]))
         eng = idx.finish_peer_recovery(sid)
+        faults.check("recovery.finalize", index=index_name, shard=sid,
+                     node=self.name)
         fin = self.remote_call(
             primary,
             "internal:recovery/finalize",
@@ -1047,7 +1103,9 @@ class TpuNode:
                 )
             else:
                 eng.delete_replica(op["id"], op["version"], op["seq_no"])
+        bump_durability_stat("recovered_ops", len(fin["ops"]))
         eng.refresh()
+        bump_durability_stat("recoveries_completed")
         # the started report must land — a swallowed failure would strand
         # a fully-recovered copy out of the in-sync set forever (the fd
         # loop's lag repair resends the same version, which the monotonic
@@ -1429,6 +1487,12 @@ class TpuNode:
         if rops:
             for target in idx.replica_targets(sid):
                 try:
+                    # a replica dying mid-replication is indistinguishable
+                    # from a dropped connection: InjectedFault here rides
+                    # the same handling as a real transport failure (the
+                    # copy leaves the in-sync set — never silent divergence)
+                    faults.check("replica.replicate", index=p["index"],
+                                 shard=sid, target=target)
                     self.remote_call(
                         target,
                         ACTION_SHARD_REPLICA_OPS,
@@ -1439,7 +1503,8 @@ class TpuNode:
                          # the promotion's cluster state
                          "primary_term": eng.primary_term},
                     )
-                except (TransportError, NodeError, ClusterError) as e:
+                except (TransportError, NodeError, ClusterError,
+                        InjectedFault) as e:
                     if STALE_PRIMARY_MARKER in str(e):
                         # the REPLICA fenced US as stale: the failure is
                         # ours, not the (likely promoted) target's —

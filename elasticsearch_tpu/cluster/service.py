@@ -259,6 +259,7 @@ class ClusterService:
             except SettingsError as e:
                 raise ClusterError(400, str(e), "illegal_argument_exception")
             idx.settings.update(validated)
+            idx.apply_translog_settings()
             self.version += 1
             self._persist()
             idx._persist_meta()

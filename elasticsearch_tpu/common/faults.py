@@ -38,6 +38,29 @@ Schedule shape (env `ES_TPU_FAULTS`, or `POST /_internal/faults`):
     the deterministic rerank→first-stage-order fallback (the request
     keeps its first-stage ranking bit-for-bit and the `fallbacks`
     counter increments), delay kind the slow-not-wrong contract)
+
+  Write-path sites (the durability mirror of the read-path list; the
+  crash-matrix harness in index/crashpoints.py + tests/test_durability.py
+  drives every one of them with the ``crash`` kind):
+  - ``translog.append``     (per WAL record, BEFORE the bytes reach the
+    log — ctx carries shard/gen/seq_no/op; a ``crash`` rule here with
+    ``"torn": true`` leaves a PARTIAL record on disk, the torn-tail
+    shape recovery must truncate)
+  - ``translog.fsync``      (inside Translog.sync, BEFORE the pending
+    tail is written+fsynced — a crash here loses exactly the
+    acked-but-unsynced window of `async` durability)
+  - ``engine.refresh``      (segment build from the indexing buffer)
+  - ``engine.flush``        (durable commit — ctx carries shard and a
+    ``stage`` of start | pre_manifest | post_manifest, bracketing the
+    segment-persist / manifest-replace / translog-trim windows)
+  - ``engine.merge``        (segment-count merge rebuild)
+  - ``replica.replicate``   (primary→replica write fan-out, per target —
+    ctx index/shard/target; error kind proves the failed copy leaves
+    the in-sync set instead of silently diverging)
+  - ``recovery.transfer``   (peer-recovery phase 1 file copy, target
+    side — ctx index/shard/node)
+  - ``recovery.finalize``   (peer-recovery phase 2 ops replay, target
+    side — ctx index/shard/node)
 * ``match``: exact-equality filters over the ctx kwargs the site passes
   (string-compared, so {"shard": 1} matches shard=1).
 * ``kind``: ``error`` (raise InjectedFault, 500-shaped), ``drop``
@@ -48,13 +71,22 @@ Schedule shape (env `ES_TPU_FAULTS`, or `POST /_internal/faults`):
   returned to the caller as a SYNTHETIC queue-pressure sample —
   `check` returns ``{"load_ms": N}`` — so overload schedules replay
   deterministically without real queue contention; only the
-  admission site consumes it today).
+  admission site consumes it today), ``crash`` (raise SimulatedCrash —
+  a BaseException, so no production `except Exception` handler can
+  "handle" a power loss; the harness catches it, tears the
+  engine/node down WITHOUT running close/flush paths, and reopens
+  from disk. ``"torn": true`` on the rule additionally asks the site
+  to leave a partial write of the in-flight record behind — only
+  ``translog.append`` honors it today).
 * ``prob``: trip probability (default 1.0). Draws are a pure hash of
   (seed, rule index, site, ctx, per-ctx attempt counter) — NOT a
   sequential RNG — so the schedule is deterministic regardless of
   thread interleaving across the fan-out, and a replica retry of the
   same shard re-draws with attempt+1 instead of being auto-doomed.
 * ``times``: cap on total trips for the rule (unlimited when absent).
+* ``skip``: deterministic onset — the first N matching draws do not
+  trip (with ``times: 1`` this reads "crash exactly at the (N+1)th
+  append/fsync/flush", the lever the crash matrix steers with).
 
 The registry is intentionally process-global (like the settings
 registries): tests and the `/_internal/faults` hook arm/clear it.
@@ -89,10 +121,27 @@ class InjectedFault(Exception):
         self.status = status
 
 
+class SimulatedCrash(BaseException):
+    """Deterministic power loss injected by a ``crash`` rule.
+
+    Deliberately a BaseException: production code paths catch Exception
+    liberally (fallbacks, retries, recovery loops) and none of them may
+    "survive" a power loss — the crash must unwind all the way to the
+    harness, which tears the engine/node down without running any
+    close/flush path and then reopens from disk. ``torn`` asks the
+    injection site to leave a partial write of the in-flight record
+    behind (a torn tail) before unwinding."""
+
+    def __init__(self, reason: str, torn: bool = False):
+        super().__init__(reason)
+        self.reason = reason
+        self.torn = torn
+
+
 class _Rule:
     __slots__ = (
         "index", "site", "match", "kind", "prob", "times", "delay_ms",
-        "trips", "attempts",
+        "torn", "skip", "trips", "attempts",
     )
 
     def __init__(self, index: int, spec: dict):
@@ -102,7 +151,7 @@ class _Rule:
             str(k): str(v) for k, v in (spec.get("match") or {}).items()
         }
         kind = str(spec.get("kind", "error"))
-        if kind not in ("error", "drop", "delay", "stall", "load"):
+        if kind not in ("error", "drop", "delay", "stall", "load", "crash"):
             raise ValueError(f"unknown fault kind [{kind}]")
         self.kind = kind
         self.prob = float(spec.get("prob", 1.0))
@@ -110,6 +159,11 @@ class _Rule:
         if self.times is not None:
             self.times = int(self.times)
         self.delay_ms = float(spec.get("delay_ms", 100.0))
+        self.torn = bool(spec.get("torn", False))
+        # deterministic onset: the first `skip` matching (and
+        # probability-passing) draws do NOT trip — "crash at the Nth
+        # append", the lever the write-path crash matrix steers with
+        self.skip = int(spec.get("skip", 0))
         self.trips = 0
         self.attempts = 0
 
@@ -129,6 +183,8 @@ class _Rule:
             "prob": self.prob,
             "times": self.times,
             "delay_ms": self.delay_ms,
+            "torn": self.torn,
+            "skip": self.skip,
             "trips": self.trips,
             "attempts": self.attempts,
         }
@@ -191,7 +247,7 @@ class FaultRegistry:
             return None
         sleep_ms = 0.0
         load_ms = 0.0
-        boom: Optional[InjectedFault] = None
+        boom: Optional[BaseException] = None
         with self._lock:
             sig = _ctx_sig(ctx)
             for rule in self._rules:
@@ -207,11 +263,20 @@ class FaultRegistry:
                     self._draw(rule, site, sig, attempt) >= rule.prob
                 ):
                     continue
+                if rule.skip > 0:
+                    rule.skip -= 1
+                    continue
                 rule.trips += 1
                 if rule.kind in ("delay", "stall"):
                     sleep_ms = max(sleep_ms, rule.delay_ms)
                 elif rule.kind == "load":
                     load_ms = max(load_ms, rule.delay_ms)
+                elif rule.kind == "crash":
+                    boom = SimulatedCrash(
+                        f"simulated crash at [{site}] ({sig})",
+                        torn=rule.torn,
+                    )
+                    break
                 elif rule.kind == "drop":
                     boom = InjectedFault(
                         f"injected connection drop at [{site}] ({sig})",

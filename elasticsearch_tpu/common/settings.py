@@ -357,7 +357,15 @@ INDEX_SETTINGS: Dict[str, Setting] = {
         Setting("search.backend", "jax", INDEX_SCOPE, dynamic=False),
         Setting("max_result_window", 10000, INDEX_SCOPE, parser=int,
                 validator=_positive("max_result_window")),
-        Setting("translog.durability", "request", INDEX_SCOPE),
+        # write durability (index/translog.py): "request" fsyncs the WAL
+        # before every ack; "async" bounds the acked-but-volatile window
+        # to translog.sync_interval (the crash matrix in
+        # tests/test_durability.py proves both contracts)
+        Setting("translog.durability", "request", INDEX_SCOPE,
+                validator=_one_of("translog.durability",
+                                  ("request", "async"))),
+        Setting("translog.sync_interval", "5s", INDEX_SCOPE,
+                parser=_parse_time),
         Setting("merge.policy.max_segments", 8, INDEX_SCOPE, parser=int,
                 validator=_positive("merge.policy.max_segments")),
         Setting("knn.quantization", "none", INDEX_SCOPE),

@@ -19,6 +19,7 @@ model, which is also what keeps device-resident postings immutable.
 
 from __future__ import annotations
 
+import json
 import os
 import shutil
 import threading
@@ -29,10 +30,16 @@ from typing import Dict, List, Optional, Tuple
 import numpy as np
 
 from ..analysis import AnalysisRegistry
+from ..common.faults import faults
 from ..search.executor import ShardReader
 from .mapping import DocumentParser, Mappings
 from .segment import Segment, SegmentBuilder
-from .translog import DURABILITY_REQUEST, Translog
+from .translog import (
+    DEFAULT_SYNC_INTERVAL,
+    DURABILITY_REQUEST,
+    Translog,
+    bump_durability_stat,
+)
 
 
 class EngineError(Exception):
@@ -77,6 +84,7 @@ class ShardEngine:
         path: Optional[str] = None,
         shard_id: int = 0,
         durability: str = DURABILITY_REQUEST,
+        sync_interval: float = DEFAULT_SYNC_INTERVAL,
         primary_term: int = 1,
         codec: str = "default",
     ):
@@ -123,7 +131,7 @@ class ShardEngine:
         self.translog: Optional[Translog] = None
         if path is not None:
             os.makedirs(path, exist_ok=True)
-            self._recover(durability)
+            self._recover(durability, sync_interval)
 
     # ------------------------------------------------------------------
     # write path (InternalEngine.index / delete)
@@ -319,6 +327,9 @@ class ShardEngine:
         """Builds a new segment from the buffer; returns True if one was
         created or deletes were applied."""
         with self._lock:
+            # crash here = power loss with the buffer un-refreshed: the
+            # translog already holds every acked op, so recovery replays
+            faults.check("engine.refresh", shard=self.shard_id)
             changed = False
             # apply deletes/updates to older segments via live_docs bits
             stale = list(self._buffer) + list(self._buffered_deletes)
@@ -386,6 +397,7 @@ class ShardEngine:
         A power loss at any step leaves either the old commit (all its
         files untouched) or the new one (all its files durable)."""
         with self._lock:
+            faults.check("engine.flush", shard=self.shard_id, stage="start")
             self.refresh()
             self.op_stats["flush_total"] += 1
             if self.path is None:
@@ -408,7 +420,25 @@ class ShardEngine:
             for si, seg in enumerate(self.segments):
                 name = self.seg_names[si]
                 seg_dir = os.path.join(self.path, name)
-                if not os.path.exists(os.path.join(seg_dir, "segment.json")):
+                sentinel = os.path.join(seg_dir, "segment.json")
+                if os.path.exists(sentinel):
+                    # a crashed earlier flush can leave a SAME-NAMED dir
+                    # holding a different segmentation (recovery rebuilds
+                    # the replayed buffer as one segment, reusing low
+                    # indices) — committing the manifest over the stale
+                    # dir would silently lose acked docs. Verify the
+                    # sentinel actually describes THIS segment; torn or
+                    # mismatched dirs are quarantined and rewritten.
+                    try:
+                        with open(sentinel, encoding="utf-8") as f:
+                            ondisk = json.load(f)
+                        stale = int(ondisk.get("num_docs", -1)) != seg.num_docs
+                    except (OSError, ValueError):
+                        stale = True
+                    if stale:
+                        shutil.rmtree(seg_dir, ignore_errors=True)
+                        bump_durability_stat("quarantined_segments")
+                if not os.path.exists(sentinel):
                     # sidecars FIRST: segment.json is the "segment fully
                     # persisted" sentinel (checked above), so everything
                     # it references must be durable before seg.save
@@ -444,8 +474,10 @@ class ShardEngine:
                 "max_seq_no": committed_seq,
                 "primary_term": self.primary_term,
             }
-            import json
-
+            # every segment file is durable but the commit point is not:
+            # a crash here must recover the PREVIOUS commit + WAL replay
+            faults.check("engine.flush", shard=self.shard_id,
+                         stage="pre_manifest")
             tmp = os.path.join(self.path, "manifest.json.tmp")
             with open(tmp, "w", encoding="utf-8") as f:
                 json.dump(manifest, f)
@@ -453,6 +485,11 @@ class ShardEngine:
                 os.fsync(f.fileno())
             os.replace(tmp, os.path.join(self.path, "manifest.json"))
             fsync_dir(self.path)
+            # the commit is durable; the translog is not yet trimmed — a
+            # crash here recovers from the NEW commit (replay skips ops
+            # its max_seq_no covers) and the next flush re-trims
+            faults.check("engine.flush", shard=self.shard_id,
+                         stage="post_manifest")
             self.committed_seq_no = committed_seq
             self._merge_uncommitted = False
             if self.translog is not None:
@@ -496,6 +533,9 @@ class ShardEngine:
         with self._lock:
             if len(self.segments) <= max_segments:
                 return False
+            # crash here = power loss mid-merge: nothing on disk moved
+            # yet (the merge result only becomes durable at flush)
+            faults.check("engine.merge", shard=self.shard_id)
             builder = SegmentBuilder(self.mappings)
             versions: List[int] = []
             seqnos: List[int] = []
@@ -528,15 +568,45 @@ class ShardEngine:
     # recovery (open an existing shard directory)
     # ------------------------------------------------------------------
 
-    def _recover(self, durability: str) -> None:
+    def _recover(self, durability: str,
+                 sync_interval: float = DEFAULT_SYNC_INTERVAL) -> None:
         assert self.path is not None
-        import json
 
         manifest_path = os.path.join(self.path, "manifest.json")
+        # a crash between the manifest tmp-write and its os.replace
+        # leaves manifest.json.tmp behind; remove it before anything
+        # else can mistake it for state
+        tmp_manifest = manifest_path + ".tmp"
+        if os.path.exists(tmp_manifest):
+            try:
+                os.remove(tmp_manifest)
+                bump_durability_stat("orphan_manifests_removed")
+            except OSError:
+                pass
         committed_seq = -1
+        manifest = None
         if os.path.exists(manifest_path):
             with open(manifest_path, encoding="utf-8") as f:
                 manifest = json.load(f)
+        # quarantine segment directories the commit does NOT reference:
+        # they are partially-written leftovers of a crashed flush. Left
+        # in place, a post-replay flush could collide with a stale
+        # same-named dir and commit a manifest over the WRONG bytes —
+        # the replayed ops re-materialize their docs, so deleting the
+        # orphans loses nothing.
+        referenced = set()
+        if manifest is not None:
+            for entry in manifest["segments"]:
+                referenced.add(entry if isinstance(entry, str)
+                               else entry["name"])
+        for fname in os.listdir(self.path):
+            full = os.path.join(self.path, fname)
+            if not os.path.isdir(full) or fname == "translog":
+                continue
+            if fname not in referenced:
+                shutil.rmtree(full, ignore_errors=True)
+                bump_durability_stat("quarantined_segments")
+        if manifest is not None:
             self.committed_generation = manifest["generation"]
             committed_seq = manifest["max_seq_no"]
             self.primary_term = manifest.get("primary_term", self.primary_term)
@@ -575,7 +645,10 @@ class ShardEngine:
         self.committed_seq_no = committed_seq
         self._next_seq = committed_seq + 1
         self.translog = Translog(
-            os.path.join(self.path, "translog"), durability=durability
+            os.path.join(self.path, "translog"),
+            durability=durability,
+            sync_interval=sync_interval,
+            shard_id=self.shard_id,
         )
         # replay the translog tail (ops newer than the commit)
         replayed = 0
@@ -594,6 +667,8 @@ class ShardEngine:
                 self._buffered_deletes[doc_id] = entry
             replayed += 1
         if replayed:
+            bump_durability_stat("replayed_ops", replayed)
+            bump_durability_stat("tail_replays")
             self.refresh()
 
     # ------------------------------------------------------------------
@@ -624,7 +699,37 @@ class ShardEngine:
     def max_seq_no(self) -> int:
         return self._next_seq - 1
 
+    def translog_stats(self) -> dict:
+        """The per-shard slice of the `_nodes/stats` translog block."""
+        with self._lock:
+            out = {
+                "uncommitted_ops": max(
+                    0, (self._next_seq - 1) - self.committed_seq_no
+                ),
+                "uncommitted_bytes": 0,
+                "last_fsync_age_ms": None,
+                "pending_ops": 0,
+                "durability": None,
+            }
+            if self.translog is not None:
+                tl = self.translog.stats()
+                out["uncommitted_bytes"] = tl["uncommitted_bytes"]
+                out["last_fsync_age_ms"] = tl["last_fsync_age_ms"]
+                out["pending_ops"] = tl["pending_ops"]
+                out["durability"] = tl["durability"]
+            return out
+
     def close(self) -> None:
         with self._lock:
             if self.translog is not None:
                 self.translog.close()
+
+    def crash(self) -> None:
+        """Simulated power loss (the durability harness's teardown): NO
+        flush, NO refresh, NO translog sync — the translog drops its
+        acked-but-unfsynced tail exactly like the page cache on a dead
+        box, and the in-memory state is abandoned. Reopening the same
+        path afterwards exercises the real recovery path."""
+        with self._lock:
+            if self.translog is not None:
+                self.translog.crash()

@@ -694,6 +694,52 @@ class RestActions:
             for idx in self.cluster.indices.values()
             if getattr(idx, "_batcher", None) is not None
         )
+        # write-path durability counters (index/translog.py): live
+        # uncommitted WAL state aggregated over local shards, plus the
+        # process-wide hygiene/recovery counters (torn tails truncated,
+        # orphan checkpoint/manifest cleanup, WAL replays, quarantined
+        # segment dirs, peer-recovery lifecycle)
+        from ..index.translog import durability_stats_snapshot
+
+        dur = durability_stats_snapshot()
+        translog_block = {
+            "uncommitted_ops": 0,
+            "uncommitted_bytes": 0,
+            "pending_unsynced_ops": 0,
+            "last_fsync_age_ms": 0.0,
+            "fsyncs": dur["translog_fsyncs"],
+            "appended_ops": dur["translog_appended_ops"],
+            "torn_tails_truncated": dur["torn_tails_truncated"],
+            "torn_bytes_dropped": dur["torn_bytes_dropped"],
+            "orphan_checkpoints_removed": dur["orphan_checkpoints_removed"],
+            "stale_generations_removed": dur["stale_generations_removed"],
+        }
+        for idx in self.cluster.indices.values():
+            for eng in getattr(idx, "_local", {}).values():
+                ts = eng.translog_stats()
+                translog_block["uncommitted_ops"] += ts["uncommitted_ops"]
+                translog_block["uncommitted_bytes"] += ts["uncommitted_bytes"]
+                translog_block["pending_unsynced_ops"] += ts["pending_ops"]
+                if ts["last_fsync_age_ms"] is not None:
+                    translog_block["last_fsync_age_ms"] = max(
+                        translog_block["last_fsync_age_ms"],
+                        ts["last_fsync_age_ms"],
+                    )
+        recovery_block = {
+            "replayed_ops": dur["replayed_ops"],
+            "tail_replays": dur["tail_replays"],
+            "quarantined_segments": dur["quarantined_segments"],
+            "orphan_manifests_removed": dur["orphan_manifests_removed"],
+            "peer": {
+                "started": dur["recoveries_started"],
+                "completed": dur["recoveries_completed"],
+                "failed": dur["recoveries_failed"],
+                "retries": dur["recovery_retries"],
+                "files": dur["recovered_files"],
+                "ops": dur["recovered_ops"],
+                "finalize_redelivered": dur["finalize_redelivered"],
+            },
+        }
         return 200, {
             "cluster_name": self.cluster.cluster_name,
             "nodes": {
@@ -731,6 +777,8 @@ class RestActions:
                     "aggs": aggs_block,
                     "knn": knn_block,
                     "rescore": rescore_block,
+                    "translog": translog_block,
+                    "recovery": recovery_block,
                     # overload-protection block (search/admission.py):
                     # per-tenant queue depths, the adaptive concurrency
                     # limit, pressure tier, shed/brownout/retry-budget

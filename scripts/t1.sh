@@ -17,7 +17,12 @@
 # hosts). T1_RERANK=1 additionally runs the second-stage rerank smoke
 # (scripts/rerank_smoke.sh: NDCG@10 >= first-stage + host-oracle parity
 # gates always; the >= 3x device-vs-host-rescore gate on >= 8-core
-# hosts). The combined exit code fails if any enabled run fails.
+# hosts). T1_DURABILITY=1 additionally runs the write-path crash smoke
+# (scripts/durability_smoke.sh: seeded 10% crash schedule over every
+# write-path fault site, zero acked-loss under request durability,
+# fsync-bounded loss under async, primary/replica checksum convergence
+# across a node crash+restart). The combined exit code fails if any
+# enabled run fails.
 set -o pipefail; rm -f /tmp/_t1.log; timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' --continue-on-collection-errors -p no:cacheprovider -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log; rc=${PIPESTATUS[0]}; echo DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' /tmp/_t1.log | tr -cd . | wc -c)
 if [ "${T1_MESH:-0}" = "1" ]; then
     echo "--- T1_MESH: mesh-marked tests on the forced 8-device host platform ---"
@@ -51,5 +56,11 @@ if [ "${T1_RERANK:-0}" = "1" ]; then
     bash scripts/rerank_smoke.sh
     rerank_rc=$?
     [ "$rc" -eq 0 ] && rc=$rerank_rc
+fi
+if [ "${T1_DURABILITY:-0}" = "1" ]; then
+    echo "--- T1_DURABILITY: write-path crash smoke (acked-loss + convergence gates) ---"
+    bash scripts/durability_smoke.sh
+    dur_rc=$?
+    [ "$rc" -eq 0 ] && rc=$dur_rc
 fi
 exit $rc

@@ -1,0 +1,802 @@
+"""Crash-consistency tests for the write path (the durability mirror of
+test_faults.py's read-path coverage).
+
+The crash matrix (index/crashpoints.py) drives a scripted workload —
+bulk index / update / delete / CAS + refresh + flush + merge — into a
+deterministic ``crash``-kind fault at EVERY write-path site, tears the
+engine down without running close/flush (SimulatedCrash escapes every
+`except Exception`), reopens through the real recovery path, and
+asserts:
+
+* `request` durability never loses an acked op;
+* `async` loss is bounded by the last completed fsync (and the
+  sync_interval clock bounds how stale that fsync can be);
+* recovery always terminates consistent — no torn segment/manifest
+  state, WAL tails truncated at the corruption, and the recovered
+  reader serves float-exact jax-vs-numpy results;
+* crashed primaries and their replicas converge checksum-identical
+  after peer recovery.
+"""
+
+import json
+import os
+import time
+
+import pytest
+
+from elasticsearch_tpu.analysis import AnalysisRegistry
+from elasticsearch_tpu.cluster.node import TpuNode
+from elasticsearch_tpu.common.faults import SimulatedCrash, faults
+from elasticsearch_tpu.index.crashpoints import (
+    ENGINE_CRASH_SITES,
+    WORKLOAD_MAPPING,
+    AckLedger,
+    engine_state_checksum,
+    run_engine_crash_case,
+    run_workload,
+)
+from elasticsearch_tpu.index.engine import ShardEngine
+from elasticsearch_tpu.index.mapping import Mappings
+from elasticsearch_tpu.index.translog import (
+    Translog,
+    durability_stats_snapshot,
+)
+from elasticsearch_tpu.search import dsl
+from elasticsearch_tpu.search.executor import NumpyExecutor
+from elasticsearch_tpu.search.executor_jax import JaxExecutor
+
+FD = {"fd_interval": 0.1, "fd_retries": 2}
+
+
+def make_engine(path=None, **kw):
+    return ShardEngine(
+        Mappings(WORKLOAD_MAPPING), AnalysisRegistry(), path=path, **kw
+    )
+
+
+def wait_until(cond, timeout=15.0, interval=0.05, msg="condition"):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if cond():
+            return
+        time.sleep(interval)
+    raise AssertionError(f"timed out waiting for {msg}")
+
+
+def assert_search_parity(eng):
+    """Recovered on-disk state must load into the device kernels and
+    score float-exact vs the numpy oracle (same ids, same scores)."""
+    reader = eng.reader()
+    nex = NumpyExecutor(reader)
+    jex = JaxExecutor(reader)
+    for body in ({"match": {"body": "shared"}},
+                 {"match": {"body": "alpha"}}):
+        q = dsl.parse_query(body)
+        nt = nex.search(q, size=50)
+        jt = jex.search(q, size=50)
+        n_hits = [(h.doc_id, h.score) for h in nt.hits]
+        j_hits = [(h.doc_id, h.score) for h in jt.hits]
+        assert n_hits == j_hits, (
+            f"post-recovery jax/numpy divergence on {body}"
+        )
+        assert nt.total == jt.total
+
+
+# ---------------------------------------------------------------------------
+# the crash matrix: every write-path site x both durability modes
+# ---------------------------------------------------------------------------
+
+
+class TestCrashMatrix:
+    @pytest.mark.parametrize("durability", ["request", "async"])
+    @pytest.mark.parametrize(
+        "label,rule", ENGINE_CRASH_SITES,
+        ids=[label for label, _ in ENGINE_CRASH_SITES],
+    )
+    def test_crash_site_contract(self, tmp_path, label, rule, durability):
+        eng, ledger, report = run_engine_crash_case(
+            str(tmp_path / "shard"), rule, durability,
+            sync_interval=3600.0,  # async syncs only at roll: the loss
+            # window is real and the recorded fsync bound is exact
+        )
+        try:
+            assert report["crashed"], f"{label}: the crash never fired"
+            if durability == "request":
+                # acked == durable, no exceptions
+                assert report["lost_acks_beyond_bound"] == 0
+                assert report["durable_bound"] == report["max_acked_seq"]
+            assert_search_parity(eng)
+            # the engine stays writable after recovery
+            r = eng.index("post", {"body": "post crash write", "n": 1})
+            assert r.seq_no > report["durable_bound"] - 1
+            eng.refresh()
+            assert eng.get("post") is not None
+        finally:
+            eng.close()
+
+    def test_engine_remains_recoverable_after_repeated_crashes(
+        self, tmp_path
+    ):
+        """Crash → recover → crash again at another site: recovery
+        must be re-entrant (a second power loss during the next
+        workload epoch still converges)."""
+        path = str(tmp_path / "shard")
+        eng, ledger, _ = run_engine_crash_case(
+            path, {"site": "engine.flush", "match": {"stage":
+                                                     "pre_manifest"}},
+            "request",
+        )
+        eng.close()
+        eng2, ledger2, report2 = run_engine_crash_case(
+            path, {"site": "translog.append", "skip": 5}, "request"
+        )
+        try:
+            assert report2["crashed"]
+            assert report2["lost_acks_beyond_bound"] == 0
+            assert_search_parity(eng2)
+        finally:
+            eng2.close()
+
+
+# ---------------------------------------------------------------------------
+# satellite: torn-tail truncation (the seed bug)
+# ---------------------------------------------------------------------------
+
+
+class TestTornTail:
+    def test_reopen_truncates_torn_tail_and_keeps_later_ops(self, tmp_path):
+        """Seed bug: reopening a generation with a torn trailing line
+        appended AFTER the garbage, so _read_ops stopped at the
+        corruption and silently dropped every LATER op. The reopen must
+        truncate the torn bytes so later appends replay."""
+        tl_dir = str(tmp_path / "tl")
+        tl = Translog(tl_dir)
+        tl.add({"op": "index", "id": "a", "seq_no": 0, "version": 1,
+                "source": {"n": 1}})
+        tl.close()
+        # a torn half-record lands at the tail (no trailing newline)
+        gen_path = os.path.join(tl_dir, "translog-1.log")
+        with open(gen_path, "ab") as f:
+            f.write(b'{"op":"index","id":"b","se')
+        before = durability_stats_snapshot()["torn_tails_truncated"]
+        tl2 = Translog(tl_dir)
+        assert (
+            durability_stats_snapshot()["torn_tails_truncated"] == before + 1
+        )
+        tl2.add({"op": "index", "id": "c", "seq_no": 1, "version": 1,
+                 "source": {"n": 3}})
+        ops = list(tl2.read_ops_after(-1))
+        assert [o["id"] for o in ops] == ["a", "c"], (
+            "ops after the torn tail must not be silently dropped"
+        )
+        tl2.close()
+
+    def test_torn_garbage_with_newline_also_truncated(self, tmp_path):
+        tl_dir = str(tmp_path / "tl")
+        tl = Translog(tl_dir)
+        tl.add({"op": "index", "id": "a", "seq_no": 0, "version": 1})
+        tl.close()
+        gen_path = os.path.join(tl_dir, "translog-1.log")
+        with open(gen_path, "ab") as f:
+            f.write(b"\x00\x17garbage{{{\nmore-garbage\n")
+        tl2 = Translog(tl_dir)
+        tl2.add({"op": "index", "id": "b", "seq_no": 1, "version": 1})
+        assert [o["id"] for o in tl2.read_ops_after(-1)] == ["a", "b"]
+        tl2.close()
+
+    def test_engine_level_torn_crash_recovers(self, tmp_path):
+        """The torn write injected by the crash harness itself: a crash
+        mid-append leaves half a record; recovery truncates it and the
+        next session appends cleanly."""
+        p = str(tmp_path / "shard")
+        eng = make_engine(p)
+        eng.index("a", {"body": "full record"})
+        faults.configure({"seed": 0, "rules": [
+            {"site": "translog.append", "kind": "crash", "torn": True,
+             "times": 1},
+        ]})
+        with pytest.raises(SimulatedCrash):
+            eng.index("b", {"body": "torn record"})
+        faults.clear()
+        eng.crash()
+        eng2 = make_engine(p)
+        assert eng2.get("a") is not None
+        assert eng2.get("b") is None  # never acked, never durable
+        eng2.index("c", {"body": "post recovery"})
+        eng2.close()
+        eng3 = make_engine(p)
+        assert eng3.get("c") is not None
+        eng3.close()
+
+
+# ---------------------------------------------------------------------------
+# satellite: reopen hygiene (orphan ckp.tmp, stale generations, orphan
+# manifest tmp, interrupted trim)
+# ---------------------------------------------------------------------------
+
+
+class TestReopenHygiene:
+    def test_orphan_checkpoint_tmp_removed(self, tmp_path):
+        tl_dir = str(tmp_path / "tl")
+        tl = Translog(tl_dir)
+        tl.add({"op": "index", "id": "a", "seq_no": 0, "version": 1})
+        tl.close()
+        with open(os.path.join(tl_dir, "translog.ckp.tmp"), "w") as f:
+            f.write('{"generation": 999}')  # crash between write+replace
+        before = durability_stats_snapshot()["orphan_checkpoints_removed"]
+        tl2 = Translog(tl_dir)
+        assert not os.path.exists(os.path.join(tl_dir, "translog.ckp.tmp"))
+        assert (
+            durability_stats_snapshot()["orphan_checkpoints_removed"]
+            == before + 1
+        )
+        assert tl2.generation == 1  # the committed checkpoint won
+        tl2.close()
+
+    def test_stale_generation_newer_than_checkpoint_removed(self, tmp_path):
+        """Crash inside roll_generation between creating the new file
+        and writing the checkpoint: the newer file holds nothing acked
+        and must not confuse the next recovery."""
+        tl_dir = str(tmp_path / "tl")
+        tl = Translog(tl_dir)
+        tl.add({"op": "index", "id": "a", "seq_no": 0, "version": 1})
+        tl.close()
+        with open(os.path.join(tl_dir, "translog-2.log"), "wb") as f:
+            f.write(b'{"op":"index","id":"phantom","se')  # torn too
+        before = durability_stats_snapshot()["stale_generations_removed"]
+        tl2 = Translog(tl_dir)
+        assert not os.path.exists(os.path.join(tl_dir, "translog-2.log"))
+        assert (
+            durability_stats_snapshot()["stale_generations_removed"]
+            == before + 1
+        )
+        assert [o["id"] for o in tl2.read_ops_after(-1)] == ["a"]
+        # the next roll re-creates generation 2 cleanly
+        tl2.roll_generation()
+        tl2.add({"op": "index", "id": "b", "seq_no": 1, "version": 1})
+        assert [o["id"] for o in tl2.read_ops_after(-1)] == ["a", "b"]
+        tl2.close()
+
+    def test_orphan_manifest_tmp_removed_on_recover(self, tmp_path):
+        p = str(tmp_path / "shard")
+        eng = make_engine(p)
+        eng.index("a", {"body": "committed"})
+        eng.flush()
+        eng.close()
+        with open(os.path.join(p, "manifest.json.tmp"), "w") as f:
+            f.write('{"generation": 999, "segments": []')  # torn
+        before = durability_stats_snapshot()["orphan_manifests_removed"]
+        eng2 = make_engine(p)
+        assert not os.path.exists(os.path.join(p, "manifest.json.tmp"))
+        assert (
+            durability_stats_snapshot()["orphan_manifests_removed"]
+            == before + 1
+        )
+        assert eng2.get("a") is not None
+        eng2.close()
+
+    def test_trim_crash_between_checkpoint_and_delete(self, tmp_path):
+        """trim_unreferenced writes the checkpoint, then deletes covered
+        generations; a crash in between leaves covered files recovery
+        must SKIP (not replay into duplicates) and the next flush must
+        remove."""
+        p = str(tmp_path / "shard")
+        eng = make_engine(p)
+        eng.index("a", {"body": "epoch one"})
+        eng.flush()
+        tl_dir = os.path.join(p, "translog")
+        # resurrect a fully-covered old generation, as if the trim's
+        # deletes never ran
+        with open(os.path.join(tl_dir, "translog-1.log"), "w") as f:
+            f.write(json.dumps({"op": "index", "id": "a", "seq_no": 0,
+                                "version": 1,
+                                "source": {"body": "epoch one"}}) + "\n")
+        eng.close()
+        eng2 = make_engine(p)
+        assert eng2.num_docs == 1
+        assert eng2.get("a")["_version"] == 1  # covered op NOT re-applied
+        eng2.index("b", {"body": "epoch two"})
+        eng2.flush()
+        logs = sorted(
+            f for f in os.listdir(tl_dir) if f.startswith("translog-")
+        )
+        assert "translog-1.log" not in logs, "next trim removes leftovers"
+        eng2.close()
+
+
+# ---------------------------------------------------------------------------
+# satellite: the async-durability contract
+# ---------------------------------------------------------------------------
+
+
+class TestAsyncDurabilityContract:
+    def test_request_never_loses_acked_ops(self, tmp_path):
+        p = str(tmp_path / "shard")
+        eng = make_engine(p, durability="request")
+        for i in range(10):
+            eng.index(f"d{i}", {"body": f"doc {i}"})
+        eng.crash()  # no flush, no close, no refresh ever ran
+        eng2 = make_engine(p)
+        for i in range(10):
+            assert eng2.get(f"d{i}") is not None, f"lost acked d{i}"
+        eng2.close()
+
+    def test_async_window_bounded_by_fsync(self, tmp_path):
+        p = str(tmp_path / "shard")
+        eng = make_engine(p, durability="async", sync_interval=3600.0)
+        eng.index("durable", {"body": "before the fsync"})
+        eng.translog.sync()
+        synced = eng.translog.last_synced_seq_no
+        eng.index("volatile", {"body": "after the fsync"})
+        assert eng.translog.last_synced_seq_no == synced  # still pending
+        eng.crash()
+        eng2 = make_engine(p)
+        assert eng2.get("durable") is not None
+        assert eng2.get("volatile") is None, (
+            "an unfsynced async op cannot survive a crash — if it does, "
+            "the loss-window model is broken and the bound is untestable"
+        )
+        eng2.close()
+
+    def test_async_interval_clock_bounds_staleness(self, tmp_path):
+        """An actively-written shard fsyncs at least every
+        sync_interval: after writing for >> interval, the synced
+        high-water must trail the acked high-water by a bounded gap."""
+        p = str(tmp_path / "shard")
+        eng = make_engine(p, durability="async", sync_interval=0.05)
+        t0 = time.monotonic()
+        last_synced_at_ack = []
+        i = 0
+        while time.monotonic() - t0 < 0.5:
+            r = eng.index(f"d{i}", {"body": f"doc {i}"})
+            last_synced_at_ack.append(
+                (r.seq_no, eng.translog.last_synced_seq_no,
+                 time.monotonic())
+            )
+            i += 1
+            time.sleep(0.002)
+        assert eng.translog.last_synced_seq_no >= 0, (
+            "interval fsyncs never fired"
+        )
+        # every ack's durable lag is bounded: ops acked more than one
+        # interval before a later ack are covered by then
+        for (seq, synced, t_ack) in last_synced_at_ack:
+            for (seq2, synced2, t2) in last_synced_at_ack:
+                if t2 - t_ack >= 0.12:  # > 2x interval later
+                    assert synced2 >= seq, (
+                        f"op seq {seq} still unfsynced {t2 - t_ack:.3f}s "
+                        f"after its ack (interval 0.05s)"
+                    )
+                    break
+        eng.close()
+
+    def test_roll_generation_crash_window(self, tmp_path):
+        """Crash inside roll (fsync site, during flush): acked request-
+        durability ops survive, the interrupted roll leaves no stale
+        generation behind after reopen."""
+        p = str(tmp_path / "shard")
+        eng = make_engine(p, durability="request")
+        for i in range(6):
+            eng.index(f"d{i}", {"body": f"doc {i}"})
+        faults.configure({"seed": 0, "rules": [
+            {"site": "translog.fsync", "kind": "crash", "times": 1},
+        ]})
+        with pytest.raises(SimulatedCrash):
+            eng.flush()  # roll_generation syncs first → crash
+        faults.clear()
+        eng.crash()
+        eng2 = make_engine(p)
+        assert eng2.num_docs == 6
+        for i in range(6):
+            assert eng2.get(f"d{i}") is not None
+        eng2.flush()
+        eng2.close()
+
+
+# ---------------------------------------------------------------------------
+# hardening: partially-written segment dirs from a crashed flush
+# ---------------------------------------------------------------------------
+
+
+class TestSegmentQuarantine:
+    def test_torn_transfer_marker_blocks_engine_open(self, tmp_path):
+        """A node that crashed MID-peer-recovery restarts with a
+        half-copied shard dir (the `_recovering` marker still present).
+        No engine open may touch it — the copy stays a recovery target
+        instead of crashing the node on a torn manifest."""
+        from elasticsearch_tpu.cluster.indices import IndexService
+
+        base = str(tmp_path / "idx")
+        shard_dir = os.path.join(base, "0")
+        os.makedirs(shard_dir)
+        with open(os.path.join(shard_dir, "_recovering"), "w") as f:
+            f.write("node-1")
+        # torn transfer: a manifest referencing a segment whose files
+        # never arrived — opening this would raise FileNotFoundError
+        with open(os.path.join(shard_dir, "manifest.json"), "w") as f:
+            json.dump({"format_version": 2, "generation": 1,
+                       "segments": [{"name": "seg_0_0", "live_gen": None}],
+                       "max_seq_no": 4, "primary_term": 1}, f)
+        idx = IndexService(
+            "torn",
+            settings={"number_of_shards": 1, "number_of_replicas": 1},
+            base_path=base,
+            routing={0: {"primary": "node-0", "replicas": ["node-1"],
+                         "in_sync": ["node-0"], "primary_term": 1}},
+            local_node="node-1",
+            remote_call=lambda *a, **k: (_ for _ in ()).throw(
+                AssertionError("no dispatch in this test")
+            ),
+        )
+        try:
+            assert 0 not in idx.local_shards  # the torn dir stays shut
+            assert idx.recovery_needed() == [0]  # still a recovery target
+            # recovery's wipe clears the marker and the torn files
+            path = idx.begin_peer_recovery(0)
+            assert os.path.exists(os.path.join(path, "_recovering"))
+            assert not os.path.exists(
+                os.path.join(path, "manifest.json")
+            )
+            eng = idx.finish_peer_recovery(0)
+            assert not os.path.exists(os.path.join(path, "_recovering"))
+            assert eng.num_docs == 0
+        finally:
+            idx.close()
+
+
+    def test_crashed_flush_segment_dirs_quarantined(self, tmp_path):
+        """A flush that crashed after persisting segment dirs but before
+        the manifest commit leaves same-named dirs a LATER flush (after
+        replay collapses the buffer into different segmentation) would
+        collide with — silently committing the manifest over the wrong
+        bytes. Recovery must quarantine unreferenced dirs."""
+        p = str(tmp_path / "shard")
+        eng = make_engine(p)
+        eng.index("a", {"body": "alpha one"})
+        eng.index("b", {"body": "alpha two"})
+        eng.refresh()
+        eng.index("c", {"body": "alpha three"})
+        eng.refresh()  # two segments in memory
+        faults.configure({"seed": 0, "rules": [
+            {"site": "engine.flush", "kind": "crash",
+             "match": {"stage": "pre_manifest"}, "times": 1},
+        ]})
+        with pytest.raises(SimulatedCrash):
+            eng.flush()  # segment dirs hit disk; the manifest never does
+        faults.clear()
+        eng.crash()
+        leftover = [d for d in os.listdir(p)
+                    if os.path.isdir(os.path.join(p, d)) and d != "translog"]
+        assert leftover, "precondition: the crashed flush left seg dirs"
+        before = durability_stats_snapshot()["quarantined_segments"]
+        eng2 = make_engine(p)
+        assert (
+            durability_stats_snapshot()["quarantined_segments"]
+            >= before + len(leftover)
+        )
+        # replay rebuilt everything; the post-recovery flush commits the
+        # REAL segmentation and a further reopen still sees all docs
+        assert eng2.num_docs == 3
+        eng2.flush()
+        eng2.close()
+        eng3 = make_engine(p)
+        assert eng3.num_docs == 3
+        for doc_id in ("a", "b", "c"):
+            assert eng3.get(doc_id) is not None
+        assert_search_parity(eng3)
+        eng3.close()
+
+
+# ---------------------------------------------------------------------------
+# cluster level: replica convergence + node crash/restart
+# ---------------------------------------------------------------------------
+
+
+def make_cluster(n, tmp_path=None, **kw):
+    kw = {**FD, **kw}
+    nodes = [
+        TpuNode(
+            "node-0",
+            data_path=str(tmp_path / "node-0") if tmp_path else None,
+            **kw,
+        ).start()
+    ]
+    for i in range(1, n):
+        nodes.append(
+            TpuNode(
+                f"node-{i}",
+                seeds=[nodes[0].address],
+                data_path=str(tmp_path / f"node-{i}") if tmp_path else None,
+                **kw,
+            ).start()
+        )
+    return nodes
+
+
+def shard_checksums(node, index):
+    return {
+        sid: engine_state_checksum(eng)
+        for sid, eng in sorted(node.indices[index].local_shards.items())
+    }
+
+
+class TestReplicaConvergence:
+    def test_replica_failure_mid_replication_leaves_in_sync(self, tmp_path):
+        """An injected replication failure must drop the copy from the
+        in-sync set (never silent divergence), then peer recovery brings
+        it back green and checksum-identical."""
+        nodes = make_cluster(2, tmp_path)
+        a, b = nodes
+        try:
+            a.create_index("conv", {"settings": {"number_of_shards": 1,
+                                                 "number_of_replicas": 1}})
+            a.index_doc("conv", "pre", {"body": "pre fault"})
+            faults.configure({"seed": 3, "rules": [
+                {"site": "replica.replicate", "kind": "error", "times": 1,
+                 "match": {"target": "node-1"}},
+            ]})
+            r = a.index_doc("conv", "during", {"body": "during fault"})
+            assert r["result"] in ("created", "updated")  # write still acked
+            faults.clear()
+            entry = a.state["indices"]["conv"]["routing"]["0"]
+            # either already recovered (fast) or node-1 left in_sync; the
+            # end state must be green + convergent
+            wait_until(
+                lambda: a.cluster.health()["status"] == "green",
+                msg="re-replication after the injected replica failure",
+            )
+            wait_until(
+                lambda: shard_checksums(a, "conv") == shard_checksums(b, "conv"),
+                msg="primary/replica checksum convergence",
+            )
+            assert a.count("conv")["count"] == b.count("conv")["count"]
+        finally:
+            faults.clear()
+            for n in nodes:
+                n.close()
+
+    def test_recovery_transfer_fault_retried_to_green(self, tmp_path):
+        a = TpuNode("node-0", data_path=str(tmp_path / "node-0"),
+                    **FD).start()
+        b = None
+        try:
+            a.create_index("rt", {"settings": {"number_of_shards": 2,
+                                               "number_of_replicas": 1}})
+            for i in range(10):
+                a.index_doc("rt", f"d{i}", {"body": f"doc {i}"})
+            a.refresh("rt")
+            before = durability_stats_snapshot()["recovery_retries"]
+            faults.configure({"seed": 5, "rules": [
+                {"site": "recovery.transfer", "kind": "error", "times": 1},
+            ]})
+            b = TpuNode("node-1", seeds=[a.address],
+                        data_path=str(tmp_path / "node-1"), **FD).start()
+            wait_until(lambda: a.cluster.health()["status"] == "green",
+                       msg="peer recovery to retry through the fault")
+            assert durability_stats_snapshot()["recovery_retries"] > before
+            wait_until(
+                lambda: shard_checksums(a, "rt") == shard_checksums(b, "rt"),
+                msg="post-recovery checksum convergence",
+            )
+        finally:
+            faults.clear()
+            if b is not None:
+                b.close()
+            a.close()
+
+    def test_recovery_finalize_redelivery_idempotent(self, tmp_path):
+        nodes = make_cluster(2, tmp_path)
+        a, b = nodes
+        try:
+            a.create_index("fin", {"settings": {"number_of_shards": 1,
+                                                "number_of_replicas": 1}})
+            for i in range(6):
+                a.index_doc("fin", f"d{i}", {"body": f"doc {i}"})
+            wait_until(lambda: a.cluster.health()["status"] == "green",
+                       msg="initial green")
+            owner = a if a.indices["fin"]._owner(0) == "node-0" else b
+            target = "node-1" if owner is a else "node-0"
+            tnode = b if owner is a else a
+            payload = {"index": "fin", "shard": 0, "target": target,
+                       "local_seq": -1}
+            before = durability_stats_snapshot()["finalize_redelivered"]
+            fin1 = owner.transport._handlers["internal:recovery/finalize"](
+                payload
+            )
+            fin2 = owner.transport._handlers["internal:recovery/finalize"](
+                payload
+            )
+            assert fin1["ops"] == fin2["ops"], "finalize must be idempotent"
+            assert (
+                durability_stats_snapshot()["finalize_redelivered"] > before
+            )
+            # re-applying the redelivered ops no-ops via seqno dedup
+            eng = tnode.indices["fin"].local_shards[0]
+            cks = engine_state_checksum(eng)
+            for op in fin2["ops"]:
+                if op["op"] == "index":
+                    r = eng.index_replica(op["id"], op["source"],
+                                          op["version"], op["seq_no"])
+                else:
+                    r = eng.delete_replica(op["id"], op["version"],
+                                           op["seq_no"])
+                assert r.result == "noop"
+            assert engine_state_checksum(eng) == cks
+        finally:
+            for n in nodes:
+                n.close()
+
+    def test_node_crash_restart_no_acked_loss(self, tmp_path):
+        """Power loss on a single-node cluster: every acked write (no
+        refresh, no flush) survives the restart under request
+        durability."""
+        a = TpuNode("node-0", data_path=str(tmp_path / "node-0"),
+                    **FD).start()
+        a.create_index("crashy", {"settings": {"number_of_shards": 2,
+                                               "number_of_replicas": 0}})
+        n_docs = 25
+        for i in range(n_docs):
+            r = a.index_doc("crashy", f"d{i}", {"body": f"payload {i}"})
+            assert r["result"] == "created"
+        a.crash()  # no flush, no close
+        a2 = TpuNode("node-0", data_path=str(tmp_path / "node-0"),
+                     **FD).start()
+        try:
+            assert a2.count("crashy")["count"] == n_docs
+            resp = a2.search("crashy", {"query": {"match": {"body":
+                                                            "payload"}},
+                                        "size": 50})
+            assert resp["hits"]["total"]["value"] == n_docs
+            # still writable
+            a2.index_doc("crashy", "post", {"body": "payload post"})
+            a2.refresh("crashy")
+            assert a2.count("crashy")["count"] == n_docs + 1
+        finally:
+            a2.close()
+
+    def test_primary_crash_promotes_then_reconverges(self, tmp_path):
+        """Crash a node holding primaries: the survivor promotes its
+        in-sync replicas with zero acked loss; the crashed node restarts
+        from its (possibly stale) disk, peer-recovers, and converges
+        checksum-identical."""
+        nodes = make_cluster(2, tmp_path)
+        a, b = nodes
+        b2 = None
+        try:
+            a.create_index("pc", {"settings": {"number_of_shards": 2,
+                                               "number_of_replicas": 1}})
+            for i in range(20):
+                a.index_doc("pc", f"d{i}", {"body": f"doc number {i}"})
+            b.crash()  # power loss, not a graceful close
+            wait_until(lambda: set(a.state["nodes"]) == {"node-0"},
+                       msg="crashed node removal")
+            # zero acked loss across the promotion (refresh for
+            # visibility — the buffered ops are already WAL-durable)
+            a.refresh("pc")
+            assert a.count("pc")["count"] == 20
+            for i in range(20, 30):
+                a.index_doc("pc", f"d{i}", {"body": f"doc number {i}"})
+            b2 = TpuNode("node-1", seeds=[a.address],
+                         data_path=str(tmp_path / "node-1"), **FD).start()
+            wait_until(lambda: a.cluster.health()["status"] == "green",
+                       msg="re-replication after crash restart")
+            wait_until(
+                lambda: shard_checksums(a, "pc") == shard_checksums(b2, "pc"),
+                msg="post-crash checksum convergence",
+            )
+            assert b2.count("pc")["count"] == 30
+        finally:
+            if b2 is not None:
+                b2.close()
+            a.close()
+
+
+# ---------------------------------------------------------------------------
+# settings plumbing + observability
+# ---------------------------------------------------------------------------
+
+
+class TestDurabilityPlumbing:
+    def test_index_setting_reaches_engine(self, tmp_path):
+        from elasticsearch_tpu.cluster.indices import IndexService
+
+        idx = IndexService(
+            "dur",
+            settings={"number_of_shards": 1,
+                      "translog.durability": "async",
+                      "translog.sync_interval": "200ms"},
+            base_path=str(tmp_path / "dur"),
+        )
+        try:
+            eng = idx.local_shard(0)
+            assert eng.translog.durability == "async"
+            assert eng.translog.sync_interval == pytest.approx(0.2)
+        finally:
+            idx.close()
+
+    def test_dynamic_durability_update_reaches_open_engines(self, tmp_path):
+        """Flipping index.translog.durability on a LIVE index must
+        change the open translog's behavior (and close the volatile
+        window at the flip), not wait for a restart."""
+        from elasticsearch_tpu.cluster import ClusterService
+
+        c = ClusterService(data_path=str(tmp_path / "node"))
+        try:
+            c.create_index("flip", {"settings": {
+                "number_of_shards": 1,
+                "translog.durability": "async",
+                "translog.sync_interval": "1h",
+            }})
+            idx = c.get_index("flip")
+            eng = idx.local_shard(0)
+            idx.index_doc("1", {"f": "volatile until the flip"})
+            assert eng.translog.last_synced_seq_no == -1  # still pending
+            c.update_settings(
+                "flip", {"index": {"translog.durability": "request"}}
+            )
+            assert eng.translog.durability == "request"
+            # the flip itself synced the pending tail
+            assert eng.translog.last_synced_seq_no >= 0
+            idx.index_doc("2", {"f": "fsynced per request now"})
+            assert eng.translog.stats()["pending_ops"] == 0
+        finally:
+            c.close()
+
+    def test_invalid_durability_rejected(self):
+        from elasticsearch_tpu.common.settings import (
+            SettingsError,
+            validate_index_settings,
+        )
+
+        with pytest.raises(SettingsError):
+            validate_index_settings(
+                {"translog.durability": "sometimes"}, creating=True
+            )
+
+    def test_nodes_stats_durability_blocks(self, tmp_path):
+        from elasticsearch_tpu.cluster import ClusterService
+        from elasticsearch_tpu.rest.actions import RestActions
+
+        c = ClusterService(data_path=str(tmp_path / "node"))
+        try:
+            c.create_index("st", {"settings": {"number_of_shards": 1}})
+            idx = c.get_index("st")
+            idx.index_doc("1", {"f": "one"})
+            actions = RestActions(c)
+            _, resp = actions.nodes_stats(None, {}, {})
+            node = resp["nodes"]["node-0"]
+            tb = node["translog"]
+            assert tb["uncommitted_ops"] >= 1
+            assert tb["appended_ops"] >= 1
+            assert tb["fsyncs"] >= 1
+            assert "torn_tails_truncated" in tb
+            assert "stale_generations_removed" in tb
+            rb = node["recovery"]
+            assert "replayed_ops" in rb and "quarantined_segments" in rb
+            assert set(rb["peer"]) >= {"started", "completed", "failed",
+                                       "retries", "finalize_redelivered"}
+            idx.flush()
+            _, resp2 = actions.nodes_stats(None, {}, {})
+            assert (
+                resp2["nodes"]["node-0"]["translog"]["uncommitted_ops"] == 0
+            )
+        finally:
+            c.close()
+
+    def test_crash_workload_ledger_tracks_acks(self, tmp_path):
+        """The harness's own bookkeeping: a clean (no-fault) workload
+        run recovers every acked op on reopen."""
+        p = str(tmp_path / "shard")
+        eng = make_engine(p)
+        ledger = AckLedger()
+        run_workload(eng, ledger)
+        assert ledger.max_acked_seq > 20
+        eng.close()
+        eng2 = make_engine(p)
+        from elasticsearch_tpu.index.crashpoints import verify_recovery
+
+        report = verify_recovery(eng2, ledger, "request",
+                                 eng.translog.last_synced_seq_no)
+        assert report["lost_acks_beyond_bound"] == 0
+        assert_search_parity(eng2)
+        eng2.close()
