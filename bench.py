@@ -1385,6 +1385,216 @@ def run_rerank_config():
         svc_np.close()
 
 
+# ---------------------------------------------------------------------------
+# indexing mode: sustained mixed write+query traffic with NRT refresh
+# ---------------------------------------------------------------------------
+
+# scales with BENCH_N_DOCS so the tiny-corpus smoke runs stay fast
+INGEST_BASE = int(os.environ.get("BENCH_INGEST_BASE", 0)) or min(
+    100_000, max(N_DOCS // 10, 4_000)
+)
+INGEST_SECONDS = float(
+    os.environ.get(
+        "BENCH_INGEST_SECONDS", 15.0 if N_DOCS > 100_000 else 6.0
+    )
+)
+INGEST_WRITERS = int(os.environ.get("BENCH_INGEST_WRITERS", 4))
+INGEST_REFRESH = os.environ.get("BENCH_INGEST_REFRESH", "200ms")
+# offered write rate (docs/s across writers): the mixed-traffic scenario
+# measures SLO compliance at a sustained rate, not the write ceiling —
+# an unthrottled writer pool just measures the GIL
+INGEST_RATE = float(os.environ.get("BENCH_INGEST_RATE", 1500.0))
+INGEST_VOCAB = 4000
+
+
+def run_indexing_config():
+    """The `indexing` scenario (streaming ingest & NRT search): one
+    service serving an open-loop query stream while writer threads
+    index a sustained document stream and the background refresher
+    swaps double-buffered generations at `refresh_interval`. Reports
+    sustained docs/s, refresh-lag percentiles (ack → searchable,
+    worst-doc per refresh), and the query p99 under concurrent ingest
+    next to the read-only p99 from the same service moments earlier —
+    the ≤1.5× gate lives in scripts/ingest_smoke.sh."""
+    from elasticsearch_tpu.cluster.indices import IndexService
+    from elasticsearch_tpu.index import segment_build
+    from elasticsearch_tpu.search.admission import admission
+
+    # raw serving measurement: overload protection is measured by the
+    # open_loop section, not here — shedding would muddy the p99 ratio
+    admission.configure(enabled=False)
+    rng = np.random.default_rng(SEED + 7)
+    # Zipf-ish vocabulary so posting lists skew like real text
+    vocab = np.array([f"w{i}" for i in range(INGEST_VOCAB)])
+    zipf = 1.0 / np.arange(1, INGEST_VOCAB + 1) ** 1.1
+    zipf /= zipf.sum()
+
+    def make_source(r):
+        n = int(r.integers(8, 16))
+        words = r.choice(vocab, size=n, p=zipf)
+        return {
+            "body": " ".join(words),
+            "popularity": int(r.integers(0, 1000)),
+        }
+
+    log(f"[indexing] seeding {INGEST_BASE} base docs "
+        f"(refresh_interval={INGEST_REFRESH})…")
+    prev_bg = os.environ.get("ES_TPU_BG_REFRESH")
+    os.environ["ES_TPU_BG_REFRESH"] = "auto"
+    svc = IndexService(
+        "ingest-bench",
+        settings={
+            "number_of_shards": 1,
+            "search.backend": "jax",
+            "refresh_interval": INGEST_REFRESH,
+        },
+        mappings_json={
+            "properties": {
+                "body": {"type": "text"},
+                "popularity": {"type": "integer"},
+            }
+        },
+    )
+    try:
+        t_seed = time.perf_counter()
+        for i in range(INGEST_BASE):
+            svc.index_doc(f"b{i}", make_source(rng))
+        svc.refresh()
+        seed_wall = time.perf_counter() - t_seed
+        log(f"[indexing] seeded in {seed_wall:.1f}s "
+            f"({INGEST_BASE / seed_wall:.0f} docs/s single-writer)")
+        # build-kernel warmup: stream a few refresh intervals of writes
+        # through the NRT loop so the pow2-bucketed build kernels (and
+        # the swap/prewarm path) compile BEFORE the measured windows
+        log("[indexing] build warmup (compile the refresh pipeline)…")
+        r0 = np.random.default_rng(SEED + 3)
+        per_writer_dt = INGEST_WRITERS / max(INGEST_RATE, 1.0)
+        warm_n = max(int(INGEST_RATE * 1.0), 64)
+        for i in range(warm_n):
+            svc.index_doc(f"warm{i}", make_source(r0))
+            if i % max(warm_n // 4, 1) == 0:
+                svc.wait_for_refresh(timeout=30)
+        svc.wait_for_refresh(timeout=30)
+        # query stream: mid-frequency two-term matches
+        mids = vocab[40:400]
+        q_bodies = [
+            {
+                "query": {"match": {"body": " ".join(
+                    rng.choice(mids, size=2)
+                )}},
+                "size": K,
+            }
+            for _ in range(512)
+        ]
+        for b in q_bodies[:6]:
+            svc.search(b)
+        # read-only baseline: closed-loop peak, then the open-loop rate
+        ro_qps, ro_p50, _, _ = run_load(svc, q_bodies, threads=64)
+        rate = max(0.4 * ro_qps, 4.0)
+        slo_ms = max(8.0 * ro_p50, 250.0)
+        log(f"[indexing] read-only: {ro_qps:.1f} QPS closed-loop; "
+            f"open-loop at {rate:.0f}/s…")
+        ro = run_open_loop(
+            svc, q_bodies, rate_qps=rate, duration_s=INGEST_SECONDS,
+            slo_ms=slo_ms,
+        )
+        # ---- mixed phase: writers + the SAME open-loop query rate ----
+        segment_build.reset_stats()
+        stop = threading.Event()
+        written = [0] * INGEST_WRITERS
+
+        def writer(tid):
+            # paced open-loop writer: INGEST_RATE/INGEST_WRITERS docs/s
+            r = np.random.default_rng(SEED + 100 + tid)
+            n = 0
+            t_start = time.perf_counter()
+            next_t = 0.0
+            while not stop.is_set():
+                now = time.perf_counter() - t_start
+                if now < next_t:
+                    time.sleep(min(next_t - now, 0.02))
+                    continue
+                svc.index_doc(f"s{tid}-{n}", make_source(r))
+                n += 1
+                next_t += per_writer_dt
+            written[tid] = n
+
+        threads = [
+            threading.Thread(target=writer, args=(t,), daemon=True)
+            for t in range(INGEST_WRITERS)
+        ]
+        t_mix = time.perf_counter()
+        for t in threads:
+            t.start()
+        mixed = run_open_loop(
+            svc, q_bodies, rate_qps=rate, duration_s=INGEST_SECONDS,
+            slo_ms=slo_ms, seed=SEED + 11,
+        )
+        stop.set()
+        for t in threads:
+            t.join(timeout=10)
+        mix_wall = time.perf_counter() - t_mix
+        docs_written = int(sum(written))
+        ing = segment_build.stats_snapshot()
+        # every streamed doc searchable after one final swap
+        svc.refresh()
+        total = svc.search({"size": 0, "track_total_hits": True})
+        total_docs = total["hits"]["total"]["value"]
+        ratio = (
+            round(mixed["accepted_p99_ms"] / ro["accepted_p99_ms"], 3)
+            if mixed["accepted_p99_ms"] and ro["accepted_p99_ms"]
+            else None
+        )
+        block = {
+            "kind": "mixed_write_query_nrt",
+            "base_docs": INGEST_BASE,
+            "refresh_interval": INGEST_REFRESH,
+            "writers": INGEST_WRITERS,
+            "offered_docs_per_s": INGEST_RATE,
+            "docs_per_s": round(docs_written / mix_wall, 1),
+            "docs_written": docs_written,
+            "seed_docs_per_s": round(INGEST_BASE / seed_wall, 1),
+            "refresh_lag": ing["refresh_lag"],
+            "refreshes": ing["refreshes"],
+            "concurrent_refreshes": ing["concurrent_refreshes"],
+            "device_builds": ing["device_builds"],
+            "host_builds": ing["host_builds"],
+            "build_kernels": ing["build_kernels"],
+            "overlap_ms": ing["overlap_ms"],
+            "prewarm_ms": ing["prewarm_ms"],
+            "generations_discarded": ing["generations_discarded"],
+            "readonly_qps_closed_loop": round(ro_qps, 1),
+            "readonly_p50_ms": ro["accepted_p50_ms"],
+            "readonly_p99_ms": ro["accepted_p99_ms"],
+            "mixed_p50_ms": mixed["accepted_p50_ms"],
+            "mixed_p99_ms": mixed["accepted_p99_ms"],
+            "mixed_goodput_qps": mixed["goodput_qps"],
+            "p99_ratio_vs_readonly": ratio,
+            "total_docs_after": total_docs,
+            "all_streamed_docs_searchable": bool(
+                total_docs == INGEST_BASE + warm_n + docs_written
+            ),
+        }
+        log(
+            f"[indexing] {block['docs_per_s']} docs/s sustained "
+            f"({INGEST_WRITERS} writers) | refresh lag p50="
+            f"{ing['refresh_lag']['p50_ms']}ms p95="
+            f"{ing['refresh_lag']['p95_ms']}ms | query p99 "
+            f"{ro['accepted_p99_ms']}ms read-only → "
+            f"{mixed['accepted_p99_ms']}ms under ingest "
+            f"({ratio}x) | builds: {ing['device_builds']} device / "
+            f"{ing['host_builds']} host, "
+            f"{ing['generations_discarded']} discarded"
+        )
+        return block
+    finally:
+        svc.close()
+        if prev_bg is None:
+            os.environ.pop("ES_TPU_BG_REFRESH", None)
+        else:
+            os.environ["ES_TPU_BG_REFRESH"] = prev_bg
+
+
 def main():
     t0 = time.perf_counter()
     # closed-loop sections measure RAW serving capacity: the admission
@@ -1680,6 +1890,13 @@ def main():
     # the first; hard gates live in scripts/rerank_smoke.sh. ----
     if os.environ.get("BENCH_RERANK", "1") != "0":
         configs["rag_rerank"] = run_rerank_config()
+
+    # ---- indexing: streaming ingest & NRT search under mixed traffic —
+    # sustained docs/s + refresh-lag percentiles + query p99 under
+    # concurrent ingest vs the read-only number (double-buffered device
+    # segment builds; gates live in scripts/ingest_smoke.sh) ----
+    if os.environ.get("BENCH_INDEXING", "1") != "0":
+        configs["indexing"] = run_indexing_config()
 
     # single-thread oracle (GIL-free per-core honesty number)
     o1_qps, _, _, _ = run_load(svc_np, bodies["match"][:24], threads=1)

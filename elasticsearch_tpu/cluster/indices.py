@@ -28,7 +28,7 @@ import os
 import threading
 import time
 from concurrent.futures import ThreadPoolExecutor
-from typing import Any, Dict, List, Optional
+from typing import Any, Dict, List, Optional, Tuple
 
 from ..analysis import AnalysisRegistry
 from ..common.faults import faults
@@ -302,6 +302,27 @@ def norm_shard_routing(entry) -> dict:
     }
 
 
+def _reader_locations(ex) -> Dict[str, Tuple[int, int]]:
+    """{doc_id → (segment, local_doc)} for one executor's PINNED reader
+    snapshot — the generation-consistent replacement for the live
+    engine's `_locations` map in multi-phase requests. Only live copies
+    enter the map (a snapshot holds at most one live copy per doc: the
+    engine flips the old copy dead under the same lock that installs
+    the new one). Built once per executor (= one reader generation) and
+    cached on it."""
+    locs = getattr(ex, "_reader_locations_cache", None)
+    if locs is None:
+        locs = {}
+        reader = ex.reader
+        for si, seg in enumerate(reader.segments):
+            live = reader.live_docs[si]
+            for local, doc_id in enumerate(seg.doc_ids):
+                if live is None or live[local]:
+                    locs[doc_id] = (si, local)
+        ex._reader_locations_cache = locs
+    return locs
+
+
 class IndexService:
     """The shard set of one index (see module docstring for the two
     deployment shapes)."""
@@ -425,6 +446,27 @@ class IndexService:
             "bm25": _deque(maxlen=4096),
             "knn": _deque(maxlen=4096),
         }
+        # ---- background refresher (index.refresh_interval): the NRT
+        # loop that turns buffered writes into searchable generations on
+        # a cadence, with the heavy segment build double-buffered
+        # against serving (ShardEngine.refresh_concurrent) and the new
+        # generation's executors/mesh stack prewarmed before the swap is
+        # observed by queries. ES_TPU_BG_REFRESH=off (tier-1) disables
+        # the thread entirely; `?refresh=wait_for` blocks on the next
+        # completed tick via _refresh_cond. ----
+        self._refresh_cond = threading.Condition()
+        self._refresh_ticks = 0
+        self._refresher_stop = False
+        self._refresher: Optional[threading.Thread] = None
+        from ..common.settings import bg_refresh_enabled
+
+        if bg_refresh_enabled():
+            self._refresher = threading.Thread(
+                target=self._refresh_loop,
+                name=f"refresher[{self.name}]",
+                daemon=True,
+            )
+            self._refresher.start()
 
     # ---- routing ----
 
@@ -479,7 +521,9 @@ class IndexService:
     def _durability_opts(self) -> dict:
         """index.translog.* settings → ShardEngine kwargs (previously
         every engine silently ran at the 'request' default regardless
-        of the index setting)."""
+        of the index setting), plus the device segment-build preference
+        (jax-backend indices build their refresh segments through the
+        jitted kernels in ops/index_build.py)."""
         from ..search.failures import parse_timeout
 
         interval = parse_timeout(
@@ -490,6 +534,9 @@ class IndexService:
                 self.settings.get("translog.durability", "request")
             ),
             "sync_interval": 5.0 if interval is None else interval,
+            "device_build": (
+                str(self.settings.get("search.backend", "numpy")) == "jax"
+            ),
         }
 
     def apply_translog_settings(self) -> None:
@@ -799,6 +846,109 @@ class IndexService:
         for owner in self._remote_owners():
             self.remote_call(owner, ACTION_SHARD_REFRESH, {"index": self.name})
 
+    # ---- background refresher (NRT loop) ----
+
+    def _refresh_interval_s(self) -> Optional[float]:
+        """index.refresh_interval as seconds; None = disabled (-1)."""
+        from ..search.failures import parse_timeout
+
+        raw = str(self.settings.get("refresh_interval", "1s"))
+        if raw == "-1":
+            return None
+        val = parse_timeout(raw)
+        return 1.0 if val is None else max(float(val), 0.01)
+
+    def apply_refresh_settings(self) -> None:
+        """Pushes a dynamic `index.refresh_interval` update into the
+        running refresher (wakes it so the new cadence applies now)."""
+        with self._refresh_cond:
+            self._refresh_cond.notify_all()
+
+    def _refresh_loop(self) -> None:
+        while True:
+            with self._refresh_cond:
+                if self._refresher_stop:
+                    return
+                interval = self._refresh_interval_s()
+                self._refresh_cond.wait(
+                    timeout=interval if interval is not None else None
+                )
+                if self._refresher_stop:
+                    return
+                if interval is None:
+                    continue  # refresh_interval: -1 → idle until wake
+            try:
+                self._refresh_tick()
+            except Exception:
+                pass  # a failed tick keeps the old generation serving
+
+    def _refresh_tick(self) -> None:
+        """One NRT cycle: concurrently build+swap every dirty local
+        shard, prewarm the new generation's serving caches (executors +
+        mesh stack) so the first query after the swap pays no upload or
+        compile, then signal `wait_for` waiters."""
+        from ..index import segment_build
+
+        refreshed = []
+        for sid, eng in sorted(self._local.items()):
+            try:
+                if eng.dirty and eng.refresh_concurrent():
+                    refreshed.append((sid, eng))
+            except Exception:
+                continue  # old generation keeps serving; next tick retries
+        t0 = time.perf_counter()
+        for sid, eng in refreshed:
+            try:
+                ex = self._executor(eng)
+                prewarm = getattr(ex, "prewarm", None)
+                if prewarm is not None:
+                    prewarm(self.settings)
+            except Exception:
+                pass
+        if refreshed and self._mesh is not None:
+            try:
+                if self._mesh.available():
+                    self._mesh.ensure_snapshot()
+            except Exception:
+                pass
+        if refreshed:
+            segment_build.note(
+                "prewarm_ms", (time.perf_counter() - t0) * 1000.0
+            )
+        with self._refresh_cond:
+            self._refresh_ticks += 1
+            self._refresh_cond.notify_all()
+
+    def wait_for_refresh(self, timeout: float = 30.0) -> None:
+        """`?refresh=wait_for` semantics: block until the change is
+        searchable. With the background refresher running this waits on
+        the NEXT completed tick (nudging it awake rather than forcing an
+        inline refresh, so wait_for still batches with the interval);
+        without one it degrades to a blocking refresh."""
+        from ..index import segment_build
+
+        r = self._refresher
+        if (
+            r is None
+            or not r.is_alive()
+            or self._refresh_interval_s() is None
+        ):
+            self.refresh()
+            return
+        segment_build.note("wait_for_waits")
+        deadline = time.monotonic() + timeout
+        with self._refresh_cond:
+            target = self._refresh_ticks + 1
+            self._refresh_cond.notify_all()  # wake the refresher now
+            while self._refresh_ticks < target:
+                left = deadline - time.monotonic()
+                if left <= 0:
+                    break
+                self._refresh_cond.wait(timeout=left)
+            done = self._refresh_ticks >= target
+        if not done:
+            self.refresh()  # refresher wedged: fall back to blocking
+
     def flush(self) -> None:
         for s in self.shards:
             s.flush()
@@ -848,6 +998,13 @@ class IndexService:
         columns, …) — a closed index keeps no device residency; before
         this, every index close leaked its executors' ledger bytes for
         the life of the process — and this index's cache entries."""
+        r = self._refresher
+        if r is not None:
+            with self._refresh_cond:
+                self._refresher_stop = True
+                self._refresh_cond.notify_all()
+            r.join(timeout=5.0)
+            self._refresher = None
         self._batcher.close()
         if self._mesh is not None:
             self._mesh.close()
@@ -922,7 +1079,14 @@ class IndexService:
             if backend == "jax":
                 from ..search.executor_jax import JaxExecutor
 
-                ex = JaxExecutor(reader)
+                stale = self._executors.get(shard.shard_id)
+                reuse = (
+                    stale[1]
+                    if stale is not None
+                    and isinstance(stale[1], JaxExecutor)
+                    else None
+                )
+                ex = JaxExecutor(reader, reuse_from=reuse)
                 ex.cache_ctx = CacheCtx(shard_key, gen, "jax")
                 ex._oracle.cache_ctx = CacheCtx(shard_key, gen, "np")
             else:
@@ -2597,13 +2761,20 @@ class IndexService:
         )
         return rescorer.apply_perm_to_topdocs(td, scores, perm)
 
-    def _rescore_ranked(self, spec, ranked: List[tuple]) -> List[tuple]:
+    def _rescore_ranked(
+        self, spec, ranked: List[tuple], pins=None
+    ) -> List[tuple]:
         """Rescore phase for the retriever/rrf coordinator path over a
         fused ranked [(doc_id, score)] list. Single-local-shard jax
-        indices rerank on device (the fused top-k stays identity-exact
-        through `_locations`); everything else — multi-shard, numpy —
-        uses the host oracle. Same degrade contract as
-        `_apply_rescore`."""
+        indices rerank on device; everything else — multi-shard, numpy
+        — uses the host oracle. Same degrade contract as
+        `_apply_rescore`.
+
+        Candidates map to (segment, doc) through the PINNED reader's
+        own location table (`_reader_locations`), never the live
+        engine's `_locations` — a refresh landing between the legs and
+        the rescore would otherwise point fused doc ids at local docs
+        of a DIFFERENT generation (wrong token rows rescored)."""
         import numpy as np
 
         from ..common.settings import rerank_mode
@@ -2634,14 +2805,14 @@ class IndexService:
             and str(self.settings.get("search.backend")) == "jax"
         ):
             try:
-                eng = self.local_shard(0)
-                ex = self._executor(eng)
+                ex = pins[0] if pins else self._executor(self.local_shard(0))
             except KeyError:
                 ex = None
             if ex is not None and isinstance(ex, JaxExecutor):
+                locs = _reader_locations(ex)
                 cands = []
                 for doc_id, score in ranked:
-                    loc = eng._locations.get(doc_id)
+                    loc = locs.get(doc_id)
                     if loc is None:
                         cands = None
                         break
@@ -2686,10 +2857,16 @@ class IndexService:
         for doc_id, score in ranked[:window]:
             msim = 0.0
             try:
-                eng = self.shard_for(doc_id)
-                loc = eng._locations.get(doc_id)
+                sid = route_shard_id(doc_id, self.num_shards)
+                if pins and sid < len(pins) and not isinstance(
+                    pins[sid], dict
+                ):
+                    px = pins[sid]
+                else:
+                    px = self._executor(self.local_shard(sid))
+                loc = _reader_locations(px).get(doc_id)
                 if loc is not None:
-                    reader = self._executor(eng).reader
+                    reader = px.reader
                     mvf = reader.segments[loc[0]].multi_vectors.get(
                         model.field
                     )
@@ -2730,15 +2907,29 @@ class IndexService:
         candidate budget, and when every leg came back with integer
         (segment, doc) identity from one executor the fusion itself runs
         on device (ops/fusion.rrf_fuse_device) with the host dict fuse
-        kept as fallback + oracle."""
+        kept as fallback + oracle.
+
+        Generation pinning: the per-shard executors are resolved ONCE,
+        up front, and every phase — leg search, rescore, fetch — reads
+        that snapshot. A refresh landing mid-request (the NRT loop runs
+        continuously) therefore can't mix columns or candidate
+        locations from two generations; without the pin, a doc moved by
+        a concurrent refresh could rescore or fetch the WRONG local
+        doc."""
         t0 = time.perf_counter()
         size = int(body.get("size", 10))
         from_ = int(body.get("from", 0))
         source_spec = body.get("_source", True)
 
+        pins = None
+        if self.routing is None:
+            try:
+                pins = self.pin_executors()
+            except KeyError:
+                pins = None
         window = max(from_ + size, 10)
         ranked = self._run_retriever(
-            body["retriever"], window, size, extra_filter
+            body["retriever"], window, size, extra_filter, pins
         )
         if "rescore" in body and ranked:
             from ..search import rescorer
@@ -2748,20 +2939,20 @@ class IndexService:
                 # second stage over the FUSED candidates (the RAG
                 # shape: filtered hybrid retrieval → rerank → fetch);
                 # sources are fetched below, after the window re-sort
-                ranked = self._rescore_ranked(rescore_spec, ranked)
+                ranked = self._rescore_ranked(rescore_spec, ranked, pins)
         page = ranked[from_ : from_ + size]
         from ..search.executor import filter_source
 
         out_hits = []
         for doc_id, score in page:
-            doc = self.get_doc(doc_id)
+            src = self._fetch_source_pinned(doc_id, pins)
             entry = {
                 "_index": self.name,
                 "_id": doc_id,
                 "_score": float(score),
             }
-            if doc is not None and source_spec is not False:
-                filtered = filter_source(doc["_source"], source_spec)
+            if src is not None and source_spec is not False:
+                filtered = filter_source(src, source_spec)
                 if filtered is not None:
                     entry["_source"] = filtered
             out_hits.append(entry)
@@ -2780,9 +2971,24 @@ class IndexService:
 
     # ---- hybrid retrieval: concurrent legs + RRF fusion ----
 
+    def _fetch_source_pinned(self, doc_id: str, pins):
+        """Fetch-phase source read from the PINNED reader generation
+        (the same snapshot the candidates were scored against); realtime
+        get is the fallback for unpinned/distributed requests."""
+        if pins:
+            sid = route_shard_id(doc_id, self.num_shards)
+            pin = pins[sid] if sid < len(pins) else None
+            if pin is not None and not isinstance(pin, dict):
+                loc = _reader_locations(pin).get(doc_id)
+                if loc is not None:
+                    return pin.reader.segments[loc[0]].sources[loc[1]]
+                return None  # not in the pinned generation
+        doc = self.get_doc(doc_id)
+        return None if doc is None else doc["_source"]
+
     def _run_retriever(
         self, ret: dict, window: int, size: int,
-        extra_filter: Optional[dict],
+        extra_filter: Optional[dict], pins=None,
     ) -> List[tuple]:
         """ranked [(doc_id, score)] for one retriever node (sync)."""
         if not isinstance(ret, dict) or len(ret) != 1:
@@ -2807,8 +3013,9 @@ class IndexService:
             # _search_reduced, not search(): legs execute INSIDE the
             # parent request's admission grant — re-admitting each leg
             # would double-charge the limit and can self-deadlock when
-            # outer requests hold every slot
-            resp = self._search_reduced(sub)
+            # outer requests hold every slot. Pins ride along so every
+            # leg scores against the request's snapshot generation.
+            resp = self._search_reduced(sub, pins)
             return [(h["_id"], h["_score"]) for h in resp["hits"]["hits"]]
         if kind == "knn":
             knn_params = dict(params)
@@ -2821,16 +3028,16 @@ class IndexService:
                     else extra_filter
                 )
             resp = self._search_reduced(
-                {"knn": knn_params, "size": window, "_source": False}
+                {"knn": knn_params, "size": window, "_source": False}, pins
             )
             return [(h["_id"], h["_score"]) for h in resp["hits"]["hits"]]
         if kind == "rrf":
-            return self._run_rrf(params, window, size, extra_filter)
+            return self._run_rrf(params, window, size, extra_filter, pins)
         raise dsl.QueryParseError(f"unknown retriever [{kind}]")
 
     def _run_rrf(
         self, params: dict, window: int, size: int,
-        extra_filter: Optional[dict],
+        extra_filter: Optional[dict], pins=None,
     ) -> List[tuple]:
         """Concurrent child legs + fusion. All legs share ONE
         rank_window_size candidate budget."""
@@ -2841,10 +3048,10 @@ class IndexService:
         # submit every leg before collecting any: plannable legs enter
         # the batcher (device overlap), the rest ride the thread pool
         handles = [
-            self._submit_leg(child, window2, extra_filter)
+            self._submit_leg(child, window2, extra_filter, pins)
             for child in children
         ]
-        legs = [self._wait_leg(h, window2, extra_filter, t_start)
+        legs = [self._wait_leg(h, window2, extra_filter, t_start, pins)
                 for h in handles]
         t_fuse = time.perf_counter()
         fused: Optional[List[tuple]] = None
@@ -2882,7 +3089,8 @@ class IndexService:
         return fused
 
     def _submit_leg(
-        self, child: dict, window: int, extra_filter: Optional[dict]
+        self, child: dict, window: int, extra_filter: Optional[dict],
+        pins=None,
     ) -> dict:
         """Async leg submission: a batcher future when the child reduces
         to a device plan, else a thread-pool future running the sync
@@ -2892,7 +3100,7 @@ class IndexService:
             raise dsl.QueryParseError("[retriever] malformed")
         kind, params = next(iter(child.items()))
         label = {"standard": "bm25", "knn": "knn"}.get(kind, "other")
-        planned = self._plan_leg(kind, params, window, extra_filter)
+        planned = self._plan_leg(kind, params, window, extra_filter, pins)
         if planned is not None:
             ex, plan, pkind, query = planned
             try:
@@ -2911,18 +3119,18 @@ class IndexService:
             return {
                 "mode": "done",
                 "ranked": self._run_retriever(
-                    child, window, window, extra_filter
+                    child, window, window, extra_filter, pins
                 ),
                 "label": label, "child": child,
             }
         fut = _LEG_POOL.submit(
-            self._run_retriever, child, window, window, extra_filter
+            self._run_retriever, child, window, window, extra_filter, pins
         )
         return {"mode": "pool", "fut": fut, "label": label, "child": child}
 
     def _plan_leg(
         self, kind: str, params: dict, window: int,
-        extra_filter: Optional[dict],
+        extra_filter: Optional[dict], pins=None,
     ):
         """(executor, plan, plan_kind, query) when this child can ride
         the batcher directly: single locally-held shard, jax backend,
@@ -2941,10 +3149,13 @@ class IndexService:
         )
         from ..search.executor_jax import JaxExecutor
 
-        try:
-            ex = self._executor(self.local_shard(0))
-        except KeyError:
-            return None
+        if pins:
+            ex = pins[0]  # the request's snapshot generation
+        else:
+            try:
+                ex = self._executor(self.local_shard(0))
+            except KeyError:
+                return None
         if not isinstance(ex, JaxExecutor):
             return None
         if kind == "standard":
@@ -2976,7 +3187,7 @@ class IndexService:
 
     def _wait_leg(
         self, handle: dict, window: int, extra_filter: Optional[dict],
-        t_start: float,
+        t_start: float, pins=None,
     ) -> dict:
         """Collects one leg: {"ranked", "td", "ex", "label", "ms"}."""
         td = None
@@ -2991,7 +3202,7 @@ class IndexService:
             except RuntimeError:
                 # batcher closed mid-flight → sync fallback
                 ranked = self._run_retriever(
-                    handle["child"], window, window, extra_filter
+                    handle["child"], window, window, extra_filter, pins
                 )
         elif handle["mode"] == "done":
             ranked = handle["ranked"]

@@ -260,6 +260,7 @@ class ClusterService:
                 raise ClusterError(400, str(e), "illegal_argument_exception")
             idx.settings.update(validated)
             idx.apply_translog_settings()
+            idx.apply_refresh_settings()
             self.version += 1
             self._persist()
             idx._persist_meta()

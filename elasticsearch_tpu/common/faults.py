@@ -49,7 +49,15 @@ Schedule shape (env `ES_TPU_FAULTS`, or `POST /_internal/faults`):
   - ``translog.fsync``      (inside Translog.sync, BEFORE the pending
     tail is written+fsynced — a crash here loses exactly the
     acked-but-unsynced window of `async` durability)
-  - ``engine.refresh``      (segment build from the indexing buffer)
+  - ``engine.refresh``      (segment build from the indexing buffer —
+    fires at refresh BEGIN, before any state moves; on the
+    double-buffered path (ShardEngine.refresh_concurrent) an error
+    keeps the old generation serving and the ops buffered)
+  - ``build.device``        (device segment-build dispatch,
+    index/segment_build.py — ctx carries shard; an injected error
+    proves the deterministic device→host-build fallback (same
+    bit-identical columns, counted `fallbacks`), delay the
+    slow-not-wrong contract, ``crash`` a power loss mid-build)
   - ``engine.flush``        (durable commit — ctx carries shard and a
     ``stage`` of start | pre_manifest | post_manifest, bracketing the
     segment-persist / manifest-replace / translog-trim windows)

@@ -225,6 +225,45 @@ def rerank_mode() -> str:
     return v if v in ("auto", "force", "off") else "auto"
 
 
+# ---- streaming-ingest knobs (index/segment_build.py, cluster/indices.py) ----
+#
+# ES_TPU_DEVICE_BUILD:  "auto" (default) — segment builds on jax-backend
+#                       indices materialize their column arrays through
+#                       the jitted build kernels (ops/index_build.py);
+#                       device-built columns are BIT-IDENTICAL to the
+#                       host SegmentBuilder output, and any device-path
+#                       failure (fault at `build.device`, HBM budget)
+#                       degrades to the host build. "force" — every
+#                       build (any backend) uses the device path and
+#                       failures RAISE (the parity/CI assertion mode;
+#                       HBM degrades still fall back). "off" — the
+#                       host SegmentBuilder everywhere (pre-ingest-PR
+#                       behavior).
+# ES_TPU_BG_REFRESH:    "auto" (default) — every IndexService runs a
+#                       background refresher thread driven by the
+#                       dynamic `index.refresh_interval` setting
+#                       (double-buffered: the next generation's columns
+#                       build while the current one serves; the swap is
+#                       one atomic generation bump). "off" — no
+#                       background thread; refresh only on explicit
+#                       calls (tier-1 pins this for determinism).
+
+DEVICE_BUILD_ENV = "ES_TPU_DEVICE_BUILD"
+BG_REFRESH_ENV = "ES_TPU_BG_REFRESH"
+
+
+def device_build_mode() -> str:
+    """Device segment-build routing mode: "auto" | "force" | "off"."""
+    v = os.environ.get(DEVICE_BUILD_ENV, "auto").strip().lower()
+    return v if v in ("auto", "force", "off") else "auto"
+
+
+def bg_refresh_enabled() -> bool:
+    """Whether IndexService starts the background refresher thread."""
+    v = os.environ.get(BG_REFRESH_ENV, "auto").strip().lower()
+    return v not in ("off", "0", "false")
+
+
 # ---- admission-control knobs (search/admission.py) ----
 #
 # ES_TPU_ADMISSION:            "on" (default) | "off" — the per-node

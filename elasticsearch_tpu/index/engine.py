@@ -33,7 +33,7 @@ from ..analysis import AnalysisRegistry
 from ..common.faults import faults
 from ..search.executor import ShardReader
 from .mapping import DocumentParser, Mappings
-from .segment import Segment, SegmentBuilder
+from .segment import Segment
 from .translog import (
     DEFAULT_SYNC_INTERVAL,
     DURABILITY_REQUEST,
@@ -72,6 +72,7 @@ class _BufferedDoc:
     version: int
     seq_no: int
     parsed: Optional[object] = None  # ParsedDocument, reused by refresh
+    ts: float = 0.0  # monotonic ack time — refresh-lag accounting
 
 
 class ShardEngine:
@@ -87,6 +88,7 @@ class ShardEngine:
         sync_interval: float = DEFAULT_SYNC_INTERVAL,
         primary_term: int = 1,
         codec: str = "default",
+        device_build: bool = False,
     ):
         self.mappings = mappings
         self.analysis = analysis
@@ -95,7 +97,17 @@ class ShardEngine:
         self.shard_id = shard_id
         self.primary_term = primary_term
         self.codec = codec
+        # jax-backend indices prefer the device segment-build pipeline
+        # (index/segment_build.py; ES_TPU_DEVICE_BUILD still overrides)
+        self.device_build = device_build
         self._lock = threading.RLock()
+        # serializes refreshes (sync AND concurrent) without blocking
+        # writes/reads: the double-buffered build runs outside _lock
+        self._refresh_mutex = threading.Lock()
+        # bumped by every committed segment-set change (refresh, merge)
+        # so a concurrent half-build can detect it was superseded and
+        # discard itself instead of installing a duplicate segment
+        self._refresh_epoch = 0
 
         self.segments: List[Segment] = []
         self.live_docs: List[Optional[np.ndarray]] = []
@@ -179,7 +191,9 @@ class ShardEngine:
             seq_no = self._next_seq
             self._next_seq += 1
             self._versions[doc_id] = _VersionEntry(version, seq_no, False)
-            self._buffer[doc_id] = _BufferedDoc(source, version, seq_no, parsed)
+            self._buffer[doc_id] = _BufferedDoc(
+                source, version, seq_no, parsed, ts=_time.monotonic()
+            )
             self._buffered_deletes.pop(doc_id, None)
             if self.translog is not None:
                 self.translog.add(
@@ -251,7 +265,9 @@ class ShardEngine:
                                 self.primary_term)
             parsed = self.parser.parse(doc_id, source)
             self._versions[doc_id] = _VersionEntry(version, seq_no, False)
-            self._buffer[doc_id] = _BufferedDoc(source, version, seq_no, parsed)
+            self._buffer[doc_id] = _BufferedDoc(
+                source, version, seq_no, parsed, ts=_time.monotonic()
+            )
             self._buffered_deletes.pop(doc_id, None)
             if self.translog is not None:
                 self.translog.add(
@@ -323,58 +339,180 @@ class ShardEngine:
     # refresh (make buffered ops searchable)
     # ------------------------------------------------------------------
 
+    def _apply_stale_flips(self) -> bool:
+        """Applies buffered deletes/updates to older segments via
+        live_docs bits (caller holds self._lock). Returns True when any
+        bit flipped."""
+        changed = False
+        stale = list(self._buffer) + list(self._buffered_deletes)
+        for doc_id in stale:
+            loc = self._locations.get(doc_id)
+            if loc is None:
+                continue
+            si, local = loc
+            if self.live_docs[si] is None:
+                self.live_docs[si] = np.ones(
+                    self.segments[si].num_docs, dtype=bool
+                )
+            if self.live_docs[si][local]:
+                self.live_docs[si][local] = False
+                changed = True
+            if doc_id in self._buffered_deletes:
+                self._locations.pop(doc_id, None)
+        self._buffered_deletes.clear()
+        return changed
+
+    def _build_from_items(self, items):
+        """(segment, versions, seqnos) for a captured buffer snapshot —
+        the heavy step; safe to run outside self._lock (the captured
+        _BufferedDoc entries are immutable). Routed through the
+        device/host segment-build pipeline (index/segment_build.py)."""
+        from . import segment_build
+
+        docs = [
+            buf.parsed
+            if buf.parsed is not None
+            else self.parser.parse(doc_id, buf.source)
+            for doc_id, buf in items
+        ]
+        seg = segment_build.build_segment(
+            self.mappings,
+            docs,
+            shard_id=self.shard_id,
+            prefer_device=self.device_build,
+        )
+        versions = np.asarray([buf.version for _, buf in items], np.int64)
+        seqnos = np.asarray([buf.seq_no for _, buf in items], np.int64)
+        return seg, versions, seqnos
+
+    def _note_refresh_lag(self, items) -> None:
+        from . import segment_build
+
+        ts = [buf.ts for _, buf in items if buf.ts > 0.0]
+        if ts:
+            segment_build.note_refresh_lag(
+                (_time.monotonic() - min(ts)) * 1000.0
+            )
+
     def refresh(self) -> bool:
         """Builds a new segment from the buffer; returns True if one was
-        created or deletes were applied."""
+        created or deletes were applied. Blocking variant: the build
+        runs under the engine lock (flush/recovery/REST `_refresh` call
+        this; the background refresher uses `refresh_concurrent`)."""
+        from . import segment_build
+
         with self._lock:
             # crash here = power loss with the buffer un-refreshed: the
             # translog already holds every acked op, so recovery replays
             faults.check("engine.refresh", shard=self.shard_id)
-            changed = False
-            # apply deletes/updates to older segments via live_docs bits
-            stale = list(self._buffer) + list(self._buffered_deletes)
-            for doc_id in stale:
-                loc = self._locations.get(doc_id)
-                if loc is None:
-                    continue
-                si, local = loc
-                if self.live_docs[si] is None:
-                    self.live_docs[si] = np.ones(
-                        self.segments[si].num_docs, dtype=bool
-                    )
-                if self.live_docs[si][local]:
-                    self.live_docs[si][local] = False
-                    changed = True
-                if doc_id in self._buffered_deletes:
-                    self._locations.pop(doc_id, None)
-            self._buffered_deletes.clear()
-
-            if self._buffer:
-                builder = SegmentBuilder(self.mappings)
-                versions = np.zeros(len(self._buffer), np.int64)
-                seqnos = np.zeros(len(self._buffer), np.int64)
+            changed = self._apply_stale_flips()
+            items = list(self._buffer.items())
+            if items:
+                seg, versions, seqnos = self._build_from_items(items)
                 si = len(self.segments)
-                for local, (doc_id, buf) in enumerate(self._buffer.items()):
-                    builder.add(
-                        buf.parsed
-                        if buf.parsed is not None
-                        else self.parser.parse(doc_id, buf.source)
-                    )
-                    versions[local] = buf.version
-                    seqnos[local] = buf.seq_no
+                for local, (doc_id, _buf) in enumerate(items):
                     self._locations[doc_id] = (si, local)
-                seg = builder.build()
                 self.segments.append(seg)
                 self.live_docs.append(None)
                 self.seg_versions.append(versions)
                 self.seg_seqnos.append(seqnos)
                 self.seg_names.append(f"seg_{self.committed_generation}_{si}")
                 self._buffer.clear()
+                self._note_refresh_lag(items)
                 changed = True
             if changed:
                 self.change_generation += 1
+                self._refresh_epoch += 1
                 self.op_stats["refresh_total"] += 1
+                segment_build.note("refreshes")
             return changed
+
+    def refresh_concurrent(self) -> bool:
+        """Double-buffered NRT refresh: the next generation's segment
+        builds OUTSIDE the engine lock — writes keep landing in the
+        buffer and searches keep serving the current generation — and
+        the swap is one atomic generation bump under the lock. A
+        mid-build failure (injected `engine.refresh`/`build.device`
+        fault, device error) discards the half-build and keeps the old
+        generation serving; ops stay in the buffer (and the translog)
+        for the next cycle. An explicit refresh/merge landing during
+        the build supersedes it (epoch check) — the half-build is
+        discarded, never installed twice. Writes captured in the
+        snapshot but superseded during the build (newer version or
+        delete) install dead-on-arrival via the new segment's live
+        bitmap, so the swap can never resurrect an overwritten doc."""
+        from . import segment_build
+
+        with self._refresh_mutex:
+            with self._lock:
+                faults.check("engine.refresh", shard=self.shard_id)
+                flips = self._apply_stale_flips()
+                items = list(self._buffer.items())
+                epoch = self._refresh_epoch
+                if not items:
+                    if flips:
+                        self.change_generation += 1
+                        self._refresh_epoch += 1
+                        self.op_stats["refresh_total"] += 1
+                        segment_build.note("refreshes")
+                    return flips
+            t0 = _time.perf_counter()
+            try:
+                seg, versions, seqnos = self._build_from_items(items)
+            except BaseException:
+                # half-build discarded; the flips (acked deletes) still
+                # become visible so a failed build can't extend their
+                # invisibility window
+                segment_build.note("generations_discarded")
+                with self._lock:
+                    if flips and self._refresh_epoch == epoch:
+                        self.change_generation += 1
+                        self._refresh_epoch += 1
+                raise
+            segment_build.note(
+                "overlap_ms", (_time.perf_counter() - t0) * 1000.0
+            )
+            with self._lock:
+                if self._refresh_epoch != epoch:
+                    # a blocking refresh/merge swapped mid-build: its
+                    # segment already holds these ops — discard ours
+                    segment_build.note("generations_discarded")
+                    return True
+                si = len(self.segments)
+                live = None
+                for local, (doc_id, buf) in enumerate(items):
+                    cur_buf = self._buffer.get(doc_id)
+                    if cur_buf is not None and cur_buf.seq_no == buf.seq_no:
+                        del self._buffer[doc_id]
+                    cur = self._versions.get(doc_id)
+                    if (
+                        cur is not None
+                        and cur.seq_no == buf.seq_no
+                        and not cur.deleted
+                    ):
+                        self._locations[doc_id] = (si, local)
+                    else:
+                        # superseded during the build: dead on arrival
+                        if live is None:
+                            live = np.ones(len(items), dtype=bool)
+                        live[local] = False
+                self.segments.append(seg)
+                self.live_docs.append(live)
+                self.seg_versions.append(versions)
+                self.seg_seqnos.append(seqnos)
+                self.seg_names.append(f"seg_{self.committed_generation}_{si}")
+                self._note_refresh_lag(items)
+                self.change_generation += 1
+                self._refresh_epoch += 1
+                self.op_stats["refresh_total"] += 1
+                segment_build.note("refreshes")
+                segment_build.note("concurrent_refreshes")
+            return True
+
+    @property
+    def dirty(self) -> bool:
+        """True when a refresh would change the searchable state."""
+        return bool(self._buffer) or bool(self._buffered_deletes)
 
     # ------------------------------------------------------------------
     # flush (durable commit) & merge
@@ -536,7 +674,9 @@ class ShardEngine:
             # crash here = power loss mid-merge: nothing on disk moved
             # yet (the merge result only becomes durable at flush)
             faults.check("engine.merge", shard=self.shard_id)
-            builder = SegmentBuilder(self.mappings)
+            from . import segment_build
+
+            docs = []
             versions: List[int] = []
             seqnos: List[int] = []
             new_locations: Dict[str, Tuple[int, int]] = {}
@@ -547,12 +687,17 @@ class ShardEngine:
                     if live is not None and not live[d]:
                         continue
                     doc_id = seg.doc_ids[d]
-                    builder.add(self.parser.parse(doc_id, seg.sources[d]))
+                    docs.append(self.parser.parse(doc_id, seg.sources[d]))
                     versions.append(int(self.seg_versions[si][d]))
                     seqnos.append(int(self.seg_seqnos[si][d]))
                     new_locations[doc_id] = (0, local)
                     local += 1
-            merged = builder.build()
+            # merges are the biggest builds of all — they ride the same
+            # device/host build pipeline as refresh
+            merged = segment_build.build_segment(
+                self.mappings, docs, shard_id=self.shard_id,
+                prefer_device=self.device_build,
+            )
             self.segments = [merged]
             self.live_docs = [None]
             self.seg_versions = [np.asarray(versions, np.int64)]
@@ -560,6 +705,9 @@ class ShardEngine:
             self.seg_names = [f"seg_{self.committed_generation}_m0"]
             self._locations = new_locations
             self.change_generation += 1
+            # a merge rewrites the segment list: any concurrent refresh
+            # build captured before it must discard itself
+            self._refresh_epoch += 1
             self.op_stats["merge_total"] += 1
             self._merge_uncommitted = True
             return True

@@ -1,0 +1,491 @@
+"""Device-side segment-build kernels (the streaming-ingest engine's
+compute tier).
+
+BM25S (PAPERS.md 2407.03618) moves all scoring math to index time;
+GPUSparse (2606.26441) builds its parallel inverted indices on the
+accelerator itself. Here the heavy array materialization of a segment
+build runs as jitted JAX programs so a refresh is a device pipeline
+instead of a host numpy pass:
+
+  - postings tiling: the flat (term-major, doc-sorted) posting stream
+    scatters into the padded [n_tiles, TILE] doc_id/tf planes, with the
+    per-tile block-max sidecars (tile_max_tf / tile_min_norm) and the
+    SmallFloat norm bytes computed in the same launch;
+  - keyword ordinals: per-doc (doc, ord) pairs dedup + sort + compact
+    into the multi-value CSR entirely on device (stable int sorts, so
+    the result is bit-identical to the host np path);
+  - vector columns: present-row scatter into the dense [N, dims] layout
+    (+ exists), and symmetric per-row int8 quantization mirroring
+    models/rerank.quantize_tokens / ops/ivf byte for byte;
+  - rank_vectors CSR offsets (int cumsum) for the late-interaction
+    token column;
+  - aggregation permutation tables: the bucket-major stable argsort +
+    boundary arrays search/aggs_device.py caches per executor
+    generation.
+
+Exactness contract: every kernel is integer/layout work or elementwise
+IEEE float work — no float reductions — so device-built columns are
+BIT-IDENTICAL to the host `SegmentBuilder` build (enforced by
+tests/test_ingest_nrt.py for every column family). Float reductions
+that numpy associates differently (cosine unit-normalization) stay on
+the host in BOTH paths, exactly like tokenization.
+
+Launch shapes are padded to power-of-two buckets so the jit cache stays
+bounded across refreshes of any size; padded scatter entries carry
+out-of-range destinations and drop in the kernel (`mode="drop"`).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Dict
+
+import numpy as np
+
+from ..index.segment import INVALID_DOC, TILE
+from ..utils.smallfloat import LENGTH_TABLE
+
+# ---------------------------------------------------------------------------
+# build-kernel observability (the `ingest.builds.kernel_ms` block)
+# ---------------------------------------------------------------------------
+
+_STATS_LOCK = threading.Lock()
+KERNEL_STATS: Dict[str, dict] = {
+    "kernel_ms": {},  # family -> cumulative device-build wall ms
+    "launches": {},  # family -> kernel launches
+}
+
+
+def _note_kernel(family: str, ms: float) -> None:
+    with _STATS_LOCK:
+        km = KERNEL_STATS["kernel_ms"]
+        km[family] = km.get(family, 0.0) + ms
+        ln = KERNEL_STATS["launches"]
+        ln[family] = ln.get(family, 0) + 1
+
+
+def kernel_stats_snapshot() -> dict:
+    with _STATS_LOCK:
+        return {
+            "kernel_ms": {
+                k: round(v, 2) for k, v in KERNEL_STATS["kernel_ms"].items()
+            },
+            "launches": dict(KERNEL_STATS["launches"]),
+        }
+
+
+def reset_kernel_stats() -> None:
+    with _STATS_LOCK:
+        KERNEL_STATS["kernel_ms"] = {}
+        KERNEL_STATS["launches"] = {}
+
+
+def bucket_pow2(n: int, floor: int = 8) -> int:
+    """Smallest power of two >= max(n, floor) — the static launch-shape
+    ladder for build kernels (bounds jit-cache growth across refreshes)."""
+    b = max(int(floor), 1)
+    n = max(int(n), 1)
+    while b < n:
+        b <<= 1
+    return b
+
+
+class _timed:
+    def __init__(self, family: str):
+        self.family = family
+
+    def __enter__(self):
+        self.t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, *exc):
+        _note_kernel(self.family, (time.perf_counter() - self.t0) * 1000.0)
+        return False
+
+
+# ---------------------------------------------------------------------------
+# postings tiling + norms + block-max sidecars
+# ---------------------------------------------------------------------------
+
+
+def _jax():
+    import jax  # lazy: numpy-backend indices never import jax
+
+    return jax
+
+
+_POSTINGS_JIT = {}
+
+
+def _postings_kernel(n_slots: int, n_docs_pad: int):
+    key = (n_slots, n_docs_pad)
+    fn = _POSTINGS_JIT.get(key)
+    if fn is not None:
+        return fn
+    jax = _jax()
+    import jax.numpy as jnp
+
+    table = jnp.asarray(LENGTH_TABLE.astype(np.int32))
+
+    @jax.jit
+    def run(docs, tfs, dest, lengths):
+        flat_doc = jnp.full((n_slots,), INVALID_DOC, jnp.int32)
+        flat_doc = flat_doc.at[dest].set(docs, mode="drop")
+        flat_tf = jnp.zeros((n_slots,), jnp.int32).at[dest].set(
+            tfs, mode="drop"
+        )
+        doc_ids = flat_doc.reshape(n_slots // TILE, TILE)
+        tf_tiles = flat_tf.reshape(n_slots // TILE, TILE)
+        tile_max_tf = tf_tiles.max(axis=1).astype(jnp.int32)
+        # SmallFloat intToByte4 via the strictly-increasing decode table
+        # (identical formulation to utils/smallfloat.encode_norms)
+        norms = (
+            jnp.searchsorted(table, lengths, side="right") - 1
+        ).astype(jnp.uint8)
+        valid = doc_ids >= 0
+        idx = jnp.clip(doc_ids, 0, n_docs_pad - 1)
+        tile_norms = jnp.where(valid, norms[idx].astype(jnp.int32), 255)
+        tile_min_norm = tile_norms.min(axis=1).astype(jnp.uint8)
+        return doc_ids, tf_tiles, tile_max_tf, norms, tile_min_norm
+
+    _POSTINGS_JIT[key] = run
+    return run
+
+
+def postings_tiles_device(
+    tids: np.ndarray,
+    docs: np.ndarray,
+    tfs: np.ndarray,
+    term_tile_start: np.ndarray,
+    term_df: np.ndarray,
+    lengths: np.ndarray,
+    n_tiles: int,
+    n_docs: int,
+):
+    """(doc_ids[n_tiles, TILE], tfs, tile_max_tf, norms[uint8 n_docs],
+    tile_min_norm) from the flat posting stream. Host has already done
+    the token/hash work: `tids`/`docs`/`tfs` are term-major doc-sorted
+    (np.lexsort), `term_tile_start`/`term_df` are the vectorized tile
+    layout plan. The device materializes the padded planes."""
+    P = len(docs)
+    # rank of each posting within its term → contiguous-tile destination
+    flat_start = np.zeros(len(term_df), np.int64)
+    if len(term_df) > 1:
+        np.cumsum(term_df[:-1].astype(np.int64), out=flat_start[1:])
+    rank = np.arange(P, dtype=np.int64) - flat_start[tids]
+    dest = term_tile_start[tids].astype(np.int64) * TILE + rank
+    n_slots = bucket_pow2(n_tiles, floor=1) * TILE
+    p_pad = bucket_pow2(P)
+    n_docs_pad = bucket_pow2(n_docs)
+    docs_p = np.full(p_pad, 0, np.int32)
+    tfs_p = np.zeros(p_pad, np.int32)
+    dest_p = np.full(p_pad, n_slots, np.int64)  # OOB → dropped
+    docs_p[:P] = docs
+    tfs_p[:P] = tfs
+    dest_p[:P] = dest
+    lengths_p = np.zeros(n_docs_pad, np.int32)
+    lengths_p[:n_docs] = lengths.astype(np.int32)
+    with _timed("postings"):
+        run = _postings_kernel(n_slots, n_docs_pad)
+        doc_ids, tf_tiles, tile_max_tf, norms, tile_min_norm = run(
+            docs_p, tfs_p, dest_p, lengths_p
+        )
+        out = (
+            np.ascontiguousarray(np.asarray(doc_ids)[:n_tiles]),
+            np.ascontiguousarray(np.asarray(tf_tiles)[:n_tiles]),
+            np.ascontiguousarray(np.asarray(tile_max_tf)[:n_tiles]),
+            np.ascontiguousarray(np.asarray(norms)[:n_docs]),
+            np.ascontiguousarray(np.asarray(tile_min_norm)[:n_tiles]),
+        )
+    return out
+
+
+def estimate_postings_nbytes(P: int, n_tiles: int, n_docs: int) -> int:
+    slots = bucket_pow2(n_tiles, floor=1) * TILE
+    return int(
+        3 * bucket_pow2(P) * 4  # docs/tfs/dest uploads
+        + 2 * slots * 4  # padded planes
+        + slots // TILE * 8  # tile sidecars
+        + 2 * bucket_pow2(n_docs) * 4  # lengths + norms
+    )
+
+
+# ---------------------------------------------------------------------------
+# keyword ordinals: device dedup + CSR assembly
+# ---------------------------------------------------------------------------
+
+_ORD_JIT = {}
+
+
+def _ordinals_kernel(n_pairs_pad: int, n_docs_pad: int):
+    key = (n_pairs_pad, n_docs_pad)
+    fn = _ORD_JIT.get(key)
+    if fn is not None:
+        return fn
+    jax = _jax()
+    import jax.numpy as jnp
+
+    @jax.jit
+    def run(docs, ords):
+        # (doc asc, ord asc) stable sort; padded pairs carry
+        # doc == n_docs_pad and sort last
+        order = jnp.lexsort((ords, docs))
+        d = docs[order]
+        o = ords[order]
+        first = jnp.concatenate(
+            [
+                jnp.ones(1, bool),
+                (d[1:] != d[:-1]) | (o[1:] != o[:-1]),
+            ]
+        )
+        validp = d < n_docs_pad
+        uniq = first & validp
+        rank = jnp.cumsum(uniq.astype(jnp.int32)) - 1
+        dest = jnp.where(uniq, rank, n_pairs_pad)
+        mv_ords = jnp.zeros((n_pairs_pad,), jnp.int32).at[dest].set(
+            o, mode="drop"
+        )
+        counts = jnp.zeros((n_docs_pad,), jnp.int32).at[d].add(
+            uniq.astype(jnp.int32), mode="drop"
+        )
+        doc_first = (
+            jnp.concatenate([jnp.ones(1, bool), d[1:] != d[:-1]]) & validp
+        )
+        ords_col = jnp.full((n_docs_pad,), -1, jnp.int32).at[
+            jnp.where(doc_first, d, n_docs_pad)
+        ].set(o, mode="drop")
+        total = uniq.astype(jnp.int32).sum()
+        offsets = jnp.concatenate(
+            [jnp.zeros(1, jnp.int32), jnp.cumsum(counts)]
+        )
+        return mv_ords, offsets, ords_col, total
+
+    _ORD_JIT[key] = run
+    return run
+
+
+def ordinals_device(docs: np.ndarray, ords: np.ndarray, n_docs: int):
+    """(ords[int32 n_docs], mv_ords[int32 total], mv_offsets[int32
+    n_docs+1]) from the raw per-value (doc, ord) pair stream (dups and
+    arbitrary order allowed — the device dedups + sorts). The host has
+    only done the string work (sorted unique term dictionary + ord id
+    assignment)."""
+    n_pairs = len(docs)
+    n_pairs_pad = bucket_pow2(n_pairs, floor=1)
+    n_docs_pad = bucket_pow2(n_docs, floor=1)
+    docs_p = np.full(n_pairs_pad, n_docs_pad, np.int32)
+    ords_p = np.zeros(n_pairs_pad, np.int32)
+    docs_p[:n_pairs] = docs
+    ords_p[:n_pairs] = ords
+    with _timed("ordinals"):
+        run = _ordinals_kernel(n_pairs_pad, n_docs_pad)
+        mv_ords, offsets, ords_col, total = run(docs_p, ords_p)
+        total = int(total)
+        out = (
+            np.ascontiguousarray(np.asarray(ords_col)[:n_docs]),
+            np.ascontiguousarray(np.asarray(mv_ords)[:total]),
+            np.ascontiguousarray(np.asarray(offsets)[: n_docs + 1]),
+        )
+    return out
+
+
+# ---------------------------------------------------------------------------
+# vector columns: present-row scatter + symmetric int8 quantization
+# ---------------------------------------------------------------------------
+
+_SCATTER_JIT = {}
+
+
+def _scatter_kernel(n_docs_pad: int, dims: int, dtype_str: str):
+    key = (n_docs_pad, dims, dtype_str)
+    fn = _SCATTER_JIT.get(key)
+    if fn is not None:
+        return fn
+    jax = _jax()
+    import jax.numpy as jnp
+
+    @jax.jit
+    def run(rows, idx):
+        mat = jnp.zeros((n_docs_pad, dims), rows.dtype).at[idx].set(
+            rows, mode="drop"
+        )
+        exists = jnp.zeros((n_docs_pad,), bool).at[idx].set(
+            True, mode="drop"
+        )
+        return mat, exists
+
+    _SCATTER_JIT[key] = run
+    return run
+
+
+def scatter_rows_device(rows: np.ndarray, idx: np.ndarray, n_docs: int):
+    """Dense [n_docs, dims] column + exists mask from the present rows
+    (pure placement — bit-exact by construction)."""
+    m = len(rows)
+    dims = int(rows.shape[1])
+    m_pad = bucket_pow2(m, floor=1)
+    n_docs_pad = bucket_pow2(n_docs, floor=1)
+    rows_p = np.zeros((m_pad, dims), rows.dtype)
+    idx_p = np.full(m_pad, n_docs_pad, np.int32)
+    rows_p[:m] = rows
+    idx_p[:m] = idx
+    with _timed("vectors"):
+        run = _scatter_kernel(n_docs_pad, dims, str(rows.dtype))
+        mat, exists = run(rows_p, idx_p)
+        out = (
+            np.ascontiguousarray(np.asarray(mat)[:n_docs]),
+            np.ascontiguousarray(np.asarray(exists)[:n_docs]),
+        )
+    return out
+
+
+_QUANT_JIT = {}
+
+
+def _quantize_kernel(m_pad: int, dims: int):
+    key = (m_pad, dims)
+    fn = _QUANT_JIT.get(key)
+    if fn is not None:
+        return fn
+    jax = _jax()
+    import jax.numpy as jnp
+
+    @jax.jit
+    def run(v, c127):
+        # models/rerank.quantize_tokens verbatim: elementwise IEEE ops
+        # and an exact-comparison row max — bit-identical to numpy.
+        # 127 rides as a RUNTIME operand: a constant divisor would let
+        # XLA strength-reduce x/127 into x*(1/127), which differs from
+        # numpy's true divide in the last ulp.
+        vf32 = v.astype(jnp.float32)
+        maxabs = jnp.abs(vf32).max(axis=1)
+        scales = (maxabs / c127).astype(jnp.float32)
+        safe = jnp.where(scales == 0, 1.0, scales)
+        qv = jnp.clip(jnp.rint(vf32 / safe[:, None]), -127, 127).astype(
+            jnp.int8
+        )
+        return qv, scales
+
+    _QUANT_JIT[key] = run
+    return run
+
+
+def quantize_int8_device(mat: np.ndarray):
+    """(int8 rows, f32 per-row scales) — the device twin of
+    models/rerank.quantize_tokens (same scheme as ops/ivf int8)."""
+    m = len(mat)
+    if m == 0:
+        return (
+            np.zeros((0, mat.shape[1]), np.int8),
+            np.zeros(0, np.float32),
+        )
+    dims = int(mat.shape[1])
+    m_pad = bucket_pow2(m, floor=1)
+    mat_p = np.zeros((m_pad, dims), np.float32)
+    mat_p[:m] = mat.astype(np.float32)
+    with _timed("quantize"):
+        run = _quantize_kernel(m_pad, dims)
+        qv, scales = run(mat_p, np.float32(127.0))
+        out = (
+            np.ascontiguousarray(np.asarray(qv)[:m]),
+            np.ascontiguousarray(np.asarray(scales)[:m]),
+        )
+    return out
+
+
+# ---------------------------------------------------------------------------
+# rank_vectors CSR offsets
+# ---------------------------------------------------------------------------
+
+_CSR_JIT = {}
+
+
+def _csr_kernel(n_docs_pad: int):
+    fn = _CSR_JIT.get(n_docs_pad)
+    if fn is not None:
+        return fn
+    jax = _jax()
+    import jax.numpy as jnp
+
+    @jax.jit
+    def run(counts):
+        offsets = jnp.concatenate(
+            [jnp.zeros(1, jnp.int32), jnp.cumsum(counts.astype(jnp.int32))]
+        )
+        exists = counts > 0
+        return offsets, exists
+
+    _CSR_JIT[n_docs_pad] = fn = run
+    return fn
+
+
+def csr_offsets_device(counts: np.ndarray, n_docs: int):
+    """(tok_offsets[int32 n_docs+1], exists[bool n_docs]) from per-doc
+    token counts — the rank_vectors flat-CSR packing plan."""
+    n_docs_pad = bucket_pow2(n_docs, floor=1)
+    counts_p = np.zeros(n_docs_pad, np.int32)
+    counts_p[:n_docs] = counts
+    with _timed("rank_vectors"):
+        run = _csr_kernel(n_docs_pad)
+        offsets, exists = run(counts_p)
+        out = (
+            np.ascontiguousarray(np.asarray(offsets)[: n_docs + 1]),
+            np.ascontiguousarray(np.asarray(exists)[:n_docs]),
+        )
+    return out
+
+
+# ---------------------------------------------------------------------------
+# aggregation permutation tables (search/aggs_device.counts_layout)
+# ---------------------------------------------------------------------------
+
+_PERM_JIT = {}
+
+
+def _perm_kernel(n_pad: int, nb: int):
+    key = (n_pad, nb)
+    fn = _PERM_JIT.get(key)
+    if fn is not None:
+        return fn
+    jax = _jax()
+    import jax.numpy as jnp
+
+    @jax.jit
+    def run(ids):
+        # stable argsort: the unique bucket-major permutation, identical
+        # to np.argsort(kind="stable") by the stability contract
+        perm = jnp.argsort(ids, stable=True)
+        bounds = jnp.searchsorted(
+            ids[perm], jnp.arange(nb + 1, dtype=ids.dtype)
+        ).astype(jnp.int32)
+        return perm.astype(jnp.int32), bounds
+
+    _PERM_JIT[key] = run
+    return run
+
+
+def agg_perm_tables_device(ids: np.ndarray, nb: int):
+    """(perm[int32 n], bounds[int32 nb+1]) — the bucket-major stable
+    permutation + boundary table the device agg engine caches per
+    executor generation. `ids` are bucket indices in [0, nb] (the nb
+    sentinel marks gated-out slots), so int32 is always exact; None is
+    returned when the inputs somehow exceed int32 (caller keeps the
+    host path)."""
+    n = len(ids)
+    if n == 0 or nb + 1 >= 2**31 or (n and int(ids.max()) >= 2**31):
+        return None
+    n_pad = bucket_pow2(n, floor=1)
+    ids_p = np.full(n_pad, nb + 1, np.int32)  # pads sort last
+    ids_p[:n] = ids.astype(np.int32)
+    with _timed("agg_tables"):
+        run = _perm_kernel(n_pad, nb)
+        perm, bounds = run(ids_p)
+        # pads carry id nb+1 and sort strictly after every real slot, so
+        # the first n entries of the stable permutation are exactly the
+        # real permutation and the boundary table is unaffected
+        out = (
+            np.ascontiguousarray(np.asarray(perm)[:n]),
+            np.ascontiguousarray(np.asarray(bounds)),
+        )
+    return out

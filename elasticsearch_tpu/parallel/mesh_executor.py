@@ -132,6 +132,23 @@ class _MeshSnapshot:
         self.aggs: Dict[tuple, dict] = {}  # stacked agg column views
         self.steps: Dict[tuple, object] = {}
         self.closed = False
+        # ---- incremental rebuild state: per-entry identity keys
+        # ((sid, shard generation, si) — a bumped shard invalidates ALL
+        # its entries, since inverse norms / idf weights are shard-level
+        # stats) and the host staging copies of every stacked view.
+        # When the next generation rebuilds, rows whose key is unchanged
+        # copy from the previous stack instead of re-extracting (and
+        # re-downloading) tilings — a one-shard NRT refresh rebuilds
+        # only that shard's rows. ----
+        gen_of = dict(gens)
+        self.entry_keys = [
+            (sid, gen_of.get(sid), si) for sid, si in entries
+        ]
+        self.host_stacks: Dict[object, dict] = {}
+        # (prev entry_keys, prev host_stacks) captured at build — plain
+        # data, NOT a reference to the previous snapshot, so the old
+        # generation's device arrays die on schedule
+        self.reuse_src: Optional[tuple] = None
 
     @property
     def device_ids(self) -> Tuple[int, ...]:
@@ -206,6 +223,8 @@ class MeshExecutor:
             "launches": 0,  # SPMD programs dispatched
             "jobs": 0,  # queries carried by those programs
             "rebuilds": 0,  # snapshot rebuilds on generation bumps
+            "incremental_rebuilds": 0,  # rebuilds that reused prev rows
+            "entries_reused": 0,  # stacked rows copied, not re-extracted
             "degraded": 0,  # HBM-budget degrades to single-device
             "fallbacks": 0,  # routed requests that fell back mid-flight
         }
@@ -304,17 +323,72 @@ class MeshExecutor:
         mesh = make_mesh(len(entries), n_data=n_data, devices=devices)
         fold = fold_factor(mesh, len(entries))
         snap = _MeshSnapshot(mesh, fold, entries, readers, executors, gens)
+        # incremental rebuild: adopt the PREVIOUS snapshot's host
+        # staging stacks (plain arrays, not the snapshot itself) so
+        # views can copy unchanged-entry rows instead of re-extracting —
+        # a one-shard NRT refresh re-stages only that shard's rows
+        old = self._snapshot
+        if old is not None and not old.closed and old.host_stacks:
+            snap.reuse_src = (old.entry_keys, old.host_stacks)
         # live ∧ in-range mask, shared by every family
         live = np.zeros((snap.e_pad, snap.n_docs_max), bool)
-        for e, (sid, si) in enumerate(entries):
+
+        def _fill_live(e: int) -> None:
+            sid, si = snap.entries[e]
             n = readers[sid].segments[si].num_docs
             l = readers[sid].live_docs[si]
             live[e, :n] = True if l is None else l
+
+        self._fill_stack(snap, "live", {"live": live}, _fill_live)
         snap.charge(live.nbytes)
         snap.live = jax.device_put(
             live, NamedSharding(mesh, P(SHARD_AXIS, None))
         )
         return snap
+
+    def _fill_stack(self, snap, key, arrays, fill_entry) -> int:
+        """Fills the leading-entry-axis rows of a stacked host view:
+        entries whose (sid, shard-generation, si) key is unchanged from
+        the previous snapshot copy their previous row (same envelope
+        shape required); everything else re-extracts via `fill_entry`.
+        Registers the stack for the NEXT rebuild and returns the reused
+        row count."""
+        prev_map = None
+        prev_arrays = None
+        if snap.reuse_src is not None:
+            prev_keys, prev_stacks = snap.reuse_src
+            got = prev_stacks.get(key)
+            # ROW-shape compatibility only: appending a segment changes
+            # the entry padding (leading axis) but unchanged shards'
+            # rows still copy over as long as the per-row envelope
+            # (t_max / n_docs_max / dims) is stable
+            if got is not None and set(got) >= set(arrays) and all(
+                got[name].shape[1:] == arr.shape[1:]
+                and got[name].dtype == arr.dtype
+                for name, arr in arrays.items()
+            ):
+                prev_arrays = got
+                prev_map = {k: i for i, k in enumerate(prev_keys)}
+        reused = 0
+        for e in range(len(snap.entries)):
+            pi = (
+                prev_map.get(snap.entry_keys[e])
+                if prev_map is not None
+                else None
+            )
+            if pi is not None:
+                for name, arr in arrays.items():
+                    arr[e] = prev_arrays[name][pi]
+                reused += 1
+            else:
+                fill_entry(e)
+        if reused:
+            self.stats["entries_reused"] += reused
+            if not getattr(snap, "_counted_incremental", False):
+                snap._counted_incremental = True
+                self.stats["incremental_rebuilds"] += 1
+        snap.host_stacks[key] = arrays
+        return reused
 
     def close(self) -> None:
         with self._lock:
@@ -346,7 +420,9 @@ class MeshExecutor:
             )
             tfs = np.zeros((snap.e_pad, t_max, TILE), np.int32)
             inv = np.zeros((snap.e_pad, snap.n_docs_max), np.float32)
-            for e, (sid, si) in enumerate(snap.entries):
+
+            def _fill_text(e: int) -> None:
+                sid, si = snap.entries[e]
                 tiling = tilings[e]
                 if tiling is not None:
                     nt = int(tiling.doc_ids.shape[0])
@@ -355,6 +431,15 @@ class MeshExecutor:
                 n = snap.readers[sid].segments[si].num_docs
                 ex = snap.executors[sid]
                 inv[e, :n] = np.asarray(ex._inv_norm(si, field, n))
+
+            # unchanged-shard rows copy from the previous generation's
+            # staging stack (no tiling download, no norm re-extract)
+            self._fill_stack(
+                snap,
+                ("text", field),
+                {"doc_ids": doc_ids, "tfs": tfs, "inv": inv},
+                _fill_text,
+            )
             nbytes = doc_ids.nbytes + tfs.nbytes + inv.nbytes
             snap.charge(nbytes)
             sh3 = NamedSharding(snap.mesh, P(SHARD_AXIS, None, None))
@@ -397,7 +482,12 @@ class MeshExecutor:
             vectors = np.zeros((snap.e_pad, snap.n_docs_max, dims), dtype)
             cand = np.zeros((snap.e_pad, snap.n_docs_max), bool)
             n_per_entry = np.zeros(snap.e_pad, np.int64)
-            live_host = np.asarray(jax.device_get(snap.live))
+            live_stack = snap.host_stacks.get("live")
+            live_host = (
+                live_stack["live"]
+                if live_stack is not None
+                else np.asarray(jax.device_get(snap.live))
+            )
             for e, (sid, si) in enumerate(snap.entries):
                 got = mats[e]
                 if got is None:
@@ -407,10 +497,23 @@ class MeshExecutor:
                     raise MeshUnavailable(
                         f"vector field [{field}] has mixed dims/similarity"
                     )
-                n = snap.readers[sid].segments[si].num_docs
+                n_per_entry[e] = snap.readers[sid].segments[si].num_docs
+
+            def _fill_knn(e: int) -> None:
+                got = mats[e]
+                if got is None:
+                    return
+                mat, vf = got
+                n = int(n_per_entry[e])
                 vectors[e, :n] = mat
                 cand[e, :n] = vf.exists & live_host[e, :n]
-                n_per_entry[e] = n
+
+            self._fill_stack(
+                snap,
+                ("knn", field, dims, similarity),
+                {"vectors": vectors, "cand": cand},
+                _fill_knn,
+            )
             snap.charge(vectors.nbytes + cand.nbytes)
             sh3 = NamedSharding(snap.mesh, P(SHARD_AXIS, None, None))
             sh2 = NamedSharding(snap.mesh, P(SHARD_AXIS, None))

@@ -725,6 +725,20 @@ class RestActions:
                         translog_block["last_fsync_age_ms"],
                         ts["last_fsync_age_ms"],
                     )
+        # streaming-ingest counters (index/segment_build.py): refresh
+        # count + visibility-lag percentiles, device vs host segment
+        # builds (+ degrade/fallback/discard counters), per-column-family
+        # build kernel ms, concurrent-build overlap, post-swap prewarm
+        # time, and the transient `build` ledger bytes
+        from ..index.segment_build import stats_snapshot as ingest_stats
+
+        ingest_block = ingest_stats()
+        ingest_block["refreshers_running"] = sum(
+            1
+            for idx in self.cluster.indices.values()
+            if getattr(idx, "_refresher", None) is not None
+            and idx._refresher.is_alive()
+        )
         recovery_block = {
             "replayed_ops": dur["replayed_ops"],
             "tail_replays": dur["tail_replays"],
@@ -778,6 +792,7 @@ class RestActions:
                     "knn": knn_block,
                     "rescore": rescore_block,
                     "translog": translog_block,
+                    "ingest": ingest_block,
                     "recovery": recovery_block,
                     # overload-protection block (search/admission.py):
                     # per-tenant queue depths, the adaptive concurrency
@@ -990,12 +1005,35 @@ class RestActions:
             "_primary_term": r.primary_term,
         }
 
-    def _maybe_refresh(self, idx, qs):
+    @staticmethod
+    def _parse_refresh_param(qs):
+        """Validated ?refresh= value: None | "true" | "false" |
+        "wait_for". Anything else is a request-scoped 400 (the
+        RestActions.parseRefreshPolicy contract)."""
         refresh = qs.get("refresh", [None])[0]
-        if refresh in ("true", "", "wait_for"):
+        if refresh is None:
+            return None
+        if refresh == "":
+            return "true"
+        if refresh in ("true", "false", "wait_for"):
+            return refresh
+        raise ClusterError(
+            400,
+            f"Unknown value for refresh: [{refresh}].",
+            "illegal_argument_exception",
+        )
+
+    def _maybe_refresh(self, idx, qs):
+        policy = self._parse_refresh_param(qs)
+        if policy == "true":
             idx.refresh()
+        elif policy == "wait_for":
+            # blocks on the NEXT background generation swap (or degrades
+            # to a blocking refresh when no refresher is running)
+            idx.wait_for_refresh()
 
     def index_doc(self, body, params, qs, op_type=None):
+        self._parse_refresh_param(qs)  # invalid ?refresh 400s pre-write
         idx, index_name = self.cluster.resolve_write_index(params["index"])
         params = dict(params, index=index_name)
         routing = qs.get("routing", [None])[0]
@@ -1069,6 +1107,7 @@ class RestActions:
         return 200, doc["_source"]
 
     def delete_doc(self, body, params, qs):
+        self._parse_refresh_param(qs)  # invalid ?refresh 400s pre-write
         idx, index_name = self.cluster.resolve_write_index(
             params["index"], allow_auto_create=False
         )
@@ -1088,6 +1127,7 @@ class RestActions:
         """_update: partial doc merge, doc_as_upsert, SCRIPTED updates
         (ctx._source/ctx.op contract), noop detection
         (TransportUpdateAction + UpdateHelper)."""
+        self._parse_refresh_param(qs)  # invalid ?refresh 400s pre-write
         idx, index_name = self.cluster.resolve_write_index(
             params["index"], allow_auto_create=False
         )
@@ -1699,6 +1739,9 @@ class RestActions:
         items: List[dict] = []
         errors = False
         t0 = time.perf_counter()
+        # ?refresh validates BEFORE any op is applied: an invalid value
+        # is a request-scoped 400, not a half-applied bulk
+        refresh_policy = self._parse_refresh_param(qs)
         i = 0
         lines = body
         default_index = params.get("index")
@@ -1824,13 +1867,16 @@ class RestActions:
                         }
                     }
                 )
-        refresh = qs.get("refresh", [None])[0]
-        if refresh in ("true", "", "wait_for"):
+        if refresh_policy in ("true", "wait_for"):
             for name in touched:
                 try:
-                    self.cluster.get_index(name).refresh()
+                    idx = self.cluster.get_index(name)
                 except ClusterError:
-                    pass
+                    continue
+                if refresh_policy == "wait_for":
+                    idx.wait_for_refresh()
+                else:
+                    idx.refresh()
         took = int((time.perf_counter() - t0) * 1000)
         return 200, {"took": took, "errors": errors, "items": items}
 

@@ -413,10 +413,27 @@ def counts_layout(ex, si: int, skey: tuple, space):
         if key in ex._agg_cols:
             return ex._agg_cols[key]
         ids, map_host, gate, nb = space
-        perm = np.argsort(ids, kind="stable")
-        bounds = np.searchsorted(
-            ids[perm], np.arange(nb + 1)
-        ).astype(np.int32)
+        perm = bounds = None
+        from ..common.settings import device_build_mode
+
+        if device_build_mode() != "off":
+            # bucket ids are small ints: the stable argsort + boundary
+            # table build rides the device build kernels (bit-identical
+            # by the stable-sort contract; ops/index_build.py)
+            got = None
+            try:
+                from ..ops.index_build import agg_perm_tables_device
+
+                got = agg_perm_tables_device(ids, nb)
+            except Exception:
+                got = None  # host fallback below — never a wrong table
+            if got is not None:
+                perm, bounds = got
+        if perm is None:
+            perm = np.argsort(ids, kind="stable")
+            bounds = np.searchsorted(
+                ids[perm], np.arange(nb + 1)
+            ).astype(np.int32)
         map_p = (
             perm if map_host is None else map_host[perm]
         ).astype(np.int32)

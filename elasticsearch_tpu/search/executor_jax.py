@@ -157,6 +157,30 @@ class DeviceSegment:
             ),
             charge=charge, category="doc_values",
         )
+        self._adopt_charge = charge
+
+    def adopt_from(self, other: "DeviceSegment") -> None:
+        """Cross-generation reuse: a refresh appends segments but never
+        mutates existing ones, so the NEW executor generation adopts the
+        previous generation's device uploads for every already-uploaded
+        field of this (same, immutable) segment instead of re-shipping
+        them over the tunnel. Adopted bytes are re-charged to THIS
+        executor's ledger records — the old executor's close() releases
+        its own — so accounting stays per-generation while the arrays
+        are shared."""
+        for mine, theirs, cat in (
+            (self.postings, other.postings, "postings"),
+            (self.numerics, other.numerics, "doc_values"),
+            (self.vectors, other.vectors, "vectors"),
+            (self.ordinals, other.ordinals, "doc_values"),
+        ):
+            with theirs._lock:
+                items = dict(theirs._cache)
+            for k, v in items.items():
+                if k in mine._names and k not in mine._cache:
+                    mine._cache[k] = v
+                    if self._adopt_charge is not None:
+                        self._adopt_charge(cat, _tree_nbytes(v), False)
 
 
 class JaxExecutor:
@@ -168,6 +192,7 @@ class JaxExecutor:
         k1: float = bm25.DEFAULT_K1,
         b: float = bm25.DEFAULT_B,
         device=None,
+        reuse_from: "Optional[JaxExecutor]" = None,
     ):
         self.reader = reader
         self.k1 = k1
@@ -186,6 +211,18 @@ class JaxExecutor:
             DeviceSegment(s, device, charge=self._charge)
             for s in reader.segments
         ]
+        if reuse_from is not None:
+            # NRT generation lifecycle: segments are immutable and a
+            # refresh only appends, so the new generation adopts the
+            # old one's device uploads for unchanged segments — the
+            # swap re-uploads only the NEW segment's columns
+            prev = {
+                id(ds.seg): ds for ds in reuse_from.device_segments
+            }
+            for ds in self.device_segments:
+                old = prev.get(id(ds.seg))
+                if old is not None:
+                    ds.adopt_from(old)
         # the oracle is reused for stats, weights, and host-only nodes
         # (match_phrase position verification)
         self._oracle = NumpyExecutor(reader, k1, b)
@@ -304,6 +341,62 @@ class JaxExecutor:
             charges, self._charges = self._charges, []
         for category, nbytes in charges:
             hbm_ledger.release(category, nbytes)
+
+    def prewarm(self, settings=None) -> None:
+        """Generation-lifecycle prewarm (the NRT refresher calls this
+        right after a generation swap, BEFORE queries observe the new
+        executor): uploads the serving-hot device columns and builds
+        the per-generation serving caches — postings tilings +
+        block-max indexes + chunked scorers, inverse norms, vector
+        columns, the IVF indexes (when `index.knn.type: ivf`) and the
+        rerank token columns — so the first query after a refresh pays
+        neither uploads nor k-means. Best-effort by design: any failure
+        (HBM breaker, fault injection) leaves the lazy path to do what
+        it always did."""
+        from ..index.mapping import RANK_VECTORS
+
+        settings = settings or {}
+        for si, seg in enumerate(self.reader.segments):
+            n = seg.num_docs
+            if n == 0:
+                continue
+            for fname in seg.postings:
+                try:
+                    self.device_segments[si].postings.get(fname)
+                    self._inv_norm(si, fname, n)
+                    self.block_index(si, fname)
+                    self.chunked_scorer(si, fname)
+                except Exception:
+                    pass
+            for fname in seg.vectors:
+                try:
+                    self.device_segments[si].vectors.get(fname)
+                except Exception:
+                    pass  # breaker: the lazy path degrades identically
+                if str(settings.get("knn.type", "exact")) == "ivf":
+                    try:
+                        from . import ann as ann_mod
+
+                        class _Sec:
+                            nprobe = None
+
+                        spec = ann_mod.resolve(settings, _Sec(), False)
+                        if spec is not None:
+                            self.ann_index(si, fname, spec)
+                    except Exception:
+                        pass
+        for fname, mf in list(self.reader.mappings.fields.items()):
+            if getattr(mf, "type", None) == RANK_VECTORS:
+                try:
+                    from ..models import rerank as rerank_model
+
+                    model = rerank_model.resolve_model(
+                        self.reader.mappings, settings, fname
+                    )
+                    if model is not None:
+                        self.rerank_column(model)
+                except Exception:
+                    pass
 
     # ---- filter-context evaluation via the device bitset cache ----
 

@@ -1,0 +1,187 @@
+#!/usr/bin/env bash
+# Streaming-ingest smoke: a sustained mixed write+query stream through
+# the NRT refresh pipeline (device segment builds, double-buffered
+# generations, background refresher).
+#
+# Gates:
+#   1. Build parity — a device-built generation answers bit-identically
+#      to a host-built one on every probe query, and device builds
+#      actually ran (ES_TPU_DEVICE_BUILD=force would hard-error
+#      otherwise). Always enforced.
+#   2. Zero acked-doc loss when the durability harness crashes the box
+#      MID-REFRESH (engine.refresh + build.device crash sites, request
+#      durability): recovery replays every acked op and the reopened
+#      shard serves them. Always enforced.
+#   3. Refresh-lag p95 sub-second at the smoke corpus scale AND query
+#      p99 under concurrent ingest within INGEST_SMOKE_MAX_P99_RATIO
+#      (default 1.5x) of the read-only number — enforced only on hosts
+#      with >= INGEST_SMOKE_MIN_CORES (default 8) cores: writers,
+#      queries, the build kernels, and the refresher genuinely overlap
+#      there; on a 1-core CI box everything serializes onto one core
+#      and the honest expectation is contention (same skip rule as
+#      aggs_smoke.sh / mesh_smoke.sh). Measured numbers print either
+#      way.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+export JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}"
+export ES_TPU_ADMISSION=off
+export ES_TPU_BUCKET_WARMUP=0
+export ES_TPU_DEVICE_BUILD="${ES_TPU_DEVICE_BUILD:-auto}"
+export ES_TPU_BG_REFRESH=auto
+
+BASE_DOCS="${INGEST_SMOKE_BASE_DOCS:-20000}"
+SECONDS_W="${INGEST_SMOKE_SECONDS:-8}"
+RATE="${INGEST_SMOKE_RATE:-400}"
+MIN_CORES="${INGEST_SMOKE_MIN_CORES:-8}"
+MAX_P99_RATIO="${INGEST_SMOKE_MAX_P99_RATIO:-1.5}"
+MAX_LAG_P95_MS="${INGEST_SMOKE_MAX_LAG_P95_MS:-1000}"
+
+python - "$BASE_DOCS" "$SECONDS_W" "$RATE" "$MIN_CORES" \
+    "$MAX_P99_RATIO" "$MAX_LAG_P95_MS" <<'PY'
+import os
+import sys
+
+import numpy as np
+
+base_docs, dur, rate = int(sys.argv[1]), float(sys.argv[2]), float(sys.argv[3])
+min_cores = int(sys.argv[4])
+max_ratio, max_lag = float(sys.argv[5]), float(sys.argv[6])
+
+sys.path.insert(0, os.getcwd())
+
+# ---- gate 1: device-vs-host build parity on a live service ----------------
+from elasticsearch_tpu.cluster.indices import IndexService
+from elasticsearch_tpu.index import segment_build
+
+rng = np.random.default_rng(9)
+vocab = np.array([f"w{i}" for i in range(2000)])
+zipf = 1.0 / np.arange(1, 2001) ** 1.1
+zipf /= zipf.sum()
+
+
+def source(r):
+    return {
+        "body": " ".join(r.choice(vocab, size=int(r.integers(6, 14)), p=zipf)),
+        "popularity": int(r.integers(0, 1000)),
+        "tag": str(r.choice(["a", "b", "c", "d"])),
+    }
+
+
+MAPPINGS = {
+    "properties": {
+        "body": {"type": "text"},
+        "popularity": {"type": "integer"},
+        "tag": {"type": "keyword"},
+    }
+}
+
+probe_bodies = [
+    {"query": {"match": {"body": f"{vocab[50 + i]} {vocab[90 + i]}"}},
+     "size": 10}
+    for i in range(12)
+]
+
+results = {}
+for mode in ("force", "off"):
+    os.environ["ES_TPU_DEVICE_BUILD"] = mode
+    os.environ["ES_TPU_BG_REFRESH"] = "off"  # deterministic refresh here
+    segment_build.reset_stats()
+    svc = IndexService(
+        f"parity-{mode}",
+        settings={"number_of_shards": 1, "search.backend": "jax"},
+        mappings_json=MAPPINGS,
+    )
+    r = np.random.default_rng(1)
+    for i in range(2000):
+        svc.index_doc(f"d{i}", source(r))
+        if i % 500 == 499:
+            svc.refresh()  # several generations, several builds
+    svc.refresh()
+    results[mode] = [
+        [(h["_id"], h["_score"]) for h in svc.search(b)["hits"]["hits"]]
+        for b in probe_bodies
+    ]
+    if mode == "force":
+        assert segment_build.INGEST_STATS["device_builds"] >= 4, (
+            segment_build.INGEST_STATS
+        )
+    svc.close()
+assert results["force"] == results["off"], "device-built generation diverged"
+print("[ingest_smoke] gate 1 OK: device builds bit-identical "
+      "(hit-for-hit on all probes)")
+
+# ---- gate 2: crash mid-refresh loses zero acked docs ----------------------
+import tempfile
+
+from elasticsearch_tpu.analysis import AnalysisRegistry
+from elasticsearch_tpu.common.faults import SimulatedCrash, faults
+from elasticsearch_tpu.index.engine import ShardEngine
+from elasticsearch_tpu.index.mapping import Mappings
+
+os.environ["ES_TPU_DEVICE_BUILD"] = "auto"
+for site in ("engine.refresh", "build.device"):
+    with tempfile.TemporaryDirectory() as tdir:
+        eng = ShardEngine(
+            Mappings(MAPPINGS), AnalysisRegistry(), path=tdir,
+            device_build=True,
+        )
+        r = np.random.default_rng(2)
+        acked = []
+        for i in range(300):
+            eng.index(f"a{i}", source(r))
+            acked.append(f"a{i}")
+        eng.refresh()
+        for i in range(300, 420):
+            eng.index(f"a{i}", source(r))
+            acked.append(f"a{i}")
+        faults.configure({"rules": [{"site": site, "kind": "crash"}]})
+        crashed = False
+        try:
+            eng.refresh_concurrent()
+        except SimulatedCrash:
+            crashed = True
+        assert crashed, f"no crash fired at {site}"
+        eng.crash()
+        faults.configure(None)
+        rec = ShardEngine(
+            Mappings(MAPPINGS), AnalysisRegistry(), path=tdir,
+            device_build=True,
+        )
+        assert rec.num_docs == len(acked), (site, rec.num_docs, len(acked))
+        missing = [i for i in acked if rec.get(i) is None]
+        assert not missing, (site, missing[:5])
+        rec.close()
+print("[ingest_smoke] gate 2 OK: crash at engine.refresh/build.device "
+      "loses zero acked docs (request durability)")
+
+# ---- mixed-traffic window (gate 3 on big hosts; printed everywhere) -------
+os.environ["BENCH_INGEST_BASE"] = str(base_docs)
+os.environ["BENCH_INGEST_SECONDS"] = str(dur)
+os.environ["BENCH_INGEST_RATE"] = str(rate)
+os.environ["BENCH_INGEST_WRITERS"] = "2"
+os.environ["ES_TPU_BG_REFRESH"] = "auto"
+import bench
+
+blk = bench.run_indexing_config()
+assert blk["all_streamed_docs_searchable"], "streamed docs went missing"
+assert blk["device_builds"] >= 1, blk
+cores = len(os.sched_getaffinity(0))
+lag95 = blk["refresh_lag"]["p95_ms"]
+ratio = blk["p99_ratio_vs_readonly"]
+print(f"[ingest_smoke] mixed window: {blk['docs_per_s']} docs/s, "
+      f"refresh-lag p95={lag95}ms, p99 ratio={ratio} (cores={cores})")
+if cores >= min_cores:
+    assert lag95 is not None and lag95 <= max_lag, (
+        f"refresh-lag p95 {lag95}ms over the {max_lag}ms gate"
+    )
+    assert ratio is not None and ratio <= max_ratio, (
+        f"query p99 under ingest {ratio}x over the {max_ratio}x gate"
+    )
+    print("[ingest_smoke] gate 3 OK: sub-second refresh lag + "
+          f"p99 within {max_ratio}x of read-only")
+else:
+    print(f"[ingest_smoke] gate 3 SKIPPED (cores={cores} < {min_cores}: "
+          "writers/queries/builds serialize on this box)")
+print("[ingest_smoke] PASS")
+PY

@@ -21,8 +21,12 @@
 # (scripts/durability_smoke.sh: seeded 10% crash schedule over every
 # write-path fault site, zero acked-loss under request durability,
 # fsync-bounded loss under async, primary/replica checksum convergence
-# across a node crash+restart). The combined exit code fails if any
-# enabled run fails.
+# across a node crash+restart). T1_INGEST=1 additionally runs the
+# streaming-ingest smoke (scripts/ingest_smoke.sh: device-vs-host build
+# parity + zero acked-loss on a crash mid-refresh always; sub-second
+# refresh-lag p95 and query-p99-under-ingest <= 1.5x read-only on
+# >= 8-core hosts). The combined exit code fails if any enabled run
+# fails.
 set -o pipefail; rm -f /tmp/_t1.log; timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' --continue-on-collection-errors -p no:cacheprovider -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log; rc=${PIPESTATUS[0]}; echo DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' /tmp/_t1.log | tr -cd . | wc -c)
 if [ "${T1_MESH:-0}" = "1" ]; then
     echo "--- T1_MESH: mesh-marked tests on the forced 8-device host platform ---"
@@ -62,5 +66,11 @@ if [ "${T1_DURABILITY:-0}" = "1" ]; then
     bash scripts/durability_smoke.sh
     dur_rc=$?
     [ "$rc" -eq 0 ] && rc=$dur_rc
+fi
+if [ "${T1_INGEST:-0}" = "1" ]; then
+    echo "--- T1_INGEST: streaming-ingest smoke (build parity + crash + NRT SLO gates) ---"
+    bash scripts/ingest_smoke.sh
+    ingest_rc=$?
+    [ "$rc" -eq 0 ] && rc=$ingest_rc
 fi
 exit $rc

@@ -26,6 +26,15 @@ os.environ["ES_TPU_ADMISSION"] = "off"
 # tested); tests/test_continuous_batching.py re-arms it per batcher via
 # the `warmup_enabled` attribute to prove the no-recompile contract.
 os.environ["ES_TPU_BUCKET_WARMUP"] = "0"
+
+# Streaming-ingest knobs are pinned for tier-1 determinism: the
+# background refresher would make buffered writes searchable mid-test
+# (tests drive refresh explicitly), and device segment builds — while
+# bit-identical to the host build by contract — would add per-shape
+# build-kernel compiles across the whole suite. tests/test_ingest_nrt.py
+# arms both explicitly.
+os.environ["ES_TPU_BG_REFRESH"] = "off"
+os.environ["ES_TPU_DEVICE_BUILD"] = "off"
 xla_flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in xla_flags:
     os.environ["XLA_FLAGS"] = (

@@ -1,0 +1,958 @@
+"""Streaming ingest & NRT search: device segment builds, double-buffered
+generations, refresh semantics, and generation pinning.
+
+Contract under test (the streaming-ingest tentpole):
+  * device-built segment columns are BIT-IDENTICAL to the host
+    SegmentBuilder build for every column family (postings/norms,
+    ordinals, vectors, rank_vectors CSR) plus the int8-quantize and
+    agg-permutation kernels;
+  * refresh-under-fault never yields a wrong answer: an error at
+    `build.device` degrades to the host build, an error at
+    `engine.refresh` (or a crash mid-build) keeps the OLD generation
+    serving with the ops still buffered+logged, and a crash mid-refresh
+    loses zero acked docs under `request` durability;
+  * the double-buffered refresh (`refresh_concurrent`) builds outside
+    the engine lock, installs atomically, never resurrects superseded
+    writes, and discards itself when an explicit refresh lands first;
+  * `index.refresh_interval` drives a real background refresher,
+    `?refresh=true|wait_for|false` are honored with request-scoped 400s
+    for invalid values;
+  * multi-phase queries (legs → rescore → fetch) pin ONE executor
+    generation — a refresh landing mid-request can't mix generations.
+"""
+
+import os
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from elasticsearch_tpu.analysis import AnalysisRegistry
+from elasticsearch_tpu.cluster.indices import IndexService
+from elasticsearch_tpu.common.faults import SimulatedCrash, faults
+from elasticsearch_tpu.index import segment_build
+from elasticsearch_tpu.index.engine import ShardEngine
+from elasticsearch_tpu.index.mapping import DocumentParser, Mappings
+from elasticsearch_tpu.index.segment import SegmentBuilder
+
+WORDS = [
+    "alpha", "beta", "gamma", "delta", "epsilon", "zeta", "eta", "theta",
+    "iota", "kappa", "lam", "mu",
+]
+DIMS = 8
+
+RICH_MAPPINGS = {
+    "properties": {
+        "body": {"type": "text"},
+        "title": {"type": "text"},
+        "tag": {"type": "keyword"},
+        "popularity": {"type": "integer"},
+        "day": {"type": "date"},
+        "emb": {
+            "type": "dense_vector", "dims": DIMS, "similarity": "cosine",
+        },
+        "emb2": {
+            "type": "dense_vector", "dims": 4, "similarity": "l2_norm",
+        },
+        "toks": {
+            "type": "rank_vectors", "dims": 4, "similarity": "cosine",
+        },
+    }
+}
+
+
+def rich_docs(n=120, seed=0):
+    rng = np.random.default_rng(seed)
+    out = []
+    for i in range(n):
+        src = {
+            "body": " ".join(
+                rng.choice(WORDS, size=int(rng.integers(1, 10)))
+            ),
+            "popularity": int(rng.integers(0, 100)),
+        }
+        if i % 3 == 0:
+            src["title"] = " ".join(rng.choice(WORDS, size=3))
+        if i % 2 == 0:
+            src["tag"] = [
+                str(t)
+                for t in rng.choice(
+                    ["a", "b", "c", "d"], size=int(rng.integers(1, 4))
+                )
+            ]
+        if i % 4 == 0:
+            src["day"] = "2026-01-%02d" % (1 + i % 27)
+        if i % 2 == 1:
+            src["emb"] = rng.normal(size=DIMS).astype(np.float32).tolist()
+        if i % 5 == 0:
+            src["emb2"] = rng.normal(size=4).astype(np.float32).tolist()
+        if i % 3 == 1:
+            src["toks"] = rng.normal(
+                size=(int(rng.integers(1, 5)), 4)
+            ).astype(np.float32).tolist()
+        out.append((f"d{i}", src))
+    return out
+
+
+def parsed_rich_docs(n=120, seed=0):
+    maps = Mappings(RICH_MAPPINGS)
+    parser = DocumentParser(maps, AnalysisRegistry())
+    return maps, [parser.parse(i, s) for i, s in rich_docs(n, seed)]
+
+
+@pytest.fixture
+def device_build_on(monkeypatch):
+    monkeypatch.setenv("ES_TPU_DEVICE_BUILD", "force")
+    yield
+
+
+@pytest.fixture
+def bg_refresh_on(monkeypatch):
+    monkeypatch.setenv("ES_TPU_BG_REFRESH", "auto")
+    yield
+
+
+def _assert_arrays_equal(name, a, b):
+    a = np.asarray(a)
+    b = np.asarray(b)
+    assert a.dtype == b.dtype, (name, a.dtype, b.dtype)
+    assert a.shape == b.shape, (name, a.shape, b.shape)
+    assert np.array_equal(a, b), name
+
+
+def assert_segments_identical(host, dev):
+    assert host.num_docs == dev.num_docs
+    assert host.doc_ids == dev.doc_ids
+    assert sorted(host.postings) == sorted(dev.postings)
+    for f, hp in host.postings.items():
+        dp = dev.postings[f]
+        assert hp.terms == dp.terms, f
+        for attr in (
+            "term_df", "term_total_tf", "term_tile_start",
+            "term_tile_count", "doc_ids", "tfs", "tile_max_tf",
+            "tile_min_norm", "norms", "term_pos_start", "pos_offsets",
+            "pos_data",
+        ):
+            ha, da = getattr(hp, attr), getattr(dp, attr)
+            if ha is None or da is None:
+                assert ha is None and da is None, (f, attr)
+                continue
+            _assert_arrays_equal(f"{f}.{attr}", ha, da)
+        assert vars(hp.stats) == vars(dp.stats), f
+    assert sorted(host.ordinals) == sorted(dev.ordinals)
+    for f, ho in host.ordinals.items():
+        do = dev.ordinals[f]
+        assert ho.ord_terms == do.ord_terms, f
+        for attr in ("ords", "mv_ords", "mv_offsets"):
+            _assert_arrays_equal(
+                f"{f}.{attr}", getattr(ho, attr), getattr(do, attr)
+            )
+    assert sorted(host.vectors) == sorted(dev.vectors)
+    for f, hv in host.vectors.items():
+        dv = dev.vectors[f]
+        assert hv.similarity == dv.similarity
+        _assert_arrays_equal(f"{f}.vectors", hv.vectors, dv.vectors)
+        _assert_arrays_equal(f"{f}.exists", hv.exists, dv.exists)
+        if hv.unit_vectors is not None:
+            _assert_arrays_equal(
+                f"{f}.unit_vectors", hv.unit_vectors, dv.unit_vectors
+            )
+    assert sorted(host.multi_vectors) == sorted(dev.multi_vectors)
+    for f, hm in host.multi_vectors.items():
+        dm = dev.multi_vectors[f]
+        for attr in ("tok_vectors", "tok_offsets", "exists"):
+            _assert_arrays_equal(
+                f"{f}.{attr}", getattr(hm, attr), getattr(dm, attr)
+            )
+    assert sorted(host.numerics) == sorted(dev.numerics)
+    for f, hn in host.numerics.items():
+        dn = dev.numerics[f]
+        _assert_arrays_equal(f"{f}.values", hn.values, dn.values)
+        _assert_arrays_equal(f"{f}.exists", hn.exists, dn.exists)
+
+
+# ---------------------------------------------------------------------------
+# build parity: device == host, bit for bit, every column family
+# ---------------------------------------------------------------------------
+
+
+class TestBuildParity:
+    def test_device_build_bit_identical_all_families(self, device_build_on):
+        maps, docs = parsed_rich_docs(137)
+        b = SegmentBuilder(maps)
+        for d in docs:
+            b.add(d)
+        host = b.build()
+        before = segment_build.INGEST_STATS["device_builds"]
+        dev = segment_build.build_segment(maps, docs)
+        assert segment_build.INGEST_STATS["device_builds"] == before + 1
+        assert_segments_identical(host, dev)
+
+    def test_device_build_empty_and_tiny(self, device_build_on):
+        maps, docs = parsed_rich_docs(1)
+        b = SegmentBuilder(maps)
+        for d in docs:
+            b.add(d)
+        assert_segments_identical(
+            b.build(), segment_build.build_segment(maps, docs)
+        )
+
+    def test_quantize_int8_parity(self):
+        from elasticsearch_tpu.models.rerank import quantize_tokens
+        from elasticsearch_tpu.ops.index_build import quantize_int8_device
+
+        rng = np.random.default_rng(3)
+        mat = rng.normal(size=(513, 16)).astype(np.float32)
+        hq, hs = quantize_tokens(mat)
+        dq, ds = quantize_int8_device(mat)
+        _assert_arrays_equal("q", hq, dq)
+        _assert_arrays_equal("scales", hs, ds)
+
+    def test_agg_perm_tables_parity(self):
+        from elasticsearch_tpu.ops.index_build import agg_perm_tables_device
+
+        rng = np.random.default_rng(4)
+        nb = 23
+        ids = rng.integers(0, nb + 1, size=997).astype(np.int64)
+        got = agg_perm_tables_device(ids, nb)
+        assert got is not None
+        hperm = np.argsort(ids, kind="stable").astype(np.int32)
+        hbounds = np.searchsorted(
+            ids[hperm], np.arange(nb + 1)
+        ).astype(np.int32)
+        _assert_arrays_equal("perm", hperm, got[0])
+        _assert_arrays_equal("bounds", hbounds, got[1])
+
+    def test_search_parity_device_built_engine(self, device_build_on):
+        """A device-built engine answers queries identically to a
+        host-built one (end to end through the executor)."""
+        maps_docs = rich_docs(90, seed=7)
+        results = []
+        for mode in ("force", "off"):
+            os.environ["ES_TPU_DEVICE_BUILD"] = mode
+            svc = IndexService(
+                f"parity-{mode}",
+                settings={
+                    "number_of_shards": 1, "search.backend": "jax",
+                },
+                mappings_json=RICH_MAPPINGS,
+            )
+            try:
+                for i, s in maps_docs:
+                    svc.index_doc(i, s)
+                svc.refresh()
+                r = svc.search(
+                    {
+                        "query": {"match": {"body": "alpha beta"}},
+                        "size": 20,
+                    }
+                )
+                results.append(
+                    [
+                        (h["_id"], h["_score"])
+                        for h in r["hits"]["hits"]
+                    ]
+                )
+            finally:
+                svc.close()
+        assert results[0] == results[1]
+
+
+# ---------------------------------------------------------------------------
+# refresh under fault: degrade or keep the old generation — never wrong
+# ---------------------------------------------------------------------------
+
+
+class TestRefreshUnderFault:
+    def _engine(self, tmp_path=None):
+        maps = Mappings(RICH_MAPPINGS)
+        return ShardEngine(
+            maps, AnalysisRegistry(),
+            path=str(tmp_path) if tmp_path is not None else None,
+            device_build=True,
+        )
+
+    def test_build_device_error_falls_back_to_host(self, monkeypatch):
+        monkeypatch.setenv("ES_TPU_DEVICE_BUILD", "auto")
+        eng = self._engine()
+        for i, s in rich_docs(30):
+            eng.index(i, s)
+        faults.configure(
+            {"rules": [{"site": "build.device", "kind": "error"}]}
+        )
+        before = segment_build.INGEST_STATS["fallbacks"]
+        assert eng.refresh() is True
+        assert segment_build.INGEST_STATS["fallbacks"] == before + 1
+        assert eng.num_docs == 30  # host build answered, nothing lost
+
+    def test_build_device_delay_slow_not_wrong(self, monkeypatch):
+        monkeypatch.setenv("ES_TPU_DEVICE_BUILD", "auto")
+        eng = self._engine()
+        for i, s in rich_docs(10):
+            eng.index(i, s)
+        faults.configure(
+            {"rules": [
+                {"site": "build.device", "kind": "delay", "delay_ms": 50}
+            ]}
+        )
+        t0 = time.perf_counter()
+        assert eng.refresh() is True
+        assert time.perf_counter() - t0 >= 0.05
+        assert eng.num_docs == 10
+
+    def test_engine_refresh_error_keeps_old_generation(self, monkeypatch):
+        monkeypatch.setenv("ES_TPU_DEVICE_BUILD", "auto")
+        eng = self._engine()
+        for i, s in rich_docs(10):
+            eng.index(i, s)
+        eng.refresh()
+        gen = eng.change_generation
+        eng.index("late", {"body": "late alpha"})
+        faults.configure(
+            {"rules": [{"site": "engine.refresh", "kind": "error"}]}
+        )
+        with pytest.raises(Exception):
+            eng.refresh_concurrent()
+        assert eng.change_generation == gen  # old generation serving
+        assert eng.dirty  # the op is still buffered
+        faults.configure(None)
+        assert eng.refresh_concurrent() is True
+        assert eng.num_docs == 11
+
+    def test_mid_build_crash_keeps_old_generation_and_loses_nothing(
+        self, monkeypatch, tmp_path
+    ):
+        """A crash INSIDE the device build (power loss mid-refresh):
+        the harness reopens the shard from disk and every acked doc is
+        back — zero acked loss under request durability."""
+        monkeypatch.setenv("ES_TPU_DEVICE_BUILD", "auto")
+        eng = self._engine(tmp_path)
+        acked = []
+        for i, s in rich_docs(25):
+            eng.index(i, s)
+            acked.append(i)
+        faults.configure(
+            {"rules": [{"site": "build.device", "kind": "crash"}]}
+        )
+        gen = eng.change_generation
+        with pytest.raises(SimulatedCrash):
+            eng.refresh_concurrent()
+        assert eng.change_generation == gen
+        eng.crash()  # the box dies; no flush, no close
+        faults.configure(None)
+        recovered = ShardEngine(
+            Mappings(RICH_MAPPINGS), AnalysisRegistry(),
+            path=str(tmp_path), device_build=True,
+        )
+        assert recovered.num_docs == len(acked)
+        for i in acked:
+            assert recovered.get(i) is not None
+        recovered.close()
+
+    def test_hbm_degrade_to_host_build(self, monkeypatch):
+        monkeypatch.setenv("ES_TPU_DEVICE_BUILD", "auto")
+        from elasticsearch_tpu.common.memory import hbm_ledger
+
+        eng = self._engine()
+        for i, s in rich_docs(40):
+            eng.index(i, s)
+        before = segment_build.INGEST_STATS["degraded"]
+        old_budget = hbm_ledger.budget
+        hbm_ledger.budget = hbm_ledger.used  # zero headroom
+        try:
+            assert eng.refresh() is True
+        finally:
+            hbm_ledger.budget = old_budget
+        assert segment_build.INGEST_STATS["degraded"] >= before + 1
+        assert eng.num_docs == 40
+
+
+# ---------------------------------------------------------------------------
+# double-buffered refresh semantics
+# ---------------------------------------------------------------------------
+
+
+class TestConcurrentRefresh:
+    def _slow_build(self, monkeypatch, hold: threading.Event,
+                    entered: threading.Event):
+        real = segment_build.build_segment
+
+        def slow(*a, **kw):
+            entered.set()
+            assert hold.wait(timeout=10)
+            return real(*a, **kw)
+
+        monkeypatch.setattr(
+            "elasticsearch_tpu.index.segment_build.build_segment", slow
+        )
+
+    def test_writes_and_deletes_during_build_never_resurrect(
+        self, monkeypatch
+    ):
+        maps = Mappings({"properties": {"body": {"type": "text"}}})
+        eng = ShardEngine(maps, AnalysisRegistry())
+        eng.index("a", {"body": "alpha one"})
+        eng.index("b", {"body": "beta one"})
+        eng.index("c", {"body": "gamma one"})
+        hold = threading.Event()
+        entered = threading.Event()
+        self._slow_build(monkeypatch, hold, entered)
+        t = threading.Thread(target=eng.refresh_concurrent)
+        t.start()
+        assert entered.wait(timeout=10)
+        # while the build is in flight: overwrite a, delete b, add d —
+        # serving state must not change until the swap
+        eng.index("a", {"body": "alpha two"})
+        eng.delete("b")
+        eng.index("d", {"body": "delta one"})
+        assert eng.num_docs == 0  # nothing searchable yet
+        hold.set()
+        t.join(timeout=10)
+        assert not t.is_alive()
+        # the committed generation: a(v1) dead-on-arrival (superseded),
+        # b dead (deleted), c live; a(v2)/d still buffered
+        assert eng.num_docs == 1
+        assert eng.get("a")["_source"] == {"body": "alpha two"}  # realtime
+        assert eng.get("b") is None
+        assert eng.refresh() is True  # drains the superseding ops
+        assert eng.num_docs == 3
+        reader = eng.reader()
+        live_ids = [
+            seg.doc_ids[d]
+            for si, seg in enumerate(reader.segments)
+            for d in range(seg.num_docs)
+            if reader.live_docs[si] is None or reader.live_docs[si][d]
+        ]
+        assert sorted(live_ids) == ["a", "c", "d"]
+
+    def test_superseded_by_blocking_refresh_discards_half_build(
+        self, monkeypatch
+    ):
+        maps = Mappings({"properties": {"body": {"type": "text"}}})
+        eng = ShardEngine(maps, AnalysisRegistry())
+        eng.index("a", {"body": "alpha"})
+        hold = threading.Event()
+        entered = threading.Event()
+        real = segment_build.build_segment
+
+        calls = {"n": 0}
+
+        def slow(*a, **kw):
+            calls["n"] += 1
+            if calls["n"] == 1:  # only the concurrent build blocks
+                entered.set()
+                assert hold.wait(timeout=10)
+            return real(*a, **kw)
+
+        monkeypatch.setattr(
+            "elasticsearch_tpu.index.segment_build.build_segment", slow
+        )
+        t = threading.Thread(target=eng.refresh_concurrent)
+        t.start()
+        assert entered.wait(timeout=10)
+        assert eng.refresh() is True  # blocking refresh wins the race
+        before = segment_build.INGEST_STATS["generations_discarded"]
+        hold.set()
+        t.join(timeout=10)
+        assert segment_build.INGEST_STATS["generations_discarded"] == (
+            before + 1
+        )
+        # no duplicate segment: exactly one copy of doc a
+        assert eng.num_docs == 1
+        assert len(eng.segments) == 1
+
+    def test_serving_continues_during_build(self, monkeypatch):
+        """The double-buffer claim: searches on the current generation
+        proceed while the next generation builds."""
+        svc = IndexService(
+            "nrt-overlap",
+            settings={"number_of_shards": 1, "search.backend": "jax"},
+            mappings_json={"properties": {"body": {"type": "text"}}},
+        )
+        try:
+            for i in range(50):
+                svc.index_doc(f"d{i}", {"body": "alpha beta gamma"})
+            svc.refresh()
+            eng = svc.local_shard(0)
+            svc.index_doc("new", {"body": "alpha delta"})
+            hold = threading.Event()
+            entered = threading.Event()
+            self._slow_build(monkeypatch, hold, entered)
+            t = threading.Thread(target=eng.refresh_concurrent)
+            t.start()
+            assert entered.wait(timeout=10)
+            # mid-build search serves the OLD generation
+            r = svc.search({"query": {"match": {"body": "alpha"}}})
+            assert r["hits"]["total"]["value"] == 50
+            hold.set()
+            t.join(timeout=10)
+            r = svc.search({"query": {"match": {"body": "alpha"}}})
+            assert r["hits"]["total"]["value"] == 51
+        finally:
+            svc.close()
+
+
+# ---------------------------------------------------------------------------
+# background refresher + REST refresh semantics
+# ---------------------------------------------------------------------------
+
+
+class TestRefreshInterval:
+    def test_background_refresher_makes_writes_visible(
+        self, bg_refresh_on
+    ):
+        svc = IndexService(
+            "nrt-bg",
+            settings={
+                "number_of_shards": 1,
+                "search.backend": "jax",
+                "refresh_interval": "50ms",
+            },
+            mappings_json={"properties": {"body": {"type": "text"}}},
+        )
+        try:
+            assert svc._refresher is not None and svc._refresher.is_alive()
+            svc.index_doc("a", {"body": "alpha"})
+            deadline = time.monotonic() + 10
+            while time.monotonic() < deadline:
+                r = svc.search({"query": {"match": {"body": "alpha"}}})
+                if r["hits"]["total"]["value"] == 1:
+                    break
+                time.sleep(0.02)
+            else:
+                pytest.fail("background refresher never made doc visible")
+        finally:
+            svc.close()
+        assert not (svc._refresher and svc._refresher.is_alive())
+
+    def test_refresh_interval_minus_one_disables(self, bg_refresh_on):
+        svc = IndexService(
+            "nrt-off",
+            settings={
+                "number_of_shards": 1,
+                "search.backend": "jax",
+                "refresh_interval": "-1",
+            },
+            mappings_json={"properties": {"body": {"type": "text"}}},
+        )
+        try:
+            svc.index_doc("a", {"body": "alpha"})
+            time.sleep(0.3)
+            r = svc.search({"query": {"match": {"body": "alpha"}}})
+            assert r["hits"]["total"]["value"] == 0  # no auto-refresh
+            # wait_for degrades to a blocking refresh when disabled
+            svc.wait_for_refresh()
+            r = svc.search({"query": {"match": {"body": "alpha"}}})
+            assert r["hits"]["total"]["value"] == 1
+        finally:
+            svc.close()
+
+    def test_wait_for_refresh_blocks_on_next_swap(self, bg_refresh_on):
+        svc = IndexService(
+            "nrt-waitfor",
+            settings={
+                "number_of_shards": 1,
+                "search.backend": "jax",
+                # long interval: wait_for must NUDGE the refresher, not
+                # sit out the full cadence
+                "refresh_interval": "60s",
+            },
+            mappings_json={"properties": {"body": {"type": "text"}}},
+        )
+        try:
+            svc.index_doc("a", {"body": "alpha"})
+            t0 = time.monotonic()
+            svc.wait_for_refresh(timeout=10)
+            assert time.monotonic() - t0 < 10
+            r = svc.search({"query": {"match": {"body": "alpha"}}})
+            assert r["hits"]["total"]["value"] == 1
+        finally:
+            svc.close()
+
+    def test_dynamic_refresh_interval_update(self, bg_refresh_on):
+        from elasticsearch_tpu.cluster.service import ClusterService
+
+        cluster = ClusterService()
+        cluster.create_index(
+            "nrt-dyn",
+            {
+                "settings": {
+                    "number_of_shards": 1,
+                    "refresh_interval": "-1",
+                    "index": {"search.backend": "jax"},
+                },
+                "mappings": {"properties": {"body": {"type": "text"}}},
+            },
+        )
+        try:
+            idx = cluster.get_index("nrt-dyn")
+            idx.index_doc("a", {"body": "alpha"})
+            time.sleep(0.2)
+            r = idx.search({"query": {"match": {"body": "alpha"}}})
+            assert r["hits"]["total"]["value"] == 0
+            cluster.update_settings(
+                "nrt-dyn", {"index": {"refresh_interval": "50ms"}}
+            )
+            deadline = time.monotonic() + 10
+            while time.monotonic() < deadline:
+                r = idx.search({"query": {"match": {"body": "alpha"}}})
+                if r["hits"]["total"]["value"] == 1:
+                    break
+                time.sleep(0.02)
+            else:
+                pytest.fail("dynamic refresh_interval update ignored")
+        finally:
+            cluster.close()
+
+
+class TestRefreshParam:
+    @pytest.fixture
+    def es(self):
+        import json as _json
+        import urllib.error
+        import urllib.request
+
+        from elasticsearch_tpu.rest.server import ElasticsearchTpuServer
+
+        srv = ElasticsearchTpuServer(port=0)
+        srv.start_background()
+        base = f"http://127.0.0.1:{srv.port}"
+
+        def call(method, path, body=None, ndjson=None):
+            data = None
+            headers = {}
+            if ndjson is not None:
+                data = (
+                    "\n".join(_json.dumps(l) for l in ndjson) + "\n"
+                ).encode()
+                headers["Content-Type"] = "application/x-ndjson"
+            elif body is not None:
+                data = _json.dumps(body).encode()
+                headers["Content-Type"] = "application/json"
+            req = urllib.request.Request(
+                base + path, data=data, method=method, headers=headers
+            )
+            try:
+                with urllib.request.urlopen(req) as resp:
+                    return resp.status, _json.loads(resp.read() or b"null")
+            except urllib.error.HTTPError as e:
+                return e.code, _json.loads(e.read() or b"null")
+
+        try:
+            yield call
+        finally:
+            srv.close()
+
+    def test_invalid_refresh_value_is_400(self, es):
+        es("PUT", "/books", {"mappings": {
+            "properties": {"body": {"type": "text"}}}})
+        status, body = es(
+            "PUT", "/books/_doc/1?refresh=banana", {"body": "alpha"}
+        )
+        assert status == 400
+        assert body["error"]["type"] == "illegal_argument_exception"
+        # the invalid value rejected the request — nothing was indexed
+        status, body = es("GET", "/books/_doc/1")
+        assert status == 404
+
+    def test_bulk_invalid_refresh_rejects_before_any_op(self, es):
+        es("PUT", "/books", {"mappings": {
+            "properties": {"body": {"type": "text"}}}})
+        status, body = es(
+            "POST", "/_bulk?refresh=nope",
+            ndjson=[
+                {"index": {"_index": "books", "_id": "1"}},
+                {"body": "alpha"},
+            ],
+        )
+        assert status == 400
+        status, _ = es("GET", "/books/_doc/1")
+        assert status == 404
+
+    def test_refresh_true_false_wait_for(self, es):
+        es("PUT", "/books", {"mappings": {
+            "properties": {"body": {"type": "text"}}}})
+        es("PUT", "/books/_doc/1?refresh=true", {"body": "alpha"})
+        status, r = es(
+            "POST", "/books/_search",
+            {"query": {"match": {"body": "alpha"}}},
+        )
+        assert r["hits"]["total"]["value"] == 1
+        es("PUT", "/books/_doc/2?refresh=false", {"body": "alpha two"})
+        status, r = es(
+            "POST", "/books/_search",
+            {"query": {"match": {"body": "alpha"}}},
+        )
+        assert r["hits"]["total"]["value"] == 1  # not yet visible
+        es("PUT", "/books/_doc/3?refresh=wait_for", {"body": "alpha three"})
+        status, r = es(
+            "POST", "/books/_search",
+            {"query": {"match": {"body": "alpha"}}},
+        )
+        assert r["hits"]["total"]["value"] == 3  # wait_for blocked on swap
+
+    def test_nodes_stats_ingest_block(self, es):
+        es("PUT", "/books", {"mappings": {
+            "properties": {"body": {"type": "text"}}}})
+        es("PUT", "/books/_doc/1?refresh=true", {"body": "alpha"})
+        status, stats = es("GET", "/_nodes/stats")
+        assert status == 200
+        blk = stats["nodes"]["node-0"]["ingest"]
+        for key in (
+            "refreshes", "device_builds", "host_builds", "fallbacks",
+            "degraded", "generations_discarded", "overlap_ms",
+            "refresh_lag", "build_kernels", "build_ledger_bytes",
+            "refreshers_running",
+        ):
+            assert key in blk, key
+        assert blk["refreshes"] >= 1
+        assert set(blk["refresh_lag"]) == {
+            "p50_ms", "p95_ms", "p99_ms", "samples"
+        }
+
+
+# ---------------------------------------------------------------------------
+# generation pinning across multi-phase requests
+# ---------------------------------------------------------------------------
+
+
+class TestGenerationPinning:
+    def _rag_service(self, name):
+        rng = np.random.default_rng(11)
+        svc = IndexService(
+            name,
+            settings={"number_of_shards": 1, "search.backend": "jax"},
+            mappings_json={
+                "properties": {
+                    "body": {"type": "text"},
+                    "vec": {
+                        "type": "dense_vector", "dims": DIMS,
+                        "similarity": "cosine",
+                    },
+                    "toks": {
+                        "type": "rank_vectors", "dims": 4,
+                        "similarity": "cosine",
+                    },
+                }
+            },
+        )
+        for i in range(60):
+            svc.index_doc(
+                f"d{i}",
+                {
+                    "body": " ".join(
+                        rng.choice(WORDS, size=int(rng.integers(3, 9)))
+                    ),
+                    "vec": rng.normal(size=DIMS).astype(
+                        np.float32
+                    ).tolist(),
+                    "toks": rng.normal(size=(3, 4)).astype(
+                        np.float32
+                    ).tolist(),
+                    "marker": "old",
+                },
+            )
+        svc.refresh()
+        return svc
+
+    def _body(self):
+        qv = [[0.5, -0.2, 0.1, 0.9], [0.1, 0.8, -0.3, 0.2]]
+        return {
+            "retriever": {
+                "rrf": {
+                    "retrievers": [
+                        {"standard": {
+                            "query": {"match": {"body": "alpha beta"}}}},
+                        {"knn": {
+                            "field": "vec",
+                            "query_vector": [0.1] * DIMS,
+                            "k": 20, "num_candidates": 40,
+                        }},
+                    ],
+                    "rank_window_size": 30,
+                }
+            },
+            "rescore": {
+                "window_size": 20,
+                "query": {
+                    "rescore_query": {
+                        "rank_vectors": {
+                            "field": "toks", "query_vectors": qv,
+                        }
+                    },
+                    "query_weight": 0.4,
+                    "rescore_query_weight": 0.6,
+                },
+            },
+            "size": 10,
+        }
+
+    def test_refresh_between_legs_and_rescore_cannot_mix_generations(
+        self, monkeypatch
+    ):
+        """Regression for the mid-request generation mix: a refresh
+        landing after the legs but before rescore/fetch used to remap
+        fused doc ids through the LIVE engine's locations — rescoring
+        (and fetching) different-generation rows. With pinning, the
+        interfered run is identical to the undisturbed run."""
+        svc = self._rag_service("pin-rag")
+        try:
+            baseline = svc.search(self._body())
+
+            rng = np.random.default_rng(99)
+            orig = IndexService._rescore_ranked
+
+            def hooked(self_svc, spec, ranked, pins=None):
+                # the interference: overwrite every candidate's tokens
+                # and marker, add fresh docs, and swap the generation
+                # before the rescore runs
+                for doc_id, _ in list(ranked)[:10]:
+                    self_svc.index_doc(
+                        doc_id,
+                        {
+                            "body": "zzz nothing",
+                            "vec": rng.normal(size=DIMS).astype(
+                                np.float32
+                            ).tolist(),
+                            "toks": (
+                                10.0 * rng.normal(size=(3, 4))
+                            ).astype(np.float32).tolist(),
+                            "marker": "new",
+                        },
+                    )
+                self_svc.refresh()
+                return orig(self_svc, spec, ranked, pins)
+
+            monkeypatch.setattr(
+                IndexService, "_rescore_ranked", hooked
+            )
+            interfered = svc.search(self._body())
+            base_hits = [
+                (h["_id"], round(h["_score"], 5),
+                 h["_source"]["marker"])
+                for h in baseline["hits"]["hits"]
+            ]
+            got_hits = [
+                (h["_id"], round(h["_score"], 5),
+                 h["_source"]["marker"])
+                for h in interfered["hits"]["hits"]
+            ]
+            assert base_hits == got_hits
+            assert all(m == "old" for _, _, m in got_hits)
+        finally:
+            svc.close()
+
+    def test_pinned_fetch_reads_snapshot_sources(self, monkeypatch):
+        svc = self._rag_service("pin-fetch")
+        try:
+            body = {
+                "retriever": {
+                    "standard": {
+                        "query": {"match": {"body": "alpha"}}
+                    }
+                },
+                "size": 5,
+            }
+            baseline = svc.search(body)
+            assert baseline["hits"]["hits"]
+
+            orig = IndexService._run_retriever
+
+            done = {"hooked": False}
+
+            def hooked(self_svc, ret, window, size, extra_filter,
+                       pins=None):
+                ranked = orig(
+                    self_svc, ret, window, size, extra_filter, pins
+                )
+                if not done["hooked"]:
+                    done["hooked"] = True
+                    for doc_id, _ in ranked[:3]:
+                        self_svc.index_doc(
+                            doc_id, {"body": "alpha", "marker": "new"}
+                        )
+                    self_svc.refresh()
+                return ranked
+
+            monkeypatch.setattr(IndexService, "_run_retriever", hooked)
+            interfered = svc.search(body)
+            for h in interfered["hits"]["hits"]:
+                assert h["_source"]["marker"] == "old"
+        finally:
+            svc.close()
+
+
+# ---------------------------------------------------------------------------
+# prewarm + mesh incremental rebuild
+# ---------------------------------------------------------------------------
+
+
+class TestPrewarmAndMesh:
+    def test_executor_prewarm_builds_serving_caches(self):
+        svc = IndexService(
+            "prewarm",
+            settings={"number_of_shards": 1, "search.backend": "jax"},
+            mappings_json=RICH_MAPPINGS,
+        )
+        try:
+            for i, s in rich_docs(60):
+                svc.index_doc(i, s)
+            svc.refresh()
+            ex = svc._executor(svc.local_shard(0))
+            assert not ex._block_indexes  # lazy before prewarm
+            ex.prewarm(svc.settings)
+            assert ex._block_indexes  # text serving caches materialized
+            assert ex._chunked_scorers
+            r = svc.search({"query": {"match": {"body": "alpha"}}})
+            assert r["hits"]["total"]["value"] >= 1
+        finally:
+            svc.close()
+
+    @pytest.mark.mesh
+    def test_mesh_incremental_rebuild_reuses_unchanged_shards(
+        self, monkeypatch
+    ):
+        import jax
+
+        if len(jax.devices()) < 2:
+            pytest.skip("needs >= 2 devices")
+        monkeypatch.setenv("ES_TPU_MESH", "force")
+        svc = IndexService(
+            "mesh-incr",
+            settings={"number_of_shards": 4, "search.backend": "jax"},
+            mappings_json={"properties": {"body": {"type": "text"}}},
+        )
+        try:
+            rng = np.random.default_rng(5)
+            for i in range(200):
+                svc.index_doc(
+                    f"d{i}",
+                    {"body": " ".join(
+                        rng.choice(WORDS, size=int(rng.integers(3, 8)))
+                    )},
+                )
+            svc.refresh()
+            body = {"query": {"match": {"body": "alpha beta"}}, "size": 10}
+            first = svc.search(body)
+            mesh = svc.mesh_executor()
+            assert mesh.stats["routed"] >= 1
+            # refresh exactly ONE shard: the stack rebuild must reuse
+            # every other shard's staged rows
+            from elasticsearch_tpu.utils.murmur3 import shard_id
+
+            svc.index_doc("extra", {"body": "alpha zeta"})
+            svc.local_shard(shard_id("extra", 4)).refresh()
+            reused_before = mesh.stats["entries_reused"]
+            second = svc.search(body)
+            assert mesh.stats["incremental_rebuilds"] >= 1
+            assert mesh.stats["entries_reused"] > reused_before
+            # parity vs the per-shard path on the same generation
+            monkeypatch.setenv("ES_TPU_MESH", "off")
+            seq = svc.search(body)
+            assert [
+                (h["_id"], h["_score"]) for h in second["hits"]["hits"]
+            ] == [(h["_id"], h["_score"]) for h in seq["hits"]["hits"]]
+            assert first["hits"]["hits"]  # sanity
+        finally:
+            svc.close()
