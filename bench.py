@@ -69,6 +69,11 @@ K = 10
 SEED = 42
 AVG_LEN = (15, 35)  # body length range (tokens)
 TITLE_LEN = (3, 9)
+# learned-sparse column (SPLADE-shaped expansions): a few hundred
+# activated vocabulary entries, zipf-popular so hot terms span many
+# impact tiles — the regime block-max pruning exists for
+SPARSE_VOCAB = int(os.environ.get("BENCH_SPARSE_VOCAB", 300))
+SPARSE_TERMS_PER_DOC = (3, 9)
 
 
 # ---------------------------------------------------------------------------
@@ -149,6 +154,59 @@ def build_postings(rng, vocab, lengths, n_docs=None):
     return pf, term_df
 
 
+def _sparse_popularity():
+    pop = 1.0 / np.arange(1, SPARSE_VOCAB + 1) ** 0.7
+    return pop / pop.sum()
+
+
+def build_sparse_column(rng, n_docs):
+    """Impact-ordered learned-sparse column for the main corpus: per-doc
+    term→weight maps laid out by the SAME host planner the real build
+    path uses (segment.sparse_plan/sparse_from_plan), so the bench
+    serves the production int8 + fp32 twin planes, not a replica."""
+    from elasticsearch_tpu.index.segment import sparse_from_plan, sparse_plan
+
+    pop = _sparse_popularity()
+    nt = rng.integers(*SPARSE_TERMS_PER_DOC, size=n_docs)
+    total = int(nt.sum())
+    t_flat = rng.choice(SPARSE_VOCAB, size=total, p=pop).astype(np.int64)
+    d_flat = np.repeat(np.arange(n_docs, dtype=np.int64), nt)
+    w_flat = (rng.random(total) * 3 + 0.05).astype(np.float32)
+    # dedupe (term, doc) pairs — a doc activates each expansion once
+    key = t_flat * n_docs + d_flat
+    _, first = np.unique(key, return_index=True)
+    t_u, d_u, w_u = t_flat[first], d_flat[first], w_flat[first]
+    order = np.argsort(t_u, kind="stable")
+    t_u, d_u, w_u = t_u[order], d_u[order], w_u[order]
+    bounds = np.searchsorted(t_u, np.arange(SPARSE_VOCAB + 1))
+    inv = {}
+    for tid in range(SPARSE_VOCAB):
+        lo, hi = int(bounds[tid]), int(bounds[tid + 1])
+        if hi > lo:
+            inv[f"tok{tid:04d}"] = dict(
+                zip(d_u[lo:hi].tolist(), w_u[lo:hi].tolist())
+            )
+    plan = sparse_plan(inv, pruning_ratio=0.0)
+    return sparse_from_plan(plan, n_docs, np.ones(n_docs, bool))
+
+
+def make_sparse_vectors(n, seed=23):
+    """SPLADE-shaped query vectors over the sparse vocabulary."""
+    rng = np.random.default_rng(seed)
+    pop = _sparse_popularity()
+    out = []
+    for _ in range(n):
+        k = int(rng.integers(2, 6))
+        picked = rng.choice(SPARSE_VOCAB, size=k, replace=False, p=pop)
+        out.append(
+            {
+                f"tok{int(t):04d}": float(np.round(rng.random() * 2 + 0.1, 4))
+                for t in picked
+            }
+        )
+    return out
+
+
 def build_corpus():
     from elasticsearch_tpu.index.segment import (
         NumericField,
@@ -183,6 +241,8 @@ def build_corpus():
         mv_ords=cat_ords.copy(),
         mv_offsets=np.arange(N_DOCS + 1, dtype=np.int32),
     )
+    log(f"building sparse column ({SPARSE_VOCAB}-token vocab)…")
+    sparse_field = build_sparse_column(rng, N_DOCS)
 
     def seg_with(vectors):
         return Segment(
@@ -205,6 +265,9 @@ def build_corpus():
                     unit_vectors=vectors,
                 )
             },
+            # one shared column: the jax path serves its int8 twin, the
+            # numpy oracle scores the identical fp32 plane
+            sparse={"ml": sparse_field},
         )
 
     # jax path uploads float16 (MXU accumulates fp32); the oracle scores
@@ -232,6 +295,7 @@ def make_service(seg, backend: str):
                     "dims": DIMS,
                     "similarity": "cosine",
                 },
+                "ml": {"type": "sparse_vector"},
             }
         },
     )
@@ -325,7 +389,19 @@ def build_bodies(body_df, title_df):
         }
         for v in qv
     ]
-    # config 5: hybrid BM25 + kNN fused with RRF
+    # config: learned-sparse retrieval — SPLADE-shaped client-supplied
+    # term→weight maps over the impact-ordered int8 postings (the numpy
+    # oracle scores the identical fp32 plane exactly)
+    sparse_qvs = make_sparse_vectors(N_QUERIES_SECONDARY)
+    bodies["sparse_retrieval"] = [
+        {
+            "query": {"sparse_vector": {"field": "ml", "query_vector": sv}},
+            "size": K,
+            "_source": False,
+        }
+        for sv in sparse_qvs
+    ]
+    # config 5: hybrid BM25 + kNN + learned-sparse fused with RRF
     bodies["hybrid_rrf"] = [
         {
             "retriever": {
@@ -349,6 +425,16 @@ def build_bodies(body_df, title_df):
                                 "num_candidates": 100,
                             }
                         },
+                        {
+                            "standard": {
+                                "query": {
+                                    "sparse_vector": {
+                                        "field": "ml",
+                                        "query_vector": sv,
+                                    }
+                                }
+                            }
+                        },
                     ],
                     "rank_constant": 60,
                 }
@@ -356,7 +442,7 @@ def build_bodies(body_df, title_df):
             "size": K,
             "_source": False,
         }
-        for t, v in zip(t_texts[:1024], qv[:1024])
+        for t, v, sv in zip(t_texts[:1024], qv[:1024], sparse_qvs[:1024])
     ]
     # config 6: filter-context bool (device filter-bitset cache). The
     # scoring part mirrors the bool config; the "warm" variant reuses a
@@ -1610,23 +1696,34 @@ def main():
     svc_np = make_service(seg_np, "numpy")
     bodies = build_bodies(body_df, title_df)
 
+    from elasticsearch_tpu.search import sparse as sparse_mod
+
     configs = {}
     oracle_n = {
         "match": 96, "bool": 64, "multi_match": 64, "knn": 16,
-        "hybrid_rrf": 12,
+        "sparse_retrieval": 32, "hybrid_rrf": 12,
     }
     gate_n = {"match": 12, "bool": 8, "multi_match": 8, "knn": 8,
-              "hybrid_rrf": 6}
+              "sparse_retrieval": 8, "hybrid_rrf": 6}
 
     batcher = svc_jax._batcher
     depth_configured = batcher.pipeline_depth
-    for name in ("match", "bool", "multi_match", "knn", "hybrid_rrf"):
+    for name in (
+        "match", "bool", "multi_match", "knn", "sparse_retrieval",
+        "hybrid_rrf",
+    ):
         blist = bodies[name]
         log(f"[{name}] warmup/compile…")
         tw = time.perf_counter()
         for b in blist[:6]:
             svc_jax.search(b)
         log(f"[{name}] warm ({time.perf_counter()-tw:.1f}s)")
+        # per-window sparse counters (impact_bytes are upload-time
+        # numbers and stay cumulative; see the block below)
+        sparse0 = (
+            sparse_mod.stats_snapshot()
+            if name == "sparse_retrieval" else None
+        )
         if name == "hybrid_rrf":
             # per-leg breakdown over the measured window only (warmup
             # included compile time)
@@ -1692,6 +1789,55 @@ def main():
             f"buckets={batch_block['bucket_hit_rates']} "
             f"express={batch_block['express_lane_hits']}"
         )
+        if name == "sparse_retrieval":
+            # learned-sparse serving block: quantized-vs-oracle
+            # recall@10 (the ≥0.95 gate lives in sparse_smoke.sh), the
+            # int8 value-plane compression headline, and the block-max
+            # pruning counters over the measured window
+            st1 = sparse_mod.stats_snapshot()
+            rec10 = []
+            for b in blist[:24]:
+                got = {
+                    h["_id"] for h in svc_jax.search(dict(b))["hits"]["hits"]
+                }
+                want = [
+                    h["_id"] for h in svc_np.search(dict(b))["hits"]["hits"]
+                ]
+                if want:
+                    rec10.append(len(got & set(want)) / len(want))
+            ib = st1["impact_bytes"]
+            fb = st1["impact_fp32_equivalent_bytes"]
+            configs[name].update(
+                {
+                    "kind": "impact_int8",
+                    "recall_at_10_vs_fp32_oracle": round(
+                        float(np.mean(rec10)), 4
+                    ),
+                    "quantized_searches": (
+                        st1["quantized_searches"]
+                        - sparse0["quantized_searches"]
+                    ),
+                    "tiles_pruned": (
+                        st1["tiles_pruned"] - sparse0["tiles_pruned"]
+                    ),
+                    "tiles_scored": (
+                        st1["tiles_scored"] - sparse0["tiles_scored"]
+                    ),
+                    "impact_bytes": ib,
+                    "impact_fp32_equivalent_bytes": fb,
+                    "impact_compression": (
+                        round(fb / ib, 2) if ib else None
+                    ),
+                    "ledger_bytes": st1["ledger_bytes"],
+                }
+            )
+            log(
+                f"[sparse_retrieval] recall@10="
+                f"{configs[name]['recall_at_10_vs_fp32_oracle']} "
+                f"compression={configs[name]['impact_compression']}x "
+                f"pruned={configs[name]['tiles_pruned']}/"
+                f"{configs[name]['tiles_pruned'] + configs[name]['tiles_scored']}"
+            )
         if name == "hybrid_rrf":
             # hybrid execution breakdown: per-leg wall time measured
             # from leg fan-out start (overlapped legs therefore SUM to
@@ -1703,6 +1849,7 @@ def main():
                 {
                     "bm25_leg_ms": round(st["bm25_leg_ms"] / n_rrf, 2),
                     "knn_leg_ms": round(st["knn_leg_ms"] / n_rrf, 2),
+                    "sparse_leg_ms": round(st["sparse_leg_ms"] / n_rrf, 2),
                     "fuse_ms": round(st["fuse_ms"] / n_rrf, 2),
                     "device_fused": st["device_fused"],
                     "host_fused": st["host_fused"],
@@ -1712,6 +1859,7 @@ def main():
             log(
                 f"[hybrid_rrf] legs: bm25={configs[name]['bm25_leg_ms']}ms "
                 f"knn={configs[name]['knn_leg_ms']}ms "
+                f"sparse={configs[name]['sparse_leg_ms']}ms "
                 f"fuse={configs[name]['fuse_ms']}ms "
                 f"(device_fused={st['device_fused']}, "
                 f"host_fused={st['host_fused']}, "
@@ -2029,7 +2177,10 @@ def main():
     headline = max(configs["match"]["qps"], qps_wand)
     base = configs["match"]["cpu_oracle_qps"]
     recall_ok = all(
-        c.get("recall", 1.0) >= 0.99 for c in configs.values()
+        c.get("recall", 1.0) >= 0.99
+        for nm, c in configs.items()
+        if nm != "sparse_retrieval"  # deliberately lossy int8 serving;
+        # its own gate is recall_at_10_vs_fp32_oracle >= 0.95
     )
     vs = round(headline / base, 2) if base and recall_ok else None
     print(
@@ -2065,6 +2216,7 @@ def main():
                 "n_docs": N_DOCS,
                 "dims": DIMS,
                 "threads": THREADS,
+                "host_cores": len(os.sched_getaffinity(0)),
             }
         )
     )

@@ -433,6 +433,7 @@ class IndexService:
             "searches": 0,
             "bm25_leg_ms": 0.0,
             "knn_leg_ms": 0.0,
+            "sparse_leg_ms": 0.0,
             "fuse_ms": 0.0,
             "device_fused": 0,
             "host_fused": 0,
@@ -445,6 +446,7 @@ class IndexService:
         self.rrf_leg_samples = {
             "bm25": _deque(maxlen=4096),
             "knn": _deque(maxlen=4096),
+            "sparse": _deque(maxlen=4096),
         }
         # ---- background refresher (index.refresh_interval): the NRT
         # loop that turns buffered writes into searchable generations on
@@ -896,6 +898,20 @@ class IndexService:
                     refreshed.append((sid, eng))
             except Exception:
                 continue  # old generation keeps serving; next tick retries
+        # merge policy: when a shard accumulated too many segments, fold
+        # them through the same double-buffered path — the big rebuild
+        # runs outside the engine lock, so the write stream stays paced
+        max_segs = int(self.settings.get("merge.policy.max_segments", 8))
+        for sid, eng in sorted(self._local.items()):
+            if len(eng.segments) <= max_segs:
+                continue
+            try:
+                if eng.merge_concurrent(max_segs) and all(
+                    e is not eng for _s, e in refreshed
+                ):
+                    refreshed.append((sid, eng))
+            except Exception:
+                continue  # policy retries next tick; serving unaffected
         t0 = time.perf_counter()
         for sid, eng in refreshed:
             try:
@@ -1309,6 +1325,7 @@ class IndexService:
                 extract_knn_plan,
                 extract_match_plan,
                 extract_serve_plan,
+                extract_sparse_plan,
                 split_filtered_bool,
             )
             from ..search.executor_jax import JaxExecutor
@@ -1316,7 +1333,18 @@ class IndexService:
             if isinstance(ex, JaxExecutor):
                 plan = None
                 kind = "match"
-                if query is not None and knn is None:
+                if isinstance(query, dsl.SparseVectorQuery):
+                    # learned-sparse leg: resolve the storage column
+                    # (int8 default / fp32 via `"exact": true`) and ride
+                    # the batcher's `sparse` job family
+                    from ..search import sparse as sparse_mod
+
+                    query.sparse = sparse_mod.resolve(
+                        self.settings, bool(body.get("exact"))
+                    )
+                    plan = extract_sparse_plan(query, self.mappings)
+                    kind = "sparse"
+                elif query is not None and knn is None:
                     plan = extract_match_plan(
                         query, self.mappings, self.analysis, tth
                     )
@@ -2134,7 +2162,7 @@ class IndexService:
         {
             "query", "knn", "size", "from", "_source",
             "track_total_hits", "allow_partial_search_results",
-            "allow_degraded", "rescore",
+            "allow_degraded", "rescore", "exact",
         }
     )
 
@@ -2191,11 +2219,25 @@ class IndexService:
         if has_q:
             query = dsl.parse_query(body["query"])  # parse errors are
             # request-scoped: surface them exactly like the shard path
-            plan = extract_match_plan(query, self.mappings, self.analysis, tth)
-            kind = "mesh_match"
-            if plan is None:
-                plan = extract_serve_plan(query, self.mappings, self.analysis)
-                kind = "mesh_serve"
+            if isinstance(query, dsl.SparseVectorQuery):
+                from ..search import sparse as sparse_mod
+                from ..search.batcher import extract_sparse_plan
+
+                query.sparse = sparse_mod.resolve(
+                    self.settings, bool(body.get("exact"))
+                )
+                plan = extract_sparse_plan(query, self.mappings)
+                kind = "mesh_sparse"
+            else:
+                plan = extract_match_plan(
+                    query, self.mappings, self.analysis, tth
+                )
+                kind = "mesh_match"
+                if plan is None:
+                    plan = extract_serve_plan(
+                        query, self.mappings, self.analysis
+                    )
+                    kind = "mesh_serve"
         else:
             knn_body = body["knn"]
             knn = [
@@ -2463,6 +2505,9 @@ class IndexService:
         QueryPhaseResultConsumer split). ``extra_filter`` supports
         filtered aliases (AliasFilter ANDed into the query)."""
         body = body or {}
+        _validate_sparse_fields(body.get("query"), self.mappings)
+        if "retriever" in body:
+            _validate_sparse_fields(body.get("retriever"), self.mappings)
         if "rescore" in body:
             from ..search import rescorer
 
@@ -3083,7 +3128,7 @@ class IndexService:
             st["fuse_ms"] += (t_end - t_fuse) * 1000.0
             st["device_fused" if device else "host_fused"] += 1
             for leg in legs:
-                if leg["label"] in ("bm25", "knn"):
+                if leg["label"] in ("bm25", "knn", "sparse"):
                     st[f"{leg['label']}_leg_ms"] += leg["ms"]
                     self.rrf_leg_samples[leg["label"]].append(leg["ms"])
         return fused
@@ -3100,6 +3145,15 @@ class IndexService:
             raise dsl.QueryParseError("[retriever] malformed")
         kind, params = next(iter(child.items()))
         label = {"standard": "bm25", "knn": "knn"}.get(kind, "other")
+        if (
+            kind == "standard"
+            and isinstance(params, dict)
+            and isinstance(params.get("query"), dict)
+            and "sparse_vector" in params["query"]
+        ):
+            # the third hybrid leg: a standard retriever whose query is
+            # a learned-sparse clause gets its own per-leg timing bucket
+            label = "sparse"
         planned = self._plan_leg(kind, params, window, extra_filter, pins)
         if planned is not None:
             ex, plan, pkind, query = planned
@@ -3162,6 +3216,15 @@ class IndexService:
             if params.get("filter") is not None or "query" not in params:
                 return None
             query = dsl.parse_query(params["query"])
+            if isinstance(query, dsl.SparseVectorQuery):
+                from ..search import sparse as sparse_mod
+                from ..search.batcher import extract_sparse_plan
+
+                query.sparse = sparse_mod.resolve(self.settings, False)
+                plan = extract_sparse_plan(query, self.mappings)
+                if plan is None:
+                    return None
+                return ex, plan, "sparse", query
             plan = extract_match_plan(
                 query, self.mappings, self.analysis, 10_000
             )
@@ -3591,6 +3654,32 @@ def _rank_to_retriever(body: dict) -> dict:
     }
     out["retriever"] = {"rrf": rrf}
     return out
+
+
+def _validate_sparse_fields(node, mappings: Mappings) -> None:
+    """Coordinator-side 400 for a `sparse_vector` clause aimed at a
+    field that is not mapped `sparse_vector` (SparseVectorQueryBuilder
+    rewrites to MatchNone in the reference; here a typo'd field name is
+    a request bug, so fail loudly before any shard work). Walks the RAW
+    JSON body — query trees, retriever legs and rescore windows alike —
+    so every entry point shares one check."""
+    if isinstance(node, dict):
+        sv = node.get("sparse_vector")
+        if isinstance(sv, dict) and "field" in sv:
+            fname = str(sv["field"])
+            mf = mappings.get(fname)
+            from ..index.mapping import SPARSE_VECTOR
+
+            if mf is None or mf.type != SPARSE_VECTOR:
+                raise dsl.QueryParseError(
+                    f"[sparse_vector] field [{fname}] is not mapped as "
+                    "[sparse_vector]"
+                )
+        for v in node.values():
+            _validate_sparse_fields(v, mappings)
+    elif isinstance(node, list):
+        for v in node:
+            _validate_sparse_fields(v, mappings)
 
 
 def _nested_with_inner_hits(q) -> list:

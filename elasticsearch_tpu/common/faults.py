@@ -38,6 +38,12 @@ Schedule shape (env `ES_TPU_FAULTS`, or `POST /_internal/faults`):
     the deterministic rerank→first-stage-order fallback (the request
     keeps its first-stage ranking bit-for-bit and the `fallbacks`
     counter increments), delay kind the slow-not-wrong contract)
+  - ``sparse.score``        (learned-sparse impact-tile scoring — per
+    segment on the batcher path with ctx field/segment, mesh=1 on the
+    SPMD path; error kind proves the deterministic impact→dense-host-
+    oracle fallback (exact answers, `fallbacks` bump), delay kind the
+    slow-not-wrong contract — the ann.probe recipe for the third
+    retrieval family)
 
   Write-path sites (the durability mirror of the read-path list; the
   crash-matrix harness in index/crashpoints.py + tests/test_durability.py

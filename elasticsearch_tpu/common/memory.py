@@ -22,8 +22,12 @@ DEGRADES the request to the single-device path instead of tripping the
 breaker). `rerank` holds the second-stage reranker's shard-level
 `rank_vectors` token columns (search/rescorer.py; a column that cannot
 fit DEGRADES TO SKIP — the request keeps its first-stage ranking).
-Per-category bytes surface as child breakers in `_nodes/stats`
-(child_breakers())."""
+`impacts` holds the learned-sparse impact-tile columns
+(executor_jax.impact_scorer: per-(segment, field, storage-mode) uploads
+of the impact-ordered doc/value planes, int8 or fp32; a column that
+cannot fit DEGRADES to the dense fp32 host oracle — exact answers,
+just not device-served). Per-category bytes surface as child breakers
+in `_nodes/stats` (child_breakers())."""
 
 from __future__ import annotations
 

@@ -421,6 +421,14 @@ INDEX_SETTINGS: Dict[str, Setting] = {
         # default probe width (per-request knn.nprobe overrides)
         Setting("knn.nprobe", 8, INDEX_SCOPE, parser=int,
                 validator=_positive("knn.nprobe")),
+        # learned-sparse impact storage (ops/impact.py, search/sparse.py):
+        # int8 — the default — serves from the 4x-smaller per-term
+        # symmetric column; "none" keeps the fp32 plane (always present
+        # as the exact oracle; a body-level `"exact": true` routes one
+        # request to it regardless)
+        Setting("sparse.quantization", "int8", INDEX_SCOPE,
+                validator=_one_of("sparse.quantization",
+                                  ("none", "int8"))),
         # second-stage reranker token storage (search/rescorer.py):
         # int8 mirrors the kNN quantization path — per-token symmetric
         # scales, 4x less HBM per maxsim gather

@@ -712,6 +712,101 @@ class ShardEngine:
             self._merge_uncommitted = True
             return True
 
+    def merge_concurrent(self, max_segments: int = 8) -> bool:
+        """Double-buffered merge: same policy as `maybe_merge`, but the
+        merged segment — the biggest build a shard ever does — runs
+        OUTSIDE the engine lock, so writes keep landing in the buffer
+        and searches keep serving the current generation while it
+        builds. The swap is one atomic generation bump under the lock,
+        guarded by the same epoch check as `refresh_concurrent`: any
+        refresh or merge that swapped mid-build supersedes this one
+        (the half-build is discarded; the next tick re-evaluates the
+        policy against the NEW segment list). Docs captured in the
+        snapshot but superseded during the build (newer version or
+        delete) install dead-on-arrival via the merged segment's live
+        bitmap. Holds `_refresh_mutex` for the duration, so a merge
+        delays the next background refresh but never blocks the write
+        path — that is the pacing bound tier-1 gates."""
+        from . import segment_build
+
+        with self._refresh_mutex:
+            with self._lock:
+                if len(self.segments) <= max_segments:
+                    return False
+                # crash here = power loss mid-merge: nothing on disk
+                # moved yet (the result only becomes durable at flush)
+                faults.check("engine.merge", shard=self.shard_id)
+                epoch = self._refresh_epoch
+                rows: List[Tuple[str, str, int, int]] = []
+                for si, seg in enumerate(self.segments):
+                    live = self.live_docs[si]
+                    for d in range(seg.num_docs):
+                        if live is not None and not live[d]:
+                            continue
+                        rows.append(
+                            (
+                                seg.doc_ids[d],
+                                seg.sources[d],
+                                int(self.seg_versions[si][d]),
+                                int(self.seg_seqnos[si][d]),
+                            )
+                        )
+            t0 = _time.perf_counter()
+            try:
+                docs = [
+                    self.parser.parse(doc_id, src)
+                    for doc_id, src, _v, _s in rows
+                ]
+                merged = segment_build.build_segment(
+                    self.mappings, docs, shard_id=self.shard_id,
+                    prefer_device=self.device_build,
+                )
+            except BaseException:
+                # half-build discarded; the old segment list keeps
+                # serving and the policy retries next tick
+                segment_build.note("generations_discarded")
+                raise
+            segment_build.note(
+                "overlap_ms", (_time.perf_counter() - t0) * 1000.0
+            )
+            with self._lock:
+                if self._refresh_epoch != epoch:
+                    # a refresh/merge swapped mid-build: the segment
+                    # list we merged no longer exists — discard
+                    segment_build.note("generations_discarded")
+                    return False
+                live = None
+                new_locations: Dict[str, Tuple[int, int]] = {}
+                for local, (doc_id, _src, _v, seq) in enumerate(rows):
+                    cur = self._versions.get(doc_id)
+                    if (
+                        cur is not None
+                        and cur.seq_no == seq
+                        and not cur.deleted
+                    ):
+                        new_locations[doc_id] = (0, local)
+                    else:
+                        # superseded during the build: dead on arrival
+                        if live is None:
+                            live = np.ones(len(rows), dtype=bool)
+                        live[local] = False
+                self.segments = [merged]
+                self.live_docs = [live]
+                self.seg_versions = [
+                    np.asarray([v for _i, _s, v, _q in rows], np.int64)
+                ]
+                self.seg_seqnos = [
+                    np.asarray([q for _i, _s, _v, q in rows], np.int64)
+                ]
+                self.seg_names = [f"seg_{self.committed_generation}_m0"]
+                self._locations = new_locations
+                self.change_generation += 1
+                self._refresh_epoch += 1
+                self.op_stats["merge_total"] += 1
+                self._merge_uncommitted = True
+                segment_build.note("concurrent_merges")
+            return True
+
     # ------------------------------------------------------------------
     # recovery (open an existing shard directory)
     # ------------------------------------------------------------------

@@ -34,6 +34,7 @@ BOOLEAN = "boolean"
 DATE = "date"
 DENSE_VECTOR = "dense_vector"
 RANK_VECTORS = "rank_vectors"
+SPARSE_VECTOR = "sparse_vector"
 GEO_POINT = "geo_point"
 NESTED = "nested"
 PERCOLATOR = "percolator"
@@ -60,6 +61,9 @@ class MappedField:
     ignore_above: Optional[int] = None
     # copy_to targets (values also indexed into these fields)
     copy_to: tuple = ()
+    # sparse_vector static pruning: per term, drop the lowest-impact
+    # tail keeping ceil((1 - ratio) * df) postings (0.0 = keep all)
+    pruning_ratio: float = 0.0
 
     def is_numeric(self) -> bool:
         return self.type in NUMERIC_TYPES or self.type in (DATE, BOOLEAN)
@@ -121,7 +125,7 @@ class Mappings:
     def _add_field(self, path: str, ftype: str, cfg: dict):
         known = (
             TEXT, KEYWORD, BOOLEAN, DATE, DENSE_VECTOR, RANK_VECTORS,
-            GEO_POINT, NESTED, PERCOLATOR,
+            SPARSE_VECTOR, GEO_POINT, NESTED, PERCOLATOR,
         ) + NUMERIC_TYPES
         if ftype not in known:
             raise MappingParseError(f"No handler for type [{ftype}] declared on field [{path}]")
@@ -142,7 +146,13 @@ class Mappings:
                 if isinstance(cfg.get("copy_to"), str)
                 else cfg.get("copy_to", ())
             ),
+            pruning_ratio=float(cfg.get("pruning_ratio", 0.0)),
         )
+        if ftype == SPARSE_VECTOR and not (0.0 <= f.pruning_ratio < 1.0):
+            raise MappingParseError(
+                f"pruning_ratio on field [{path}] must be in [0, 1), "
+                f"got [{f.pruning_ratio}]"
+            )
         if ftype == DENSE_VECTOR and f.dims <= 0:
             # ES infers dims from the first vector if unset; we allow that too
             f.dims = int(cfg.get("dims", 0))
@@ -238,7 +248,7 @@ class Mappings:
                         f"mapper [{name}] cannot be changed from type "
                         f"[{mine.type}] to [{f.type}]"
                     )
-                for param in ("analyzer", "dims", "similarity"):
+                for param in ("analyzer", "dims", "similarity", "pruning_ratio"):
                     theirs = getattr(f, param)
                     if param == "dims" and not theirs:
                         # dims omitted in the incoming mapping: keep the
@@ -297,6 +307,8 @@ class Mappings:
         if f.type in (DENSE_VECTOR, RANK_VECTORS):
             entry["dims"] = f.dims
             entry["similarity"] = f.similarity
+        if f.type == SPARSE_VECTOR and f.pruning_ratio:
+            entry["pruning_ratio"] = f.pruning_ratio
         if f.ignore_above is not None:
             entry["ignore_above"] = f.ignore_above
         if f.copy_to:
@@ -344,6 +356,9 @@ class ParsedDocument:
     # field → per-doc token-embedding matrix (rank_vectors: one row per
     # token, the late-interaction reranker's document side)
     multi_vectors: Dict[str, List[List[float]]] = field(default_factory=dict)
+    # field → term→weight map (sparse_vector: SPLADE-shaped learned
+    # sparse representations, input to the impact-ordered postings)
+    sparse_vectors: Dict[str, Dict[str, float]] = field(default_factory=dict)
     # field → field length (token count incl. duplicates) for norms
     field_lengths: Dict[str, int] = field(default_factory=dict)
 
@@ -386,6 +401,12 @@ class DocumentParser:
                 f = self.mappings.get(path)
                 if f is not None:
                     if f.type == GEO_POINT:
+                        self._index_values(f, path, [value], out)
+                        continue
+                    if f.type == SPARSE_VECTOR:
+                        # term→weight maps arrive as JSON objects; the
+                        # weights must be finite numbers (the reference's
+                        # SparseVectorFieldMapper rejects anything else)
                         self._index_values(f, path, [value], out)
                         continue
                     if f.type == PERCOLATOR:
@@ -570,6 +591,36 @@ class DocumentParser:
             raise MappingParseError(
                 f"percolator field [{path}] must hold a query object"
             )
+        elif f.type == SPARSE_VECTOR:
+            weights: Dict[str, float] = dict(out.sparse_vectors.get(path, {}))
+            for v in values:
+                if v is None:
+                    continue
+                if not isinstance(v, dict):
+                    raise MappingParseError(
+                        f"sparse_vector field [{path}] must hold a "
+                        "term→weight object"
+                    )
+                for term, w in v.items():
+                    if isinstance(w, bool) or not isinstance(w, (int, float)):
+                        raise MappingParseError(
+                            f"sparse_vector field [{path}] weight for term "
+                            f"[{term}] must be a number, got [{w!r}]"
+                        )
+                    wf = float(w)
+                    if math.isnan(wf) or math.isinf(wf):
+                        raise MappingParseError(
+                            f"sparse_vector field [{path}] weight for term "
+                            f"[{term}] must be finite, got [{w}]"
+                        )
+                    if wf <= 0.0:
+                        # non-positive weights can never contribute to a
+                        # max-score top-k; drop them like the reference
+                        # drops zero-weight features
+                        continue
+                    weights[str(term)] = wf
+            if weights:
+                out.sparse_vectors[path] = weights
         elif f.type == DENSE_VECTOR:
             vec = [float(x) for x in values]
             if f.dims and len(vec) != f.dims:

@@ -37,6 +37,7 @@ atomic manifest rename at the shard level (see engine.py).
 from __future__ import annotations
 
 import json
+import math
 import os
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Sequence, Tuple
@@ -91,6 +92,15 @@ class PostingsField:
     term_pos_start: Optional[np.ndarray] = None  # int64[n_terms]
     pos_offsets: Optional[np.ndarray] = None  # int64[sum(df)+1]
     pos_data: Optional[np.ndarray] = None  # int32[sum(tf)]
+    # precomputed BM25 impacts (text fields; the BM25S eager-scoring
+    # layout): per posting, the tf/norm factor 1 - 1/(1 + tf*inv_norm)
+    # folded at build time with the SEGMENT-local avgdl, quantized to
+    # int8 with per-term symmetric scales. Query-time scoring of a term
+    # then reduces to idf * dequantized gather — no norm math on the hot
+    # path. Built for text fields on both host and device build paths
+    # (bit-identical, parity-gated).
+    impacts: Optional[np.ndarray] = None  # int8[n_tiles, TILE]
+    impact_scales: Optional[np.ndarray] = None  # float32[n_terms]
     _term_index: Optional[Dict[str, int]] = None
 
     def term_id(self, term: str) -> int:
@@ -171,6 +181,202 @@ class MultiVectorField:
         return int(np.diff(self.tok_offsets).max())
 
 
+@dataclass
+class SparseField:
+    """Impact-ordered tiled postings for one `sparse_vector` field (the
+    GPUSparse/BM25S layout): a term owns a contiguous tile range whose
+    postings are sorted by weight DESC (doc asc tie-break), so the
+    highest-impact postings of every term live in its first tiles and a
+    per-tile `tile_max` sidecar is non-increasing within a term — the
+    block-max pruning invariant. The fp32 `weights` plane is the exact
+    oracle source of truth; `qweights` is its int8 per-term-symmetric
+    twin (4x smaller in HBM), with `tile_qmax` giving the dequantized
+    per-tile bound so pruning stays exact in either serving mode."""
+
+    terms: List[str]  # sorted term dictionary
+    term_df: np.ndarray  # int32[n_terms] kept postings per term
+    term_tile_start: np.ndarray  # int32[n_terms]
+    term_tile_count: np.ndarray  # int32[n_terms]
+    doc_ids: np.ndarray  # int32[n_tiles, TILE], impact-ordered, pad -1
+    weights: np.ndarray  # float32[n_tiles, TILE], pad 0 (exact plane)
+    qweights: np.ndarray  # int8[n_tiles, TILE] per-term symmetric twin
+    scales: np.ndarray  # float32[n_terms] dequant scale = maxabs/127
+    tile_max: np.ndarray  # float32[n_tiles] max fp32 weight in tile
+    tile_qmax: np.ndarray  # float32[n_tiles] max dequantized weight
+    exists: np.ndarray  # bool[N]
+    pruned: int = 0  # postings dropped by static pruning at build
+    _term_index: Optional[Dict[str, int]] = None
+
+    def term_id(self, term: str) -> int:
+        if self._term_index is None:
+            self._term_index = {t: i for i, t in enumerate(self.terms)}
+        return self._term_index.get(term, -1)
+
+    @property
+    def n_tiles(self) -> int:
+        return self.doc_ids.shape[0]
+
+    def term_postings(self, tid: int) -> Tuple[np.ndarray, np.ndarray]:
+        """Compact (unpadded) impact-ordered (docs, fp32 weights)."""
+        start = int(self.term_tile_start[tid])
+        count = int(self.term_tile_count[tid])
+        df = int(self.term_df[tid])
+        return (
+            self.doc_ids[start : start + count].ravel()[:df],
+            self.weights[start : start + count].ravel()[:df],
+        )
+
+
+def sparse_plan(inv: Dict[str, Dict[int, float]], pruning_ratio: float) -> dict:
+    """Host-side layout plan for one sparse_vector column, shared by the
+    host build AND the device build (ops/index_build.sparse_planes_device):
+    sorted term dictionary, impact ordering (weight desc, doc asc
+    tie-break), static pruning of the lowest-impact tail, and flat scatter
+    destinations. All layout decisions happen exactly once here, so the
+    two materializers stay bit-identical by construction — the device
+    kernels only scatter, reduce with exact max, and quantize."""
+    terms = sorted(inv)
+    n_terms = len(terms)
+    term_df = np.zeros(n_terms, np.int32)
+    term_tile_start = np.zeros(n_terms, np.int32)
+    term_tile_count = np.zeros(n_terms, np.int32)
+    docs_parts: List[np.ndarray] = []
+    w_parts: List[np.ndarray] = []
+    dest_parts: List[np.ndarray] = []
+    next_tile = 0
+    pruned = 0
+    for tid, term in enumerate(terms):
+        plist = inv[term]
+        d_arr = np.fromiter(sorted(plist), count=len(plist), dtype=np.int32)
+        w_arr = np.asarray([plist[int(d)] for d in d_arr], dtype=np.float32)
+        order = np.lexsort((d_arr, -w_arr))
+        d_arr, w_arr = d_arr[order], w_arr[order]
+        if pruning_ratio > 0.0 and len(d_arr) > 1:
+            keep = max(1, math.ceil((1.0 - pruning_ratio) * len(d_arr)))
+            pruned += len(d_arr) - keep
+            d_arr, w_arr = d_arr[:keep], w_arr[:keep]
+        df = len(d_arr)
+        term_df[tid] = df
+        nt = (df + TILE - 1) // TILE
+        term_tile_start[tid] = next_tile
+        term_tile_count[tid] = nt
+        dest_parts.append(next_tile * TILE + np.arange(df, dtype=np.int64))
+        docs_parts.append(d_arr)
+        w_parts.append(w_arr)
+        next_tile += nt
+    return {
+        "terms": terms,
+        "term_df": term_df,
+        "term_tile_start": term_tile_start,
+        "term_tile_count": term_tile_count,
+        "n_tiles": next_tile,
+        "pruned": pruned,
+        "docs": (
+            np.concatenate(docs_parts) if docs_parts else np.zeros(0, np.int32)
+        ),
+        "weights": (
+            np.concatenate(w_parts) if w_parts else np.zeros(0, np.float32)
+        ),
+        "dest": (
+            np.concatenate(dest_parts) if dest_parts else np.zeros(0, np.int64)
+        ),
+        "tile_term": np.repeat(
+            np.arange(n_terms, dtype=np.int32), term_tile_count
+        ),
+    }
+
+
+def sparse_from_plan(plan: dict, n: int, exists: np.ndarray) -> SparseField:
+    """Host materializer: scatter the planned postings into padded tile
+    planes and derive the quantized twin + block-max sidecars. Mirrors
+    ops/index_build.sparse_planes_device formula-for-formula (scatter,
+    exact max reductions, f32 divides, rint) for bit-parity."""
+    n_tiles = int(plan["n_tiles"])
+    n_terms = len(plan["terms"])
+    doc_plane = np.full(n_tiles * TILE, INVALID_DOC, np.int32)
+    w_plane = np.zeros(n_tiles * TILE, np.float32)
+    doc_plane[plan["dest"]] = plan["docs"]
+    w_plane[plan["dest"]] = plan["weights"]
+    doc_ids = doc_plane.reshape(n_tiles, TILE)
+    weights = w_plane.reshape(n_tiles, TILE)
+    tile_term = plan["tile_term"]
+    if n_tiles:
+        tile_max = weights.max(axis=1).astype(np.float32)
+    else:
+        tile_max = np.zeros(0, np.float32)
+    scales = np.zeros(n_terms, np.float32)
+    if n_terms:
+        # impact ordering puts every term's global max in its first tile
+        first = plan["term_tile_start"].astype(np.int64)
+        scales = (tile_max[first] / np.float32(127.0)).astype(np.float32)
+    if n_tiles:
+        slot_scale = scales[tile_term]
+        safe = np.where(
+            slot_scale == 0.0, np.float32(1.0), slot_scale
+        ).astype(np.float32)
+        qweights = np.clip(
+            np.rint(weights / safe[:, None]), -127, 127
+        ).astype(np.int8)
+        tile_qmax = (
+            qweights.max(axis=1).astype(np.float32) * slot_scale
+        ).astype(np.float32)
+    else:
+        qweights = np.zeros((0, TILE), np.int8)
+        tile_qmax = np.zeros(0, np.float32)
+    return SparseField(
+        terms=plan["terms"],
+        term_df=plan["term_df"],
+        term_tile_start=plan["term_tile_start"],
+        term_tile_count=plan["term_tile_count"],
+        doc_ids=doc_ids,
+        weights=weights,
+        qweights=qweights,
+        scales=scales,
+        tile_max=tile_max,
+        tile_qmax=tile_qmax,
+        exists=exists,
+        pruned=int(plan["pruned"]),
+    )
+
+
+def attach_impacts(pf: PostingsField, inv_norm_cache: np.ndarray) -> None:
+    """Fold the BM25 tf/norm factor into per-posting int8 impacts (BM25S
+    eager scoring): impact = 1 - 1/(1 + tf * inv_norm[norm_byte]) with
+    the SEGMENT-local avgdl baked into `inv_norm_cache` (256-entry f32
+    table, computed once on host and shared with the device build path
+    so both produce identical bits). Query-time scoring of term t is
+    then idf(t) * impact — pure gather+sum."""
+    n_terms = len(pf.terms)
+    if pf.n_tiles == 0:
+        pf.impacts = np.zeros((0, TILE), np.int8)
+        pf.impact_scales = np.zeros(n_terms, np.float32)
+        return
+    valid = pf.doc_ids >= 0
+    n = len(pf.norms)
+    nb = pf.norms[np.clip(pf.doc_ids, 0, n - 1 if n else 0)]
+    one = np.float32(1.0)
+    inv = inv_norm_cache[nb.astype(np.int64)]
+    imp = (one - one / (one + pf.tfs.astype(np.float32) * inv)).astype(
+        np.float32
+    )
+    imp = np.where(valid, imp, np.float32(0.0))
+    tile_imax = imp.max(axis=1).astype(np.float32)
+    starts = pf.term_tile_start.astype(np.int64)
+    term_max = np.maximum.reduceat(tile_imax, starts).astype(np.float32)
+    scales = (term_max / np.float32(127.0)).astype(np.float32)
+    tile_term = np.repeat(
+        np.arange(n_terms, dtype=np.int64), pf.term_tile_count
+    )
+    slot_scale = scales[tile_term]
+    safe = np.where(slot_scale == 0.0, np.float32(1.0), slot_scale).astype(
+        np.float32
+    )
+    pf.impacts = np.clip(np.rint(imp / safe[:, None]), -127, 127).astype(
+        np.int8
+    )
+    pf.impact_scales = scales
+
+
 class Segment:
     """An immutable searchable segment of N documents (local ids 0..N-1)."""
 
@@ -185,6 +391,7 @@ class Segment:
         vectors: Dict[str, VectorField],
         generation: int = 0,
         multi_vectors: Optional[Dict[str, MultiVectorField]] = None,
+        sparse: Optional[Dict[str, SparseField]] = None,
     ):
         self.num_docs = num_docs
         self.doc_ids = doc_ids  # _id per local doc
@@ -194,6 +401,7 @@ class Segment:
         self.ordinals = ordinals
         self.vectors = vectors
         self.multi_vectors = multi_vectors or {}
+        self.sparse = sparse or {}
         self.generation = generation
 
     # ---------- persistence ----------
@@ -211,6 +419,7 @@ class Segment:
             "ordinals": sorted(self.ordinals),
             "vectors": {},
             "multi_vectors": {},
+            "sparse": {},
         }
         arrays: Dict[str, np.ndarray] = {}
 
@@ -257,6 +466,10 @@ class Segment:
                 put(f"{key}.term_pos_start", pf.term_pos_start)
                 put(f"{key}.pos_offsets", pf.pos_offsets)
                 put(f"{key}.pos_data", pf.pos_data)
+            if pf.impacts is not None:
+                manifest["postings"][fname]["impacts"] = True
+                put(f"{key}.impacts", pf.impacts)
+                put(f"{key}.impact_scales", pf.impact_scales)
         for fname, nf in self.numerics.items():
             key = _fkey(fname)
             put(f"num.{key}.values", nf.values)
@@ -283,6 +496,26 @@ class Segment:
             put(f"mvec.{key}.tok_vectors", mvf.tok_vectors)
             put(f"mvec.{key}.tok_offsets", mvf.tok_offsets)
             put(f"mvec.{key}.exists", mvf.exists)
+        for fname, sf in self.sparse.items():
+            key = _fkey(fname)
+            manifest["sparse"][fname] = {
+                "key": key,
+                "n_terms": len(sf.terms),
+                "pruned": sf.pruned,
+            }
+            blob, offsets = _encode_terms(sf.terms)
+            arrays[f"sp.{key}.terms_blob"] = blob
+            put(f"sp.{key}.term_offsets", offsets)
+            put(f"sp.{key}.term_df", sf.term_df)
+            put(f"sp.{key}.term_tile_start", sf.term_tile_start)
+            put(f"sp.{key}.term_tile_count", sf.term_tile_count)
+            put(f"sp.{key}.doc_ids", sf.doc_ids)
+            put(f"sp.{key}.weights", sf.weights)
+            put(f"sp.{key}.qweights", sf.qweights)
+            put(f"sp.{key}.scales", sf.scales)
+            put(f"sp.{key}.tile_max", sf.tile_max)
+            put(f"sp.{key}.tile_qmax", sf.tile_qmax)
+            put(f"sp.{key}.exists", sf.exists)
 
         np.savez(os.path.join(path, "arrays.npz"), **arrays)
         fsync_path(os.path.join(path, "arrays.npz"))
@@ -367,6 +600,14 @@ class Segment:
                 pos_data=(
                     data[f"{key}.pos_data"] if meta.get("positions") else None
                 ),
+                impacts=(
+                    data[f"{key}.impacts"] if meta.get("impacts") else None
+                ),
+                impact_scales=(
+                    data[f"{key}.impact_scales"]
+                    if meta.get("impacts")
+                    else None
+                ),
             )
         numerics = {
             fname: NumericField(
@@ -406,6 +647,26 @@ class Segment:
                 exists=data[f"mvec.{key}.exists"],
                 similarity=meta["similarity"],
             )
+        sparse = {}
+        for fname, meta in manifest.get("sparse", {}).items():
+            key = meta["key"]
+            sparse[fname] = SparseField(
+                terms=_decode_terms(
+                    data[f"sp.{key}.terms_blob"],
+                    data[f"sp.{key}.term_offsets"],
+                ),
+                term_df=data[f"sp.{key}.term_df"],
+                term_tile_start=data[f"sp.{key}.term_tile_start"],
+                term_tile_count=data[f"sp.{key}.term_tile_count"],
+                doc_ids=data[f"sp.{key}.doc_ids"],
+                weights=data[f"sp.{key}.weights"],
+                qweights=data[f"sp.{key}.qweights"],
+                scales=data[f"sp.{key}.scales"],
+                tile_max=data[f"sp.{key}.tile_max"],
+                tile_qmax=data[f"sp.{key}.tile_qmax"],
+                exists=data[f"sp.{key}.exists"],
+                pruned=int(meta.get("pruned", 0)),
+            )
         return cls(
             num_docs=manifest["num_docs"],
             doc_ids=docs["doc_ids"],
@@ -416,6 +677,7 @@ class Segment:
             vectors=vectors,
             generation=manifest.get("generation", 0),
             multi_vectors=multi_vectors,
+            sparse=sparse,
         )
 
 
@@ -508,6 +770,18 @@ class SegmentBuilder:
             }
             pf = self._build_postings(inv, lengths, n, doc_count)
             self._attach_positions(pf, inv_pos)
+            mf = self.mappings.get(fname)
+            if mf is None or mf.type == TEXT:
+                from ..models import bm25
+
+                attach_impacts(
+                    pf,
+                    bm25.norm_inverse_cache(
+                        bm25.avg_field_length(
+                            pf.stats.sum_total_term_freq, pf.stats.doc_count
+                        )
+                    ),
+                )
             postings[fname] = pf
 
         # ---- keyword fields → postings (tf=1) + ordinals ----
@@ -603,6 +877,24 @@ class SegmentBuilder:
                 similarity=sim,
             )
 
+        # ---- sparse_vector: impact-ordered quantized postings ----
+        sparse: Dict[str, SparseField] = {}
+        sp_fields = sorted({f for d in docs for f in d.sparse_vectors})
+        for fname in sp_fields:
+            mf = self.mappings.get(fname)
+            ratio = mf.pruning_ratio if mf else 0.0
+            inv_w: Dict[str, Dict[int, float]] = {}
+            exists = np.zeros(n, dtype=bool)
+            for local_id, d in enumerate(docs):
+                wmap = d.sparse_vectors.get(fname)
+                if not wmap:
+                    continue
+                exists[local_id] = True
+                for term, w in wmap.items():
+                    inv_w.setdefault(term, {})[local_id] = float(w)
+            plan = sparse_plan(inv_w, ratio)
+            sparse[fname] = sparse_from_plan(plan, n, exists)
+
         return Segment(
             num_docs=n,
             doc_ids=[d.doc_id for d in docs],
@@ -613,6 +905,7 @@ class SegmentBuilder:
             vectors=vectors,
             generation=self.generation,
             multi_vectors=multi_vectors,
+            sparse=sparse,
         )
 
     @staticmethod

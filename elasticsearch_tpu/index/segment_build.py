@@ -39,7 +39,7 @@ from typing import List, Optional
 import numpy as np
 
 from ..common.faults import SimulatedCrash, faults
-from .mapping import Mappings, ParsedDocument
+from .mapping import TEXT, Mappings, ParsedDocument
 from .segment import (
     MultiVectorField,
     NumericField,
@@ -47,10 +47,12 @@ from .segment import (
     PostingsField,
     Segment,
     SegmentBuilder,
+    SparseField,
     VectorField,
     FieldStats,
     TILE,
     _unit_normalize,
+    sparse_plan,
 )
 
 # ---------------------------------------------------------------------------
@@ -61,6 +63,7 @@ _LOCK = threading.Lock()
 INGEST_STATS = {
     "refreshes": 0,  # committed refreshes (all shards, all indices)
     "concurrent_refreshes": 0,  # double-buffered (built outside the lock)
+    "concurrent_merges": 0,  # double-buffered merges (built outside the lock)
     "device_builds": 0,  # segments whose columns were built on device
     "host_builds": 0,  # segments built by the host SegmentBuilder
     "fallbacks": 0,  # device-path failures → host build
@@ -223,6 +226,9 @@ def _device_build(builder: SegmentBuilder) -> Segment:
         }
         pf = _device_postings(ib, inv, lengths, n, doc_count)
         SegmentBuilder._attach_positions(pf, inv_pos)
+        mf = builder.mappings.get(fname)
+        if mf is None or mf.type == TEXT:
+            _device_impacts(ib, pf, n)
         postings[fname] = pf
 
     # ---- keyword fields: postings (tf=1) + device ordinal CSR ----
@@ -344,6 +350,51 @@ def _device_build(builder: SegmentBuilder) -> Segment:
             similarity=sim,
         )
 
+    # ---- sparse_vector: impact-ordered planes materialized on device.
+    # The host owns the layout plan (index/segment.sparse_plan — sort,
+    # impact ordering, pruning), so the device twin is bit-identical by
+    # construction; the kernel scatters + quantizes. ----
+    sparse = {}
+    sp_fields = sorted({f for d in docs for f in d.sparse_vectors})
+    for fname in sp_fields:
+        mf = builder.mappings.get(fname)
+        ratio = mf.pruning_ratio if mf else 0.0
+        inv_w = {}
+        sp_exists = np.zeros(n, dtype=bool)
+        for local_id, d in enumerate(docs):
+            wmap = d.sparse_vectors.get(fname)
+            if not wmap:
+                continue
+            sp_exists[local_id] = True
+            for term, w in wmap.items():
+                inv_w.setdefault(term, {})[local_id] = float(w)
+        plan = sparse_plan(inv_w, ratio)
+        nb = _charge_build(
+            ib.estimate_sparse_nbytes(
+                len(plan["docs"]), plan["n_tiles"], len(plan["terms"])
+            )
+        )
+        try:
+            doc_ids, weights, qweights, scales, tile_max, tile_qmax = (
+                ib.sparse_planes_device(plan)
+            )
+        finally:
+            _release_build(nb)
+        sparse[fname] = SparseField(
+            terms=plan["terms"],
+            term_df=plan["term_df"],
+            term_tile_start=plan["term_tile_start"],
+            term_tile_count=plan["term_tile_count"],
+            doc_ids=doc_ids,
+            weights=weights,
+            qweights=qweights,
+            scales=scales,
+            tile_max=tile_max,
+            tile_qmax=tile_qmax,
+            exists=sp_exists,
+            pruned=int(plan["pruned"]),
+        )
+
     return Segment(
         num_docs=n,
         doc_ids=[d.doc_id for d in docs],
@@ -354,6 +405,7 @@ def _device_build(builder: SegmentBuilder) -> Segment:
         vectors=vectors,
         generation=builder.generation,
         multi_vectors=multi_vectors,
+        sparse=sparse,
     )
 
 
@@ -435,6 +487,41 @@ def _device_postings(
         norms=norms,
         stats=stats,
     )
+
+
+def _device_impacts(ib, pf: PostingsField, n: int) -> None:
+    """Attach the precomputed BM25 impacts to a device-built text
+    postings column. The 256-entry segment-local inv-norm cache is
+    computed on HOST (models/bm25.norm_inverse_cache — the same float
+    path the host attach uses), so both builds fold identical bits; the
+    device folds it into per-posting int8 impacts."""
+    from ..models import bm25
+
+    n_terms = len(pf.terms)
+    if pf.n_tiles == 0:
+        pf.impacts = np.zeros((0, TILE), np.int8)
+        pf.impact_scales = np.zeros(n_terms, np.float32)
+        return
+    cache = bm25.norm_inverse_cache(
+        bm25.avg_field_length(
+            pf.stats.sum_total_term_freq, pf.stats.doc_count
+        )
+    )
+    tile_term = np.repeat(
+        np.arange(n_terms, dtype=np.int32), pf.term_tile_count
+    )
+    nb = _charge_build(
+        ib.bucket_pow2(pf.n_tiles, floor=1) * TILE * 9
+        + ib.bucket_pow2(n, floor=1)
+    )
+    try:
+        impacts, scales = ib.text_impacts_device(
+            pf.doc_ids, pf.tfs, pf.norms, cache, tile_term, n_terms, n
+        )
+    finally:
+        _release_build(nb)
+    pf.impacts = impacts
+    pf.impact_scales = scales
 
 
 def _device_ordinals(ib, all_vals: List[List[str]], n: int) -> OrdinalField:

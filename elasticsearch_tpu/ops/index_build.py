@@ -437,6 +437,187 @@ def csr_offsets_device(counts: np.ndarray, n_docs: int):
 
 
 # ---------------------------------------------------------------------------
+# sparse_vector impact planes: scatter + per-term int8 quantization
+# ---------------------------------------------------------------------------
+
+_SPARSE_JIT = {}
+
+
+def _sparse_kernel(n_slots: int, t_pad: int):
+    key = (n_slots, t_pad)
+    fn = _SPARSE_JIT.get(key)
+    if fn is not None:
+        return fn
+    jax = _jax()
+    import jax.numpy as jnp
+
+    n_tiles_pad = n_slots // TILE
+
+    @jax.jit
+    def run(docs, ws, dest, tts, tile_term, c127):
+        flat_doc = jnp.full((n_slots,), INVALID_DOC, jnp.int32)
+        flat_doc = flat_doc.at[dest].set(docs, mode="drop")
+        flat_w = jnp.zeros((n_slots,), jnp.float32).at[dest].set(
+            ws, mode="drop"
+        )
+        doc_ids = flat_doc.reshape(n_tiles_pad, TILE)
+        w_tiles = flat_w.reshape(n_tiles_pad, TILE)
+        tile_max = w_tiles.max(axis=1).astype(jnp.float32)
+        # impact ordering puts every term's global max weight in its
+        # FIRST tile, so the per-term quantization scale is one gather.
+        # c127 rides as a runtime operand (see _quantize_kernel: a
+        # constant divisor would let XLA strength-reduce the divide).
+        first = jnp.clip(tts, 0, n_tiles_pad - 1)
+        scales = (tile_max[first] / c127).astype(jnp.float32)
+        slot_scale = scales[jnp.clip(tile_term, 0, t_pad - 1)]
+        safe = jnp.where(slot_scale == 0, 1.0, slot_scale)
+        qweights = jnp.clip(
+            jnp.rint(w_tiles / safe[:, None]), -127, 127
+        ).astype(jnp.int8)
+        tile_qmax = (
+            qweights.max(axis=1).astype(jnp.float32) * slot_scale
+        ).astype(jnp.float32)
+        return doc_ids, w_tiles, qweights, scales, tile_max, tile_qmax
+
+    _SPARSE_JIT[key] = run
+    return run
+
+
+def sparse_planes_device(plan: dict):
+    """(doc_ids, weights, qweights, scales, tile_max, tile_qmax) — the
+    device materializer for one sparse_vector column, consuming the SAME
+    host layout plan (index/segment.sparse_plan) as the host build. The
+    kernel only scatters, reduces with exact max, and quantizes with
+    per-term symmetric scales, so every output plane is bit-identical to
+    index/segment.sparse_from_plan (parity-gated per family)."""
+    n_tiles = int(plan["n_tiles"])
+    n_terms = len(plan["terms"])
+    P = len(plan["docs"])
+    n_slots = bucket_pow2(n_tiles, floor=1) * TILE
+    p_pad = bucket_pow2(P, floor=1)
+    t_pad = bucket_pow2(n_terms, floor=1)
+    docs_p = np.zeros(p_pad, np.int32)
+    ws_p = np.zeros(p_pad, np.float32)
+    dest_p = np.full(p_pad, n_slots, np.int64)  # OOB → dropped
+    docs_p[:P] = plan["docs"]
+    ws_p[:P] = plan["weights"]
+    dest_p[:P] = plan["dest"]
+    tts_p = np.zeros(t_pad, np.int32)
+    tts_p[:n_terms] = plan["term_tile_start"]
+    tile_term_p = np.full(n_slots // TILE, t_pad, np.int32)
+    tile_term_p[:n_tiles] = plan["tile_term"]
+    with _timed("sparse"):
+        run = _sparse_kernel(n_slots, t_pad)
+        doc_ids, w_tiles, qweights, scales, tile_max, tile_qmax = run(
+            docs_p, ws_p, dest_p, tts_p, tile_term_p, np.float32(127.0)
+        )
+        out = (
+            np.ascontiguousarray(np.asarray(doc_ids)[:n_tiles]),
+            np.ascontiguousarray(np.asarray(w_tiles)[:n_tiles]),
+            np.ascontiguousarray(np.asarray(qweights)[:n_tiles]),
+            np.ascontiguousarray(np.asarray(scales)[:n_terms]),
+            np.ascontiguousarray(np.asarray(tile_max)[:n_tiles]),
+            np.ascontiguousarray(np.asarray(tile_qmax)[:n_tiles]),
+        )
+    return out
+
+
+def estimate_sparse_nbytes(P: int, n_tiles: int, n_terms: int) -> int:
+    slots = bucket_pow2(n_tiles, floor=1) * TILE
+    return int(
+        bucket_pow2(P, floor=1) * 16  # docs/weights/dest uploads
+        + slots * 9  # doc/weight/qweight planes
+        + slots // TILE * 12  # tile sidecars + tile_term
+        + bucket_pow2(n_terms, floor=1) * 8  # starts + scales
+    )
+
+
+# ---------------------------------------------------------------------------
+# text-postings BM25 impact precompute (BM25S eager scoring)
+# ---------------------------------------------------------------------------
+
+_IMPACT_JIT = {}
+
+
+def _impact_kernel(n_slots: int, n_docs_pad: int, t_pad: int):
+    key = (n_slots, n_docs_pad, t_pad)
+    fn = _IMPACT_JIT.get(key)
+    if fn is not None:
+        return fn
+    jax = _jax()
+    import jax.numpy as jnp
+
+    n_tiles_pad = n_slots // TILE
+
+    @jax.jit
+    def run(doc_ids, tfs, norms, cache, tile_term, c127):
+        valid = doc_ids >= 0
+        nb = norms[jnp.clip(doc_ids, 0, n_docs_pad - 1)]
+        inv = cache[nb.astype(jnp.int32)]
+        # 1 - 1/(1 + tf*inv_norm): the tf/norm factor of the repo's one
+        # BM25 contribution formula (ops/scoring.bm25_tile_contrib),
+        # elementwise IEEE ops only — bit-identical to the host attach
+        imp = 1.0 - 1.0 / (1.0 + tfs.astype(jnp.float32) * inv)
+        imp = jnp.where(valid, imp, jnp.float32(0.0)).astype(jnp.float32)
+        tile_imax = imp.max(axis=1).astype(jnp.float32)
+        term_max = jax.ops.segment_max(
+            tile_imax, tile_term, num_segments=t_pad
+        ).astype(jnp.float32)
+        scales = (term_max / c127).astype(jnp.float32)
+        slot_scale = scales[jnp.clip(tile_term, 0, t_pad - 1)]
+        safe = jnp.where(slot_scale == 0, 1.0, slot_scale)
+        impacts = jnp.clip(
+            jnp.rint(imp / safe[:, None]), -127, 127
+        ).astype(jnp.int8)
+        return impacts, scales
+
+    _IMPACT_JIT[key] = run
+    return run
+
+
+def text_impacts_device(
+    doc_ids: np.ndarray,
+    tfs: np.ndarray,
+    norms: np.ndarray,
+    inv_norm_cache: np.ndarray,
+    tile_term: np.ndarray,
+    n_terms: int,
+    n_docs: int,
+):
+    """(impacts[int8 n_tiles, TILE], impact_scales[f32 n_terms]) for one
+    text postings column. `inv_norm_cache` is the host-computed 256-entry
+    segment-local table (models/bm25.norm_inverse_cache) — shared with
+    the host attach so both paths fold identical bits."""
+    n_tiles = doc_ids.shape[0]
+    n_slots = bucket_pow2(n_tiles, floor=1) * TILE
+    n_docs_pad = bucket_pow2(n_docs, floor=1)
+    t_pad = bucket_pow2(n_terms, floor=1)
+    doc_p = np.full((n_slots // TILE, TILE), INVALID_DOC, np.int32)
+    tf_p = np.zeros((n_slots // TILE, TILE), np.int32)
+    doc_p[:n_tiles] = doc_ids
+    tf_p[:n_tiles] = tfs
+    norms_p = np.zeros(n_docs_pad, np.uint8)
+    norms_p[:n_docs] = norms
+    tile_term_p = np.full(n_slots // TILE, t_pad, np.int32)
+    tile_term_p[:n_tiles] = tile_term
+    with _timed("impacts"):
+        run = _impact_kernel(n_slots, n_docs_pad, t_pad)
+        impacts, scales = run(
+            doc_p,
+            tf_p,
+            norms_p,
+            inv_norm_cache.astype(np.float32),
+            tile_term_p,
+            np.float32(127.0),
+        )
+        out = (
+            np.ascontiguousarray(np.asarray(impacts)[:n_tiles]),
+            np.ascontiguousarray(np.asarray(scales)[:n_terms]),
+        )
+    return out
+
+
+# ---------------------------------------------------------------------------
 # aggregation permutation tables (search/aggs_device.counts_layout)
 # ---------------------------------------------------------------------------
 

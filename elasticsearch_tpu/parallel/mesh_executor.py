@@ -62,6 +62,7 @@ from .sharded import (
     build_mesh_ann_step,
     build_mesh_knn_step,
     build_mesh_rerank_step,
+    build_mesh_sparse_step,
     build_mesh_text_step,
 )
 
@@ -527,6 +528,70 @@ class MeshExecutor:
             snap.knn[field] = view
             return view
 
+    def _sparse_view(
+        self, snap: _MeshSnapshot, field: str, quantized: bool
+    ) -> dict:
+        """Stacked impact-ordered postings for one `sparse_vector`
+        field: each entry's tile planes padded to the widest tile count,
+        plus the per-entry SparseField handles (each entry has its OWN
+        term dictionary / tile layout / dequant scales, so plan packing
+        resolves per entry). Only the serving column for this
+        `quantized` mode is stacked — the other column never rides the
+        ICI."""
+        key = ("sparse", field, bool(quantized))
+        view = snap.text.get(key)
+        if view is not None:
+            return view
+        with self._lock:
+            view = snap.text.get(key)
+            if view is not None:
+                return view
+            sfs = []
+            t_max = 1
+            for sid, si in snap.entries:
+                sf = (
+                    getattr(snap.readers[sid].segments[si], "sparse", None)
+                    or {}
+                ).get(field)
+                sfs.append(sf)
+                if sf is not None:
+                    t_max = max(t_max, int(sf.n_tiles))
+            if all(sf is None for sf in sfs):
+                raise MeshUnavailable(
+                    f"no entry has sparse_vector field [{field}]"
+                )
+            vdtype = np.int8 if quantized else np.float32
+            doc_ids = np.full(
+                (snap.e_pad, t_max, TILE), INVALID_DOC, np.int32
+            )
+            values = np.zeros((snap.e_pad, t_max, TILE), vdtype)
+
+            def _fill_sparse(e: int) -> None:
+                sf = sfs[e]
+                if sf is None:
+                    return
+                nt = int(sf.n_tiles)
+                doc_ids[e, :nt] = np.asarray(sf.doc_ids)
+                values[e, :nt] = np.asarray(
+                    sf.qweights if quantized else sf.weights
+                )
+
+            self._fill_stack(
+                snap,
+                key,
+                {"doc_ids": doc_ids, "values": values},
+                _fill_sparse,
+            )
+            snap.charge(doc_ids.nbytes + values.nbytes)
+            sh3 = NamedSharding(snap.mesh, P(SHARD_AXIS, None, None))
+            view = {
+                "doc_ids": jax.device_put(doc_ids, sh3),
+                "values": jax.device_put(values, sh3),
+                "sfs": sfs,
+            }
+            snap.text[key] = view
+            return view
+
     def _ann_view(self, snap: _MeshSnapshot, field: str, spec) -> dict:
         """Stacked IVF view: per-entry centroids (replicated scan),
         cluster-major permuted blocks + CSR bounds (clusters stay
@@ -981,6 +1046,24 @@ class MeshExecutor:
                     snap.steps[key] = step
         return step
 
+    def _sparse_step(self, snap, field, quantized, kb, t_shape):
+        key = ("sparse", field, bool(quantized), kb, t_shape)
+        step = snap.steps.get(key)
+        if step is None:
+            with self._lock:
+                step = snap.steps.get(key)
+                if step is None:
+                    view = self._sparse_view(snap, field, quantized)
+                    step = build_mesh_sparse_step(
+                        snap.mesh,
+                        view["doc_ids"],
+                        view["values"],
+                        snap.live,
+                        kb,
+                    )
+                    snap.steps[key] = step
+        return step
+
     # ---- plan packing (host side; mirrors the sequential builders) ----
 
     def _rows_for(self, snap, n_jobs: int) -> int:
@@ -1087,6 +1170,58 @@ class MeshExecutor:
                 if len(ti) > t_cap:
                     raise MeshUnavailable(
                         f"serve plan overflows mesh tile cap [{t_cap}]"
+                    )
+                t_max = max(t_max, len(ti))
+                slots += len(ti)
+                row.append((ti, tw))
+            lists.append(row)
+        T = scoring.next_bucket(t_max)
+        ti_a = np.zeros((e_pad, rows, T), np.int32)
+        tw_a = np.zeros((e_pad, rows, T), np.float32)
+        tv_a = np.zeros((e_pad, rows, T), bool)
+        for e, row in enumerate(lists):
+            for ji, (ti, tw) in enumerate(row):
+                if ti is None or not len(ti):
+                    continue
+                ti_a[e, ji, : len(ti)] = ti
+                tw_a[e, ji, : len(ti)] = tw
+                tv_a[e, ji, : len(ti)] = True
+        return ti_a, tw_a, tv_a, T, slots
+
+    def _pack_sparse(self, snap, view, jobs, quantized, t_cap, rows: int):
+        """Per-(entry, job) impact-tile plans in EXACTLY the sequential
+        _dispatch_sparse_group order: ops/impact.impact_tile_lists term
+        order with each entry's dequant scales folded on host, every
+        tile essential (no pruning on the mesh path)."""
+        from ..ops import impact as impact_ops
+
+        e_pad = snap.e_pad
+        lists: List[List[Tuple[Optional[np.ndarray], Optional[np.ndarray]]]] = []
+        t_max = 1
+        slots = 0
+        for e in range(len(snap.entries)):
+            sf = view["sfs"][e]
+            row = []
+            for j in jobs:
+                if sf is None or not sf.n_tiles:
+                    row.append((None, None))
+                    continue
+                _tids, tws, _bws, starts, counts = impact_ops.impact_tile_lists(
+                    sf, j.plan.terms, j.plan.weights, quantized
+                )
+                tl = [
+                    np.arange(s0, s0 + c, dtype=np.int64)
+                    for s0, c in zip(starts, counts)
+                ]
+                wl = [
+                    np.full(int(c), w, np.float32)
+                    for c, w in zip(counts, tws)
+                ]
+                ti = np.concatenate(tl) if tl else np.empty(0, np.int64)
+                tw = np.concatenate(wl) if wl else np.empty(0, np.float32)
+                if len(ti) > t_cap:
+                    raise MeshUnavailable(
+                        f"sparse plan overflows mesh tile cap [{t_cap}]"
                     )
                 t_max = max(t_max, len(ti))
                 slots += len(ti)
@@ -1255,6 +1390,40 @@ class MeshExecutor:
                 snapshot=snap,
             )
             j.event.set()
+
+    def dispatch_sparse(self, jobs, kb: int):
+        """One SPMD learned-sparse launch for a same-(field, spec) job
+        group. The `sparse.score` fault site fires with mesh=1 here —
+        an injected error degrades the whole request to the per-shard
+        path (indices._mesh_search's fallback), where the site fires
+        again per segment with the host dense oracle as the terminal
+        backstop."""
+        from ..common.faults import faults as _faults
+        from ..ops import impact as impact_ops
+        from ..search import sparse as sparse_mod
+
+        snap = self.ensure_snapshot()
+        plan0 = jobs[0].plan
+        field = plan0.field
+        quantized = bool(plan0.spec.quantized)
+        _faults.check("sparse.score", field=field, mesh=1)
+        view = self._sparse_view(snap, field, quantized)
+        rows = self._rows_for(snap, len(jobs))
+        ti, tw, tv, T, slots = self._pack_sparse(
+            snap, view, jobs, quantized, mesh_t_max(), rows
+        )
+        step = self._sparse_step(snap, field, quantized, kb, T)
+        with _LAUNCH_LOCK:
+            out = step(ti, tw, tv)
+        with self._lock:
+            self.stats["launches"] += 1
+            self.stats["jobs"] += len(jobs)
+        sparse_mod.note_search(len(jobs), quantized, slots, 0)
+        flops = impact_ops.sparse_flops(slots)
+        return {"snap": snap, "out": out, "flops": flops, "rows": rows}
+
+    def collect_sparse(self, jobs, pend):
+        self._collect_text(jobs, pend)
 
     def dispatch_knn(self, jobs, kb: int):
         snap = self.ensure_snapshot()

@@ -570,6 +570,96 @@ def build_mesh_text_step(
     return step
 
 
+def build_mesh_sparse_step(
+    mesh: Mesh,
+    doc_ids: jax.Array,  # [E, Tmax, TILE] stacked impact-ordered tiles
+    values: jax.Array,  # [E, Tmax, TILE] stored dtype (int8 or f32)
+    live: jax.Array,  # bool[E, Nmax] (live docs ∧ in-range padding mask)
+    k: int,
+):
+    """One SPMD learned-sparse scoring step over stacked (shard,
+    segment) entries.
+
+    fn(ti, tw, tv) → (scores[B, K], entry[B, K], doc[B, K], totals[B])
+    with the per-(entry, job) tile plan ti/tw/tv of shape [E, B, T]
+    sharded (shards, data, None) and outputs over ``data`` only.
+
+    The contribution formula is ops/impact.impact_tile_contrib — the
+    SAME jnp expression the sequential ImpactScorer launches — and the
+    tile lists arrive term-ordered with every tile present (no pruning
+    on the mesh path: theta would need a cross-device round-trip, and
+    the full pass keeps the step float-identical to the per-shard
+    serving path with the exact totals for free). `tw` carries the
+    query weight with each ENTRY's per-term dequant scale pre-folded,
+    so the one step serves int8 and fp32 columns alike."""
+    from ..ops.impact import impact_tile_contrib
+
+    n_docs = int(live.shape[1])
+
+    def body(dids, vals, live_b, ti, tw, tv):
+        def entry(d_e, v_e, live_e, ti_e, tw_e, tv_e):
+            Bd = ti_e.shape[0]
+            nt = d_e.shape[0]
+            rows_d = d_e[jnp.clip(ti_e, 0, nt - 1)]  # [Bd, T, 128]
+            rows_v = v_e[jnp.clip(ti_e, 0, nt - 1)]
+            valid = (rows_d >= 0) & tv_e[:, :, None]
+            tgt, s = impact_tile_contrib(
+                rows_d, rows_v, tw_e[:, :, None], valid, n_docs
+            )
+            acc = jnp.zeros((Bd, n_docs + 1), jnp.float32)
+            acc = jax.vmap(
+                lambda a, d, v: a.at[d.ravel()].add(v.ravel())
+            )(acc, tgt, s)
+            cnt = jnp.zeros((Bd, n_docs + 1), jnp.int32)
+            cnt = jax.vmap(
+                lambda c, d, v: c.at[d.ravel()].add(
+                    v.ravel().astype(jnp.int32)
+                )
+            )(cnt, tgt, valid)
+            # every query term is optional: the sparse match mask is
+            # cnt > 0, exactly ops/scoring._finalize at msm=1
+            mask = (cnt[:, :n_docs] >= 1) & live_e[None, :]
+            masked = jnp.where(mask, acc[:, :n_docs], -jnp.inf)
+            kk = min(k, n_docs)
+            s2, d2 = jax.lax.top_k(masked, kk)
+            return s2, d2, mask.sum(axis=1, dtype=jnp.int32)
+
+        s, d, t = jax.vmap(entry)(
+            dids, vals, live_b, ti, tw, tv
+        )  # [F, Bd, kk] ×2, [F, Bd]
+        gs = jax.lax.all_gather(s, SHARD_AXIS)  # [G, F, Bd, kk]
+        gd = jax.lax.all_gather(d, SHARD_AXIS)
+        ms, me, md = _merge_gathered(gs, gd, k)
+        totals = jax.lax.psum(t.sum(axis=0), SHARD_AXIS)
+        return ms, me, md, totals
+
+    fn = shard_map(
+        body,
+        mesh=mesh,
+        in_specs=(
+            P(SHARD_AXIS, None, None),
+            P(SHARD_AXIS, None, None),
+            P(SHARD_AXIS, None),
+            P(SHARD_AXIS, DATA_AXIS, None),
+            P(SHARD_AXIS, DATA_AXIS, None),
+            P(SHARD_AXIS, DATA_AXIS, None),
+        ),
+        out_specs=(
+            P(DATA_AXIS, None),
+            P(DATA_AXIS, None),
+            P(DATA_AXIS, None),
+            P(DATA_AXIS),
+        ),
+        check_vma=False,
+    )
+
+    @jax.jit
+    def step(ti, tw, tv):
+        return fn(doc_ids, values, live, ti, tw, tv)
+
+    return step
+
+
 def build_mesh_rerank_step(
     mesh: Mesh,
     doc_ids: jax.Array,  # [E, Tmax, TILE] stacked postings tiles

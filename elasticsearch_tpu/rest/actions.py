@@ -694,6 +694,19 @@ class RestActions:
             for idx in self.cluster.indices.values()
             if getattr(idx, "_batcher", None) is not None
         )
+        # learned-sparse retrieval counters (search/sparse.py):
+        # quantized/exact/fallback routings, impact tiles scored vs
+        # pruned by the block-max pass, the `impacts` HBM ledger bytes,
+        # and the int8-vs-fp32-equivalent upload sizes (the compression
+        # headline)
+        from ..search.sparse import stats_snapshot as sparse_stats
+
+        sparse_block = sparse_stats()
+        sparse_block["batched_jobs"] = sum(
+            getattr(idx, "_batcher", None).stats.get("sparse_jobs", 0)
+            for idx in self.cluster.indices.values()
+            if getattr(idx, "_batcher", None) is not None
+        )
         # write-path durability counters (index/translog.py): live
         # uncommitted WAL state aggregated over local shards, plus the
         # process-wide hygiene/recovery counters (torn tails truncated,
@@ -791,6 +804,7 @@ class RestActions:
                     "aggs": aggs_block,
                     "knn": knn_block,
                     "rescore": rescore_block,
+                    "sparse": sparse_block,
                     "translog": translog_block,
                     "ingest": ingest_block,
                     "recovery": recovery_block,

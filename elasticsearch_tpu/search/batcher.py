@@ -49,7 +49,7 @@ import numpy as np
 
 from ..common.faults import faults
 from ..common.settings import batch_buckets, bucket_for, bucket_warmup
-from ..index.mapping import TEXT
+from ..index.mapping import SPARSE_VECTOR, TEXT
 from ..ops import scoring
 from ..ops.scoring import BPAD
 from . import dsl
@@ -178,6 +178,46 @@ class KnnPlan:
     num_candidates: int
     boost: float
     ann: Optional[object] = None
+
+
+@dataclass(frozen=True)
+class SparsePlan:
+    """A bare `sparse_vector` query: batched impact-tile launches per
+    segment (ops/impact.py) with impact-ordered block-max pruning.
+    Query weights arrive boost-folded (float32, exactly as the host
+    oracle folds them) and term-sorted — the canonical accumulation
+    order both paths share, which is what keeps the fp32 device path
+    bit-equal to the oracle. `spec` (search/sparse.SparseSpec) rides
+    the group key, so int8 and fp32 servings never share a launch."""
+
+    field: str
+    terms: Tuple[str, ...]
+    weights: Tuple[float, ...]
+    spec: object
+
+
+def extract_sparse_plan(query, mappings) -> Optional[SparsePlan]:
+    """Returns a SparsePlan when `query` is a bare sparse_vector query
+    over a sparse_vector field with a resolved SparseSpec (the hot REST
+    shape), else None → normal executor path (host oracle)."""
+    if not isinstance(query, dsl.SparseVectorQuery):
+        return None
+    mf = mappings.get(query.field)
+    if mf is None or mf.type != SPARSE_VECTOR:
+        return None
+    spec = getattr(query, "sparse", None)
+    if spec is None:
+        return None
+    boost = np.float32(query.boost)
+    items = sorted(query.query_vector.items())
+    return SparsePlan(
+        field=query.field,
+        terms=tuple(t for t, _ in items),
+        weights=tuple(
+            float(np.float32(boost * np.float32(w))) for _, w in items
+        ),
+        spec=spec,
+    )
 
 
 def _clause_terms(q, mappings, analysis) -> Optional[Tuple[str, List[str], float]]:
@@ -518,6 +558,10 @@ class QueryBatcher:
             # dispatch/collect pipeline as maxsim launches between
             # merge and fetch)
             "rerank_jobs": 0,
+            # learned-sparse job family (bare sparse_vector bodies
+            # riding the dispatch/collect pipeline as impact-tile
+            # launches with block-max pruning)
+            "sparse_jobs": 0,
         }
         # per-bucket launch histogram + occupancy sums (guarded by
         # self._lock; surfaced via batching_stats() → _nodes/stats):
@@ -534,7 +578,9 @@ class QueryBatcher:
         self._warm_inflight = 0
         # family → groups currently dispatched-but-not-collected,
         # across ALL workers (guarded by self._lock)
-        self._inflight = {"text": 0, "knn": 0, "agg": 0, "rerank": 0}
+        self._inflight = {
+            "text": 0, "knn": 0, "agg": 0, "rerank": 0, "sparse": 0,
+        }
         # per-device roofline accounting (straggler visibility): device
         # id → [inflight_groups, busy_t0, busy_s, flops]; single-device
         # groups attribute to device 0, mesh groups to every device in
@@ -814,6 +860,10 @@ class QueryBatcher:
                     )
                 elif j.kind == "mesh_knn":
                     key = (id(j.executor), "Mk", j.plan.field, j.plan.ann, kb)
+                elif j.kind == "mesh_sparse":
+                    key = (
+                        id(j.executor), "Mv", j.plan.field, j.plan.spec, kb,
+                    )
                 elif j.kind == "agg":
                     # device-aggregations family: jobs group by the
                     # compiled plan's structural signature so identical
@@ -824,6 +874,11 @@ class QueryBatcher:
                     # launch when model, padded window/query-token
                     # shapes, static window, and blend weights agree
                     key = (id(j.executor), "r", j.plan.sig, kb)
+                elif j.kind == "sparse":
+                    # learned-sparse family: the frozen SparseSpec rides
+                    # the key so int8 and fp32 servings of one field
+                    # never share a launch
+                    key = (id(j.executor), "v", j.plan.field, j.plan.spec, kb)
                 elif j.kind == "mesh_agg":
                     key = (id(j.executor), "Ma", j.plan.sig, kb)
                 else:  # knn (exact and IVF-probed jobs never share;
@@ -835,13 +890,15 @@ class QueryBatcher:
             )
             for key, jobs in ordered:
                 kind, kb = key[1], key[-1]
-                mesh = kind in ("Mm", "Ms", "Mk", "Ma")
+                mesh = kind in ("Mm", "Ms", "Mk", "Ma", "Mv")
                 if kind in ("k", "Mk"):
                     fam = "knn"
                 elif kind in ("a", "Ma"):
                     fam = "agg"
                 elif kind == "r":
                     fam = "rerank"
+                elif kind in ("v", "Mv"):
+                    fam = "sparse"
                 else:
                     fam = "text"
                 # pad-bucket ladder: the group's launch width is the
@@ -903,6 +960,16 @@ class QueryBatcher:
                              dev_ids)
                         )
                         dispatched = True
+                    elif kind == "v":
+                        self._record_bucket(rows, len(jobs))
+                        ctx.pending.append(
+                            (key, jobs, fam,
+                             self._dispatch_sparse_group(jobs, kb,
+                                                         rows=rows),
+                             dev_ids)
+                        )
+                        dispatched = True
+                        self._maybe_warm(key, jobs, kb, rows)
                     else:
                         mex = jobs[0].executor
                         if kind == "Mm":
@@ -911,6 +978,8 @@ class QueryBatcher:
                             pend = mex.dispatch_serve(jobs, kb)
                         elif kind == "Ma":
                             pend = mex.dispatch_agg(jobs)
+                        elif kind == "Mv":
+                            pend = mex.dispatch_sparse(jobs, kb)
                         else:
                             pend = mex.dispatch_knn(jobs, kb)
                         # the busy window opens on the devices the
@@ -960,7 +1029,7 @@ class QueryBatcher:
                     # transfer) fails this group's waiters only
                     faults.check(
                         "batcher.collect", family=fam, jobs=len(jobs),
-                        mesh=int(kind in ("Mm", "Ms", "Mk")),
+                        mesh=int(kind in ("Mm", "Ms", "Mk", "Mv")),
                     )
                     if kind == "s":
                         self._collect_serve_group(jobs, key[-1], pend)
@@ -970,6 +1039,8 @@ class QueryBatcher:
                         self._collect_agg_group(jobs, pend)
                     elif kind == "r":
                         self._collect_rerank_group(jobs, pend)
+                    elif kind == "v":
+                        self._collect_sparse_group(jobs, key[-1], pend)
                     elif kind in ("Mm", "Ms"):
                         t0 = time.perf_counter()
                         jobs[0].executor.collect_match(jobs, pend)
@@ -981,6 +1052,10 @@ class QueryBatcher:
                     elif kind == "Ma":
                         t0 = time.perf_counter()
                         jobs[0].executor.collect_agg(jobs, pend)
+                        self._add_stall(time.perf_counter() - t0)
+                    elif kind == "Mv":
+                        t0 = time.perf_counter()
+                        jobs[0].executor.collect_sparse(jobs, pend)
                         self._add_stall(time.perf_counter() - t0)
                     else:
                         self._collect_knn_group(jobs, pend)
@@ -1110,6 +1185,12 @@ class QueryBatcher:
                         )
                         self._collect_serve_group(dummy, kb, pend,
                                                   record=False)
+                    elif kind == "v":
+                        pend = self._dispatch_sparse_group(
+                            dummy, kb, rows=b, record=False
+                        )
+                        self._collect_sparse_group(dummy, kb, pend,
+                                                   record=False)
                     else:
                         pend = self._dispatch_knn_group(
                             dummy, rows=b, record=False
@@ -1829,6 +1910,189 @@ class QueryBatcher:
             jobs, per_job_cands, totals, reader,
             page_caps=[j.plan.k for j in jobs],
         )
+
+    def _dispatch_sparse_group(self, jobs: List[_Job], kb: int,
+                               rows: Optional[int] = None,
+                               record: bool = True) -> List[Tuple]:
+        """Launches the impact-tile kernels (ops/impact.py) for a group
+        of same-(field, spec) sparse_vector jobs on every segment
+        carrying the column. Two-phase per segment: phase A scores each
+        query term's FIRST tile (where impact ordering puts the term
+        maxima), one theta download, then the surviving block-max tile
+        list scores into a fresh accumulator whose finalize triple
+        stays ON DEVICE until collect. The `sparse.score` fault site
+        fires per segment — an injected error (like an HBM degrade or
+        missing column) falls back DETERMINISTICALLY to the host dense
+        oracle for that segment at collect time, exact answers
+        included."""
+        from ..ops import impact as impact_ops
+        from . import sparse as sparse_mod
+
+        ex = jobs[0].executor
+        reader = ex.reader
+        nj = len(jobs)
+        rows = rows or BPAD
+        staging = getattr(ex, "staging_slab", None)
+        plan0 = jobs[0].plan
+        field = plan0.field
+        spec = plan0.spec
+        items: List[Tuple] = []
+        for si, seg in enumerate(reader.segments):
+            sfh = (getattr(seg, "sparse", None) or {}).get(field)
+            if sfh is None or not sfh.n_tiles:
+                continue
+            sc = None
+            try:
+                if record:
+                    faults.check("sparse.score", field=field, segment=si)
+                sc = ex.impact_scorer(si, field, spec.quantized)
+            except BaseException:
+                sc = None
+            if sc is None:
+                if record:
+                    sparse_mod.note("fallbacks", nj)
+                items.append(("fallback", si, None))
+                continue
+            # int8 serving prunes against the DEQUANTIZED tile maxima
+            # (tile_qmax): a dequantized slot can exceed the fp32 tile
+            # max by up to scale/2, so the fp32 bounds alone would be
+            # unsound against quantized scores
+            bound = sfh.tile_qmax if spec.quantized else sfh.tile_max
+            bms = []
+            prunable = []
+            for j in jobs:
+                tids, tws, bws, _, _ = impact_ops.impact_tile_lists(
+                    sfh, j.plan.terms, j.plan.weights, spec.quantized
+                )
+                bms.append(
+                    impact_ops.SparseBlockMax(
+                        sfh.term_tile_start, sfh.term_tile_count,
+                        bound, tids, tws, bws,
+                    )
+                )
+                # block-max upper bounds assume non-negative tile
+                # weights; a negative query weight keeps the job exact
+                # but unpruned
+                prunable.append(bool((tws >= 0).all()))
+            thetas = np.full(len(jobs), -np.inf, np.float32)
+            if any(
+                p and bm.n_tail_tiles for p, bm in zip(prunable, bms)
+            ):
+                acc, cnt = sc.new_acc(rows)
+                acc, cnt = sc.score_into(
+                    acc, cnt,
+                    [bm.phase_a()[0] for bm in bms],
+                    [bm.phase_a()[1] for bm in bms],
+                    staging=staging,
+                )
+                th, _ = sc.threshold(acc, kb)
+                for ji in range(len(jobs)):
+                    if prunable[ji]:
+                        thetas[ji] = th[ji]
+            tile_lists: List[np.ndarray] = []
+            weight_lists: List[np.ndarray] = []
+            pruned_flags = np.zeros(len(jobs), bool)
+            tiles_scored = 0
+            tiles_pruned = 0
+            for ji, bm in enumerate(bms):
+                t, w, dropped = bm.kept(float(thetas[ji]))
+                tile_lists.append(t)
+                weight_lists.append(w)
+                pruned_flags[ji] = dropped > 0
+                tiles_scored += len(t)
+                tiles_pruned += dropped
+            acc, cnt = sc.new_acc(rows)
+            acc, cnt = sc.score_into(
+                acc, cnt, tile_lists, weight_lists, staging=staging
+            )
+            pend = sc.finalize_device(acc, cnt, kb)
+            if record:
+                sparse_mod.note_search(
+                    nj, spec.quantized, tiles_scored, tiles_pruned
+                )
+                with self._lock:
+                    self.stats["launches"] += 1
+                    self.stats["sparse_jobs"] += nj
+                self._add_flops(impact_ops.sparse_flops(tiles_scored))
+            items.append(("dev", si, (pend, pruned_flags)))
+        return items
+
+    def _collect_sparse_group(self, jobs: List[_Job], kb: int, items,
+                              record: bool = True):
+        """Host side of the sparse group: one device-side merge + one
+        packed download covers every device segment; fallback segments
+        (fault / degrade) run per job through the executor's generic
+        per-segment top-k — which routes SparseVectorQuery to the host
+        dense oracle — and join the final merge. Hits are exact either
+        way; totals turn relation "gte" when block-max pruning dropped
+        tiles (the dropped docs provably score below the kth best, but
+        they are no longer counted)."""
+        ex = jobs[0].executor
+        reader = ex.reader
+        per_job_cands: List[List[Tuple[float, int, int]]] = [
+            [] for _ in jobs
+        ]
+        totals = np.zeros(len(jobs), np.int64)
+        pruned_any = np.zeros(len(jobs), bool)
+        dev_items = []
+        for tag, si, payload in items:
+            if tag != "dev":
+                continue
+            pend, pruned_flags = payload
+            dev_items.append((si, *pend))
+            pruned_any |= pruned_flags
+        if dev_items:
+            t0 = time.perf_counter()
+            ms, mseg, mdoc, mtot = scoring.merge_segment_topk(
+                dev_items, kb
+            )
+            if record:
+                self._add_stall(time.perf_counter() - t0)
+            for ji in range(len(jobs)):
+                finite = np.isfinite(ms[ji])
+                for s, si, d in zip(
+                    ms[ji][finite], mseg[ji][finite], mdoc[ji][finite]
+                ):
+                    per_job_cands[ji].append((float(s), int(si), int(d)))
+                totals[ji] += int(mtot[ji].sum())
+        for tag, si, _payload in items:
+            if tag != "fallback":
+                continue
+            for ji, j in enumerate(jobs):
+                s1, d1, t1 = ex.segment_topk(j.query, si, kb)
+                if record:
+                    with self._lock:
+                        self.stats["launches"] += 1
+                self._collect(
+                    [j], [per_job_cands[ji]], totals[ji : ji + 1],
+                    si, s1[None, :], d1[None, :], np.array([t1]),
+                )
+        for ji, j in enumerate(jobs):
+            cands = per_job_cands[ji]
+            cands.sort(key=lambda c: (-c[0], c[1], c[2]))
+            page = cands[: j.k]
+            hits = [
+                Hit(
+                    score=s,
+                    segment=si,
+                    local_doc=d,
+                    doc_id=reader.segments[si].doc_ids[d],
+                )
+                for s, si, d in page
+            ]
+            relation = "eq"
+            if pruned_any[ji]:
+                if record:
+                    with self._lock:
+                        self.stats["pruned_jobs"] += 1
+                relation = "gte"
+            j.result = TopDocs(
+                total=int(totals[ji]),
+                hits=hits,
+                max_score=hits[0].score if hits else None,
+                relation=relation,
+            )
+            j.event.set()
 
     def _finish_jobs(self, jobs, per_job_cands, totals, reader,
                      page_caps=None):

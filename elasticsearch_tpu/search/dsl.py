@@ -14,6 +14,8 @@ TPU-native replacement for Lucene's Weight/Scorer pull iterators.
 
 from __future__ import annotations
 
+import math
+
 from dataclasses import dataclass, field as dc_field
 from typing import Any, Dict, List, Optional
 
@@ -456,6 +458,54 @@ def _parse_knn_query(params):
 
 
 @dataclass
+class SparseVectorQuery(Query):
+    """`sparse_vector` query over a learned term→weight map (ES 8.15
+    SparseVectorQueryBuilder shape): score = Σ query_weight · impact
+    over the terms both sides share. Served from the device-resident
+    impact-ordered postings (ops/impact.py) with the dense fp32 host
+    scorer as oracle."""
+
+    field: str = ""
+    query_vector: Dict[str, float] = dc_field(default_factory=dict)
+    boost: float = 1.0
+    # resolved search/sparse.SparseSpec (set by IndexService from the
+    # index's sparse.quantization setting + body-level exact flag)
+    sparse: Optional[object] = None
+
+
+def parse_sparse_vector(params) -> SparseVectorQuery:
+    if not isinstance(params, dict) or "field" not in params:
+        raise QueryParseError("[sparse_vector] requires [field]")
+    qv = params.get("query_vector")
+    if not isinstance(qv, dict) or not qv:
+        # missing, wrong-shaped and {}-empty maps are all the same
+        # request bug; catching it at parse keeps it a 400, not a
+        # shard-side 500
+        raise QueryParseError(
+            "[sparse_vector] requires a non-empty [query_vector] "
+            "term→weight object"
+        )
+    terms: Dict[str, float] = {}
+    for t, w in qv.items():
+        if isinstance(w, bool) or not isinstance(w, (int, float)):
+            raise QueryParseError(
+                f"[sparse_vector] weight for term [{t}] must be a "
+                f"number, got [{w!r}]"
+            )
+        w = float(w)
+        if not math.isfinite(w):
+            raise QueryParseError(
+                f"[sparse_vector] weight for term [{t}] must be finite"
+            )
+        terms[str(t)] = w
+    return SparseVectorQuery(
+        field=str(params["field"]),
+        query_vector=terms,
+        boost=float(params.get("boost", 1.0)),
+    )
+
+
+@dataclass
 class KnnQueryWrapper(Query):
     """`knn` used as a query clause (ES 8.12+)."""
 
@@ -846,6 +896,7 @@ _PARSERS = {
     "match_all": _parse_match_all,
     "match_none": _parse_match_none,
     "knn": _parse_knn_query,
+    "sparse_vector": parse_sparse_vector,
     "ids": _parse_ids,
     "prefix": lambda p: _parse_simple_pattern(PrefixQuery, "prefix")(p),
     "wildcard": lambda p: _parse_simple_pattern(WildcardQuery, "wildcard")(p),

@@ -485,6 +485,8 @@ class NumpyExecutor:
         if isinstance(q, KnnQueryWrapper):
             si = self.reader.segments.index(seg)
             return self._exec_knn(q.knn, si, seg)
+        if isinstance(q, dsl.SparseVectorQuery):
+            return self._exec_sparse(q, seg)
         if isinstance(q, dsl.IdsQuery):
             return self._exec_ids(q, seg)
         if isinstance(q, (dsl.PrefixQuery, dsl.WildcardQuery, dsl.RegexpQuery)):
@@ -1386,6 +1388,34 @@ class NumpyExecutor:
         return mask, np.where(mask, total, 0).astype(np.float32)
 
     # ---- knn ----
+
+    def _exec_sparse(
+        self, q: "dsl.SparseVectorQuery", seg: Segment
+    ) -> Tuple[np.ndarray, np.ndarray]:
+        """Dense fp32 learned-sparse scorer — THE float oracle for the
+        impact-tile device path. Term-at-a-time np.add.at in sorted
+        query-term order: a doc occurs at most once in a term's
+        postings, so each score cell accumulates exactly one f32 add
+        per term, in term order — the same per-cell order the device
+        kernel scatters (ops/impact.py lays tiles out per term in the
+        identical sorted order), which is what makes the unquantized
+        device path bit-equal to this function."""
+        n = seg.num_docs
+        sf = (seg.sparse or {}).get(q.field)
+        if sf is None:
+            return np.zeros(n, bool), np.zeros(n, np.float32)
+        scores = np.zeros(n, np.float32)
+        mask = np.zeros(n, bool)
+        boost = np.float32(q.boost)
+        for t, w in sorted(q.query_vector.items()):
+            tid = sf.term_id(t)
+            if tid < 0:
+                continue
+            docs, ws = sf.term_postings(tid)
+            tw = np.float32(boost * np.float32(w))
+            np.add.at(scores, docs, tw * ws)
+            mask[docs] = True
+        return mask, np.where(mask, scores, 0).astype(np.float32)
 
     def _exec_knn(self, sec: KnnSection, si: int, seg: Segment) -> Tuple[np.ndarray, np.ndarray]:
         n = seg.num_docs

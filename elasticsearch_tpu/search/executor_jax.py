@@ -253,6 +253,12 @@ class JaxExecutor:
         # (small segment / budget degrade) so the exact path is chosen
         # without re-locking per batch.
         self._ann_indexes: Dict[tuple, object] = {}
+        # learned-sparse serving (ops/impact.py, search/sparse.py):
+        # per-(segment, field, storage-mode) ImpactScorers over the
+        # impact-ordered postings column, charged to the `impacts`
+        # HbmLedger category; an upload that would not fit degrades to
+        # the host dense oracle (None cached)
+        self._impact_scorers: Dict[tuple, object] = {}
         # second-stage reranker columns (search/rescorer.py): per-model
         # shard-level concatenated `rank_vectors` token arrays, built
         # lazily per executor generation and charged to the `rerank`
@@ -385,6 +391,15 @@ class JaxExecutor:
                             self.ann_index(si, fname, spec)
                     except Exception:
                         pass
+            for fname in getattr(seg, "sparse", None) or {}:
+                try:
+                    quant = (
+                        str(settings.get("sparse.quantization", "int8"))
+                        == "int8"
+                    )
+                    self.impact_scorer(si, fname, quant)
+                except Exception:
+                    pass
         for fname, mf in list(self.reader.mappings.fields.items()):
             if getattr(mf, "type", None) == RANK_VECTORS:
                 try:
@@ -1907,6 +1922,54 @@ class JaxExecutor:
                 ann_mod.note("small_segment_exact")
             self._ann_indexes[key] = idx
             return idx
+
+    # ---- learned-sparse impact columns (ops/impact.py scorers) ----
+
+    def impact_scorer(self, si: int, field: str, quantized: bool):
+        """Cached ops/impact.ImpactScorer over one segment's
+        impact-ordered sparse postings column — the int8 qweights plane
+        or the fp32 weights plane, chosen per SparseSpec — or None when
+        the segment has no such column or the upload would not fit the
+        HBM ledger (degrade to the host dense oracle, never trip).
+        Charged to the `impacts` category and cached per executor
+        generation, exactly like the agg tables and IVF indexes."""
+        key = ("sparse", si, field, bool(quantized))
+        if key in self._impact_scorers:
+            return self._impact_scorers[key]
+        with self._build_lock:
+            if key in self._impact_scorers:
+                return self._impact_scorers[key]
+            from ..common.memory import hbm_ledger
+            from ..ops import impact as impact_ops
+
+            seg = self.reader.segments[si]
+            sf = (getattr(seg, "sparse", None) or {}).get(field)
+            sc = None
+            if sf is not None and seg.num_docs and sf.n_tiles:
+                vals = sf.qweights if quantized else sf.weights
+                est = int(sf.doc_ids.nbytes + vals.nbytes)
+                if not hbm_ledger.would_fit(est):
+                    hbm_ledger.note_degraded()
+                else:
+                    sc = impact_ops.ImpactScorer(
+                        sf.doc_ids,
+                        vals,
+                        seg.num_docs,
+                        self.reader.live_docs[si],
+                    )
+                    self._charge("impacts", est, False)
+                    from ..search import sparse as sparse_mod
+
+                    # compression headline: the value plane actually
+                    # uploaded vs the same plane at fp32 (doc-id planes
+                    # are identical either way — see ledger_bytes)
+                    sparse_mod.note("impact_bytes", int(vals.nbytes))
+                    sparse_mod.note(
+                        "impact_fp32_equivalent_bytes",
+                        int(sf.weights.nbytes),
+                    )
+            self._impact_scorers[key] = sc
+            return sc
 
     # ---- second-stage rerank column (flat rank_vectors gather arrays) ----
 

@@ -25,8 +25,11 @@
 # streaming-ingest smoke (scripts/ingest_smoke.sh: device-vs-host build
 # parity + zero acked-loss on a crash mid-refresh always; sub-second
 # refresh-lag p95 and query-p99-under-ingest <= 1.5x read-only on
-# >= 8-core hosts). The combined exit code fails if any enabled run
-# fails.
+# >= 8-core hosts). T1_SPARSE=1 additionally runs the learned-sparse
+# smoke (scripts/sparse_smoke.sh: fp32 impact serving float-identical
+# to the dense oracle + int8 recall@10 >= 0.95 + >= 2x value-plane
+# compression always; the >= 3x device-vs-host QPS gate on >= 8-core
+# hosts). The combined exit code fails if any enabled run fails.
 set -o pipefail; rm -f /tmp/_t1.log; timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' --continue-on-collection-errors -p no:cacheprovider -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log; rc=${PIPESTATUS[0]}; echo DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' /tmp/_t1.log | tr -cd . | wc -c)
 if [ "${T1_MESH:-0}" = "1" ]; then
     echo "--- T1_MESH: mesh-marked tests on the forced 8-device host platform ---"
@@ -72,5 +75,11 @@ if [ "${T1_INGEST:-0}" = "1" ]; then
     bash scripts/ingest_smoke.sh
     ingest_rc=$?
     [ "$rc" -eq 0 ] && rc=$ingest_rc
+fi
+if [ "${T1_SPARSE:-0}" = "1" ]; then
+    echo "--- T1_SPARSE: learned-sparse smoke (parity + recall + compression gates) ---"
+    bash scripts/sparse_smoke.sh
+    sparse_rc=$?
+    [ "$rc" -eq 0 ] && rc=$sparse_rc
 fi
 exit $rc

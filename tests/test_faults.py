@@ -501,6 +501,90 @@ class TestBatcherFaults:
             ivf_svc.close()
             exact_svc.close()
 
+    def test_sparse_score_fault_falls_back_to_dense_oracle(self):
+        """The `sparse.score` site fires on the impact-tile dispatch:
+        an injected error must exercise the deterministic impact→dense
+        host-oracle fallback — float-identical to the numpy backend's
+        answer, zero shard failures, `fallbacks` counter bumped
+        (mirrors the `ann.probe` device→exact pattern); a delay is
+        slow, not wrong."""
+        import numpy as np
+
+        from elasticsearch_tpu.search import sparse as sparse_mod
+
+        def build(name, backend):
+            svc = IndexService(
+                name,
+                settings={
+                    "number_of_shards": 2, "search.backend": backend,
+                    "sparse.quantization": "none",
+                },
+                mappings_json={"properties": {
+                    "ml": {"type": "sparse_vector"}}},
+            )
+            rng = np.random.default_rng(7)
+            vocab = [f"tok{i}" for i in range(30)]
+            for i in range(200):
+                toks = rng.choice(
+                    vocab, size=int(rng.integers(2, 7)), replace=False
+                )
+                svc.index_doc(
+                    str(i),
+                    {"ml": {
+                        t: float(np.round(rng.random() * 3 + 0.05, 4))
+                        for t in toks
+                    }},
+                )
+            svc.refresh()
+            return svc
+
+        jx = build("sf-sparse", "jax")
+        nps = build("sf-sparse-np", "numpy")
+        try:
+            body = {
+                "query": {"sparse_vector": {
+                    "field": "ml",
+                    "query_vector": {
+                        "tok0": 1.5, "tok3": 0.7, "tok9": 1.1,
+                    },
+                }},
+                "size": 10,
+            }
+            expected = [
+                (h["_id"], h["_score"])
+                for h in nps.search(dict(body))["hits"]["hits"]
+            ]
+            faults.configure(
+                {"rules": [{"site": "sparse.score", "kind": "error"}]}
+            )
+            before = sparse_mod.stats_snapshot()
+            resp = jx.search(dict(body))
+            after = sparse_mod.stats_snapshot()
+            got = [
+                (h["_id"], h["_score"]) for h in resp["hits"]["hits"]
+            ]
+            assert got == expected
+            assert resp["_shards"]["failed"] == 0
+            assert after["fallbacks"] > before["fallbacks"]
+            # delay kind: slow, not wrong — the impact path still serves
+            faults.configure(
+                {"rules": [{"site": "sparse.score", "kind": "delay",
+                            "delay_ms": 30}]}
+            )
+            before = sparse_mod.stats_snapshot()
+            resp2 = jx.search(dict(body))
+            after = sparse_mod.stats_snapshot()
+            got2 = [
+                (h["_id"], h["_score"]) for h in resp2["hits"]["hits"]
+            ]
+            assert got2 == expected
+            assert resp2["_shards"]["failed"] == 0
+            assert after["searches"] > before["searches"]
+        finally:
+            faults.clear()
+            jx.close()
+            nps.close()
+
     def test_rerank_score_fault_falls_back_to_first_stage(self):
         """The `rerank.score` site fires on the second-stage maxsim
         dispatch: an injected error must exercise the deterministic

@@ -494,6 +494,152 @@ class TestConcurrentRefresh:
 
 
 # ---------------------------------------------------------------------------
+# double-buffered merge: segment-count policy without blocking the writers
+# ---------------------------------------------------------------------------
+
+
+class TestConcurrentMerge:
+    def _seg_engine(self, n_segs=6, docs_per=5):
+        maps = Mappings({"properties": {"body": {"type": "text"}}})
+        eng = ShardEngine(maps, AnalysisRegistry())
+        k = 0
+        for _ in range(n_segs):
+            for _ in range(docs_per):
+                eng.index(f"d{k}", {"body": f"alpha doc{k}"})
+                k += 1
+            eng.refresh()
+        return eng
+
+    def _slow_build(self, monkeypatch, hold, entered, only_first=False):
+        real = segment_build.build_segment
+        calls = {"n": 0}
+
+        def slow(*a, **kw):
+            calls["n"] += 1
+            if not only_first or calls["n"] == 1:
+                entered.set()
+                assert hold.wait(timeout=10)
+            return real(*a, **kw)
+
+        monkeypatch.setattr(
+            "elasticsearch_tpu.index.segment_build.build_segment", slow
+        )
+
+    def test_merge_concurrent_folds_segments(self):
+        eng = self._seg_engine(6)
+        assert len(eng.segments) == 6
+        before = segment_build.INGEST_STATS["concurrent_merges"]
+        assert eng.merge_concurrent(max_segments=4) is True
+        assert len(eng.segments) == 1
+        assert eng.num_docs == 30
+        assert eng.op_stats["merge_total"] == 1
+        assert segment_build.INGEST_STATS["concurrent_merges"] == before + 1
+        # under policy now: a second call is a no-op
+        assert eng.merge_concurrent(max_segments=4) is False
+
+    def test_write_stream_stays_paced_during_merge(self, monkeypatch):
+        """The pacing bound: the merged segment — the biggest build a
+        shard ever does — runs outside the engine lock, so the write
+        stream never stalls behind it."""
+        eng = self._seg_engine(6)
+        hold = threading.Event()
+        entered = threading.Event()
+        self._slow_build(monkeypatch, hold, entered)
+        t = threading.Thread(target=eng.merge_concurrent, args=(4,))
+        t.start()
+        assert entered.wait(timeout=10)
+        worst = 0.0
+        for i in range(50):
+            t0 = time.perf_counter()
+            eng.index(f"w{i}", {"body": "beta stream"})
+            worst = max(worst, time.perf_counter() - t0)
+        # writes paced by the buffer append, not the in-flight merge
+        assert worst < 0.25, worst
+        assert eng.num_docs == 30  # old segment list still serving
+        hold.set()
+        t.join(timeout=10)
+        assert not t.is_alive()
+        assert len(eng.segments) == 1  # merge landed
+        assert eng.refresh() is True  # drains the streamed writes
+        assert eng.num_docs == 80
+
+    def test_superseding_ops_during_merge_never_resurrect(
+        self, monkeypatch
+    ):
+        eng = self._seg_engine(6)
+        hold = threading.Event()
+        entered = threading.Event()
+        self._slow_build(monkeypatch, hold, entered)
+        t = threading.Thread(target=eng.merge_concurrent, args=(4,))
+        t.start()
+        assert entered.wait(timeout=10)
+        eng.index("d0", {"body": "alpha two"})  # overwrite mid-merge
+        eng.delete("d1")  # delete mid-merge
+        hold.set()
+        t.join(timeout=10)
+        # the merged segment installs with d0(v1)/d1 dead on arrival
+        assert len(eng.segments) == 1
+        assert eng.num_docs == 28
+        assert eng.get("d1") is None
+        assert eng.get("d0")["_source"] == {"body": "alpha two"}
+        assert eng.refresh() is True  # drains the superseding write
+        assert eng.num_docs == 29
+
+    def test_refresh_mid_merge_supersedes_the_merge(self, monkeypatch):
+        eng = self._seg_engine(6)
+        hold = threading.Event()
+        entered = threading.Event()
+        self._slow_build(monkeypatch, hold, entered, only_first=True)
+        t = threading.Thread(target=eng.merge_concurrent, args=(4,))
+        t.start()
+        assert entered.wait(timeout=10)
+        eng.index("late", {"body": "gamma"})
+        assert eng.refresh() is True  # blocking refresh bumps the epoch
+        before = segment_build.INGEST_STATS["generations_discarded"]
+        hold.set()
+        t.join(timeout=10)
+        assert segment_build.INGEST_STATS["generations_discarded"] == (
+            before + 1
+        )
+        # the half-merge was discarded: the refreshed list survives and
+        # no doc was duplicated or lost
+        assert len(eng.segments) == 7
+        assert eng.num_docs == 31
+
+    def test_refresh_tick_auto_merges_over_policy(self, bg_refresh_on):
+        svc = IndexService(
+            "nrt-merge",
+            settings={
+                "number_of_shards": 1,
+                "search.backend": "jax",
+                "refresh_interval": "50ms",
+                "merge.policy.max_segments": 3,
+            },
+            mappings_json={"properties": {"body": {"type": "text"}}},
+        )
+        try:
+            eng = svc.local_shard(0)
+            for s in range(5):
+                for d in range(4):
+                    svc.index_doc(f"s{s}d{d}", {"body": "alpha"})
+                eng.refresh()  # blocking: force one segment per batch
+            assert len(eng.segments) >= 4
+            deadline = time.monotonic() + 10
+            while time.monotonic() < deadline:
+                if len(eng.segments) == 1:
+                    break
+                time.sleep(0.02)
+            else:
+                pytest.fail("refresh tick never merged over-policy shard")
+            assert eng.op_stats["merge_total"] >= 1
+            assert eng.num_docs == 20
+            r = svc.search({"query": {"match": {"body": "alpha"}}})
+            assert r["hits"]["total"]["value"] == 20
+        finally:
+            svc.close()
+
+
+# ---------------------------------------------------------------------------
 # background refresher + REST refresh semantics
 # ---------------------------------------------------------------------------
 

@@ -1,0 +1,795 @@
+"""Learned sparse retrieval: impact-ordered quantized postings, the
+`sparse_vector` field + query, and the third hybrid leg.
+
+Contract under test (the sparse-retrieval tentpole):
+  * segment builds are BIT-IDENTICAL host vs device for every
+    SparseField plane (impact-ordered doc/weight tiles, int8 qweights
+    twin, scales, tile_max/tile_qmax sidecars), and the impact-ordering
+    invariants hold (weight desc within a term, non-increasing tile
+    bounds, term maxima in first tiles);
+  * the fp32 serving path is FLOAT-IDENTICAL to the NumpyExecutor's
+    dense term-at-a-time oracle — with or without block-max pruning —
+    and the int8 column holds recall@10 ≥ 0.95 against it;
+  * block-max pruning is exact: dropped tiles never change the
+    returned hits, only the totals relation (→ "gte");
+  * every device-path failure (injected `sparse.score` fault, HBM
+    budget breach) deterministically falls back to the dense host
+    oracle — same answer, counters bumped;
+  * the mesh SPMD path is bit-identical to the per-shard path in both
+    storage modes;
+  * `sparse_vector` fuses as a third `rrf` retriever leg beside BM25
+    and kNN, with its own leg timing in rrf_stats;
+  * malformed `sparse_vector` queries are request-scoped 400s, and
+    `_nodes/stats` carries the `sparse` block with the ≥2x int8
+    compression headline.
+"""
+
+import os
+import time
+
+import numpy as np
+import pytest
+
+from elasticsearch_tpu.analysis import AnalysisRegistry
+from elasticsearch_tpu.cluster.indices import IndexService
+from elasticsearch_tpu.common.faults import faults
+from elasticsearch_tpu.index import segment_build
+from elasticsearch_tpu.index.mapping import DocumentParser, Mappings
+from elasticsearch_tpu.index.segment import SegmentBuilder
+from elasticsearch_tpu.ops import impact as impact_ops
+from elasticsearch_tpu.search import sparse as sparse_mod
+from elasticsearch_tpu.search.dsl import QueryParseError
+
+VOCAB = [f"tok{i:02d}" for i in range(40)]
+DIMS = 4
+
+SPARSE_MAPPINGS = {
+    "properties": {
+        "ml": {"type": "sparse_vector"},
+        "body": {"type": "text"},
+        "vec": {"type": "dense_vector", "dims": DIMS,
+                "similarity": "cosine"},
+    }
+}
+
+
+def sparse_docs(n=300, vocab=VOCAB, seed=3, lo=2, hi=9):
+    rng = np.random.default_rng(seed)
+    out = []
+    for i in range(n):
+        nt = int(rng.integers(lo, min(hi, len(vocab))))
+        toks = [str(t) for t in rng.choice(vocab, size=nt, replace=False)]
+        vec = {t: float(np.round(rng.random() * 3 + 0.05, 4)) for t in toks}
+        out.append(
+            (
+                str(i),
+                {
+                    "ml": vec,
+                    "body": " ".join(toks),
+                    "vec": [
+                        float(x) for x in rng.normal(size=DIMS)
+                    ],
+                },
+            )
+        )
+    return out
+
+
+def make_service(name, backend="jax", quant="int8", shards=1, docs=None,
+                 **extra):
+    svc = IndexService(
+        name,
+        settings={
+            "number_of_shards": shards,
+            "search.backend": backend,
+            "sparse.quantization": quant,
+            **extra,
+        },
+        mappings_json=SPARSE_MAPPINGS,
+    )
+    for i, s in (docs if docs is not None else sparse_docs()):
+        svc.index_doc(i, s)
+    svc.refresh()
+    return svc
+
+
+def qbody(seed, size=10, exact=False):
+    rng = np.random.default_rng(seed)
+    nt = int(rng.integers(2, 6))
+    toks = [str(t) for t in rng.choice(VOCAB, size=nt, replace=False)]
+    qv = {t: float(np.round(rng.random() * 2 + 0.1, 4)) for t in toks}
+    b = {
+        "query": {"sparse_vector": {"field": "ml", "query_vector": qv}},
+        "size": size,
+    }
+    if exact:
+        b["exact"] = True
+    return b
+
+
+def hits_of(resp):
+    return [(h["_id"], h["_score"]) for h in resp["hits"]["hits"]]
+
+
+def _arrays_equal(name, a, b):
+    a = np.asarray(a)
+    b = np.asarray(b)
+    assert a.dtype == b.dtype, (name, a.dtype, b.dtype)
+    assert a.shape == b.shape, (name, a.shape, b.shape)
+    assert np.array_equal(a, b), name
+
+
+# ---------------------------------------------------------------------------
+# build: host == device, bit for bit; impact-ordering invariants
+# ---------------------------------------------------------------------------
+
+
+class TestSparseBuildParity:
+    def _parsed(self, n=137, seed=5):
+        maps = Mappings(SPARSE_MAPPINGS)
+        parser = DocumentParser(maps, AnalysisRegistry())
+        return maps, [
+            parser.parse(i, s) for i, s in sparse_docs(n, seed=seed)
+        ]
+
+    def test_device_build_bit_identical(self, monkeypatch):
+        monkeypatch.setenv("ES_TPU_DEVICE_BUILD", "force")
+        maps, docs = self._parsed()
+        b = SegmentBuilder(maps)
+        for d in docs:
+            b.add(d)
+        host = b.build()
+        dev = segment_build.build_segment(maps, docs)
+        assert sorted(host.sparse) == sorted(dev.sparse) == ["ml"]
+        hs, ds = host.sparse["ml"], dev.sparse["ml"]
+        assert hs.terms == ds.terms
+        assert hs.pruned == ds.pruned
+        for attr in (
+            "term_df", "term_tile_start", "term_tile_count", "doc_ids",
+            "weights", "qweights", "scales", "tile_max", "tile_qmax",
+            "exists",
+        ):
+            _arrays_equal(attr, getattr(hs, attr), getattr(ds, attr))
+
+    def test_impact_ordering_invariants(self):
+        maps, docs = self._parsed(200, seed=9)
+        b = SegmentBuilder(maps)
+        for d in docs:
+            b.add(d)
+        sf = b.build().sparse["ml"]
+        for tid in range(len(sf.terms)):
+            pdocs, pw = sf.term_postings(tid)
+            # impact ordering: weight DESC, doc asc tie-break
+            assert all(
+                (pw[i], -pdocs[i]) >= (pw[i + 1], -pdocs[i + 1])
+                for i in range(len(pw) - 1)
+            ), sf.terms[tid]
+            start = int(sf.term_tile_start[tid])
+            count = int(sf.term_tile_count[tid])
+            tmax = sf.tile_max[start : start + count]
+            # tile bounds non-increasing within a term; the term's
+            # global max lives in its FIRST tile
+            assert np.all(tmax[:-1] >= tmax[1:]), sf.terms[tid]
+            if len(pw):
+                assert np.float32(tmax[0]) == np.float32(pw.max())
+            # int8 soundness: tile_qmax bounds the DEQUANTIZED values
+            scale = np.float32(sf.scales[tid])
+            for t in range(count):
+                row_q = sf.qweights[start + t].astype(np.float32) * scale
+                valid = sf.doc_ids[start + t] >= 0
+                if valid.any():
+                    assert np.float32(sf.tile_qmax[start + t]) >= np.float32(
+                        row_q[valid].max()
+                    )
+
+
+# ---------------------------------------------------------------------------
+# kernel: ImpactScorer vs the dense numpy oracle, across k/row buckets
+# ---------------------------------------------------------------------------
+
+
+class TestImpactKernel:
+    def _field(self, n=300, seed=3):
+        maps = Mappings({"properties": {"ml": {"type": "sparse_vector"}}})
+        parser = DocumentParser(maps, AnalysisRegistry())
+        b = SegmentBuilder(maps)
+        docs = sparse_docs(n, seed=seed)
+        for i, s in docs:
+            b.add(parser.parse(i, {"ml": s["ml"]}))
+        return b.build(), docs
+
+    def _oracle(self, sf, n_docs, tids, tws):
+        """Term-at-a-time fp32 accumulation in term order — the exact
+        float-op order the serving kernel must reproduce."""
+        acc = np.zeros(n_docs, np.float32)
+        for tid, tw in zip(tids, tws):
+            start = int(sf.term_tile_start[tid])
+            count = int(sf.term_tile_count[tid])
+            d = sf.doc_ids[start : start + count].ravel()
+            v = sf.values_plane[start : start + count].ravel()
+            m = d >= 0
+            np.add.at(acc, d[m], np.float32(tw) * v[m].astype(np.float32))
+        return acc
+
+    @pytest.mark.parametrize("quantized", [False, True])
+    @pytest.mark.parametrize("k", [5, 16, 40])
+    def test_scorer_matches_oracle(self, quantized, k):
+        seg, _docs = self._field()
+        sf = seg.sparse["ml"]
+        sf.values_plane = sf.qweights if quantized else sf.weights
+        sc = impact_ops.ImpactScorer(
+            sf.doc_ids, sf.values_plane, seg.num_docs
+        )
+        rng = np.random.default_rng(17)
+        queries = []
+        for _ in range(6):
+            toks = [
+                str(t) for t in rng.choice(VOCAB, size=4, replace=False)
+            ]
+            ws = [float(np.round(rng.random() * 2 + 0.1, 4)) for _ in toks]
+            queries.append((toks, ws))
+        tile_lists, weight_lists, oracles = [], [], []
+        for toks, ws in queries:
+            tids, tws, _bws, starts, counts = impact_ops.impact_tile_lists(
+                sf, toks, ws, quantized
+            )
+            tiles = np.concatenate(
+                [
+                    np.arange(s, s + c, dtype=np.int64)
+                    for s, c in zip(starts, counts)
+                ]
+            ) if len(tids) else np.zeros(0, np.int64)
+            tws_full = np.concatenate(
+                [
+                    np.full(int(c), tw, np.float32)
+                    for tw, c in zip(tws, counts)
+                ]
+            ) if len(tids) else np.zeros(0, np.float32)
+            tile_lists.append(tiles)
+            weight_lists.append(tws_full)
+            oracles.append(self._oracle(sf, seg.num_docs, tids, tws))
+        acc, cnt = sc.new_acc()
+        acc, cnt = sc.score_into(acc, cnt, tile_lists, weight_lists)
+        scores, docs, totals = sc.finalize(acc, cnt, k)
+        for ji, oracle in enumerate(oracles):
+            matched = np.flatnonzero(oracle != 0.0)
+            order = sorted(matched, key=lambda d: (-oracle[d], d))
+            want = order[: min(k, seg.num_docs)]
+            finite = np.isfinite(scores[ji])
+            got_docs = docs[ji][finite]
+            got_scores = scores[ji][finite]
+            assert list(got_docs) == [int(d) for d in want], ji
+            # float-identical accumulation, both storage modes
+            assert np.array_equal(
+                got_scores, oracle[got_docs].astype(np.float32)
+            ), ji
+            assert int(totals[ji]) == len(matched)
+
+
+# ---------------------------------------------------------------------------
+# serving: fp32 float parity, int8 recall gate, exact escape hatch
+# ---------------------------------------------------------------------------
+
+
+class TestServingParity:
+    def test_fp32_serving_float_identical_to_oracle(self):
+        jx = make_service("sp-fp32", quant="none")
+        nps = make_service("sp-fp32-np", backend="numpy", quant="none")
+        try:
+            for s in range(12):
+                for size in (5, 16, 40):
+                    b = qbody(s, size=size)
+                    assert hits_of(jx.search(dict(b))) == hits_of(
+                        nps.search(dict(b))
+                    ), (s, size)
+        finally:
+            jx.close()
+            nps.close()
+
+    def test_exact_escape_hatch_on_quantized_index(self):
+        jx = make_service("sp-exact", quant="int8")
+        nps = make_service("sp-exact-np", backend="numpy")
+        try:
+            before = sparse_mod.SPARSE_STATS["exact_searches"]
+            for s in range(8):
+                b = qbody(s, exact=True)
+                assert hits_of(jx.search(dict(b))) == hits_of(
+                    nps.search(dict(b))
+                ), s
+            assert (
+                sparse_mod.SPARSE_STATS["exact_searches"] >= before + 8
+            )
+        finally:
+            jx.close()
+            nps.close()
+
+    def test_quantized_recall_at_10(self):
+        jx = make_service("sp-rec", quant="int8")
+        nps = make_service("sp-rec-np", backend="numpy")
+        try:
+            rec = []
+            for s in range(40):
+                b = qbody(s, size=10)
+                got = {h["_id"] for h in jx.search(dict(b))["hits"]["hits"]}
+                want = [
+                    h["_id"] for h in nps.search(dict(b))["hits"]["hits"]
+                ]
+                if want:
+                    rec.append(len(got & set(want)) / len(want))
+            assert np.mean(rec) >= 0.95, np.mean(rec)
+        finally:
+            jx.close()
+            nps.close()
+
+    def test_boost_and_negative_weights(self):
+        jx = make_service("sp-boost", quant="none")
+        nps = make_service("sp-boost-np", backend="numpy", quant="none")
+        try:
+            qv = {"tok00": 1.5, "tok03": -0.7, "tok09": 1.1}
+            b = {
+                "query": {
+                    "sparse_vector": {
+                        "field": "ml", "query_vector": qv, "boost": 2.5,
+                    }
+                },
+                "size": 10,
+            }
+            assert hits_of(jx.search(dict(b))) == hits_of(
+                nps.search(dict(b))
+            )
+        finally:
+            jx.close()
+            nps.close()
+
+
+# ---------------------------------------------------------------------------
+# block-max pruning: exact hits, "gte" totals, monotone vs deep k
+# ---------------------------------------------------------------------------
+
+
+class TestPruning:
+    """A term-heavy corpus (few tokens, many docs) so every term spans
+    several 128-posting tiles and phase-A thetas actually drop tails."""
+
+    def _docs(self, n=600):
+        return sparse_docs(n, vocab=VOCAB[:6], seed=21, lo=2, hi=5)
+
+    def test_pruning_is_exact_and_flags_gte(self):
+        docs = self._docs()
+        jx = make_service("sp-prune", quant="none", docs=docs)
+        nps = make_service(
+            "sp-prune-np", backend="numpy", quant="none", docs=docs
+        )
+        try:
+            before = dict(sparse_mod.SPARSE_STATS)
+            b = {
+                "query": {
+                    "sparse_vector": {
+                        "field": "ml",
+                        "query_vector": {"tok00": 2.0, "tok01": 1.0},
+                    }
+                },
+                "size": 5,
+            }
+            rj = jx.search(dict(b))
+            rn = nps.search(dict(b))
+            assert hits_of(rj) == hits_of(rn)
+            after = dict(sparse_mod.SPARSE_STATS)
+            assert after["tiles_pruned"] > before["tiles_pruned"]
+            assert after["pruned_searches"] > before["pruned_searches"]
+            # dropped docs provably score below the kth best, but they
+            # are no longer counted: totals become a lower bound
+            assert rj["hits"]["total"]["relation"] == "gte"
+            assert (
+                rj["hits"]["total"]["value"]
+                <= rn["hits"]["total"]["value"]
+            )
+        finally:
+            jx.close()
+            nps.close()
+
+    def test_int8_pruning_exact_wrt_quantized_scores(self):
+        """Regression: the tile_qmax sidecar is already DEQUANTIZED, so
+        the block-max bound must use the RAW query weight — bounding
+        with the scale-folded kernel weight scales twice, prunes tiles
+        that still hold competitive mass, and silently craters recall.
+        int8 pruned serving must return exactly the pure-quantized
+        (unpruned) ranking."""
+        docs = self._docs()
+        jx = make_service("sp-prune-q", quant="int8", docs=docs)
+        try:
+            eng = jx.local_shard(0)
+            sf = eng.segments[0].sparse["ml"]
+            qv = {"tok00": 2.0, "tok01": 1.0}
+            # host oracle over the DEQUANTIZED column, term order
+            acc = np.zeros(eng.segments[0].num_docs, np.float32)
+            for t, w in sorted(qv.items()):
+                tid = sf.term_id(t)
+                d, _wv = sf.term_postings(tid)
+                start = int(sf.term_tile_start[tid])
+                count = int(sf.term_tile_count[tid])
+                df = int(sf.term_df[tid])
+                q = sf.qweights[start : start + count].ravel()[:df]
+                tw = np.float32(np.float32(w) * sf.scales[tid])
+                np.add.at(acc, d, tw * q.astype(np.float32))
+            matched = np.flatnonzero(acc != 0.0)
+            want = sorted(matched, key=lambda i: (-acc[i], i))[:5]
+            before = sparse_mod.SPARSE_STATS["tiles_pruned"]
+            r = jx.search(
+                {
+                    "query": {"sparse_vector": {
+                        "field": "ml", "query_vector": qv}},
+                    "size": 5,
+                }
+            )
+            assert (
+                sparse_mod.SPARSE_STATS["tiles_pruned"] > before
+            )  # the pruning path actually engaged
+            got = [
+                (h["_id"], h["_score"]) for h in r["hits"]["hits"]
+            ]
+            assert got == [
+                (eng.segments[0].doc_ids[i], float(acc[i])) for i in want
+            ]
+        finally:
+            jx.close()
+
+    def test_pruned_topk_equals_deep_unpruned_prefix(self):
+        jx = make_service("sp-mono", quant="none", docs=self._docs())
+        try:
+            b5 = {
+                "query": {
+                    "sparse_vector": {
+                        "field": "ml",
+                        "query_vector": {"tok02": 1.4, "tok04": 0.9},
+                    }
+                },
+                "size": 5,
+            }
+            deep = dict(b5)
+            deep["size"] = 400  # k ≥ df: theta can't drop anything
+            shallow_hits = hits_of(jx.search(b5))
+            deep_hits = hits_of(jx.search(deep))
+            assert shallow_hits == deep_hits[:5]
+        finally:
+            jx.close()
+
+
+# ---------------------------------------------------------------------------
+# degraded paths: HBM budget breach, injected fault (see test_faults too)
+# ---------------------------------------------------------------------------
+
+
+class TestDegradedPaths:
+    def test_hbm_budget_breach_degrades_to_host_oracle(self):
+        from elasticsearch_tpu.common.memory import hbm_ledger
+
+        jx = make_service("sp-hbm", quant="none")
+        nps = make_service("sp-hbm-np", backend="numpy", quant="none")
+        try:
+            b = qbody(1)
+            expected = hits_of(nps.search(dict(b)))
+            old_budget = hbm_ledger.budget
+            hbm_ledger.budget = hbm_ledger.used  # zero headroom
+            f_before = sparse_mod.SPARSE_STATS["fallbacks"]
+            d_before = hbm_ledger.stats()["degraded_allocations"]
+            try:
+                got = hits_of(jx.search(dict(b)))
+            finally:
+                hbm_ledger.budget = old_budget
+            assert got == expected
+            assert sparse_mod.SPARSE_STATS["fallbacks"] > f_before
+            assert (
+                hbm_ledger.stats()["degraded_allocations"] > d_before
+            )
+        finally:
+            jx.close()
+            nps.close()
+
+    def test_sparse_score_fault_is_exact(self):
+        jx = make_service("sp-flt", quant="none")
+        nps = make_service("sp-flt-np", backend="numpy", quant="none")
+        try:
+            b = qbody(2)
+            expected = hits_of(nps.search(dict(b)))
+            faults.configure(
+                {"rules": [{"site": "sparse.score", "kind": "error"}]}
+            )
+            before = sparse_mod.SPARSE_STATS["fallbacks"]
+            assert hits_of(jx.search(dict(b))) == expected
+            assert sparse_mod.SPARSE_STATS["fallbacks"] > before
+        finally:
+            faults.clear()
+            jx.close()
+            nps.close()
+
+
+# ---------------------------------------------------------------------------
+# mesh SPMD serving: bit-identical to the per-shard path, both modes
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.mesh
+class TestMeshSparse:
+    @pytest.mark.parametrize("quant", ["int8", "none"])
+    def test_mesh_vs_shard_parity(self, monkeypatch, quant):
+        import jax
+
+        if len(jax.devices()) < 2:
+            pytest.skip("needs >= 2 devices")
+        svc = make_service(
+            f"spm-{quant}", quant=quant, shards=4,
+            docs=sparse_docs(240, vocab=VOCAB[:30], seed=11, lo=2, hi=8),
+        )
+        try:
+            mex = svc.mesh_executor()
+            rng = np.random.default_rng(5)
+            for s in range(4):
+                toks = [
+                    str(t)
+                    for t in rng.choice(
+                        VOCAB[:30], size=int(rng.integers(2, 6)),
+                        replace=False,
+                    )
+                ]
+                body = {
+                    "query": {
+                        "sparse_vector": {
+                            "field": "ml",
+                            "query_vector": {
+                                t: float(
+                                    np.round(rng.random() * 2 + 0.1, 4)
+                                )
+                                for t in toks
+                            },
+                        }
+                    },
+                    "size": 10,
+                }
+                monkeypatch.setenv("ES_TPU_MESH", "force")
+                routed0 = mex.stats["routed"]
+                rm = svc.search(dict(body))
+                assert mex.stats["routed"] == routed0 + 1, (quant, s)
+                monkeypatch.setenv("ES_TPU_MESH", "off")
+                rs = svc.search(dict(body))
+                assert hits_of(rm) == hits_of(rs), (quant, s)
+                assert rm["hits"]["total"] == rs["hits"]["total"]
+        finally:
+            svc.close()
+
+
+# ---------------------------------------------------------------------------
+# the third hybrid leg: rrf over bm25 + knn + sparse
+# ---------------------------------------------------------------------------
+
+
+class TestHybridThirdLeg:
+    def _body(self, qv):
+        return {
+            "retriever": {
+                "rrf": {
+                    "retrievers": [
+                        {"standard": {
+                            "query": {"match": {"body": "tok00 tok01"}}}},
+                        {"knn": {
+                            "field": "vec",
+                            "query_vector": [0.4, -0.1, 0.7, 0.2],
+                            "k": 20, "num_candidates": 40,
+                        }},
+                        {"standard": {"query": {"sparse_vector": {
+                            "field": "ml", "query_vector": qv}}}},
+                    ],
+                    "rank_constant": 60,
+                    "rank_window_size": 50,
+                }
+            },
+            "size": 10,
+        }
+
+    def test_three_leg_rrf_parity_and_leg_stats(self):
+        jx = make_service("rrf3", quant="none")
+        nps = make_service("rrf3-np", backend="numpy", quant="none")
+        try:
+            qv = {t: 1.0 for t in ("tok00", "tok02", "tok05", "tok07")}
+            body = self._body(qv)
+            rj = jx.search(dict(body))
+            rn = nps.search(dict(body))
+            assert rj["hits"]["hits"]
+            # every leg is float-exact on both backends, so the fused
+            # rank ORDER is identical end to end (the fused rrf score
+            # itself is f32 on device vs f64 on host — compare ranks)
+            assert [h["_id"] for h in rj["hits"]["hits"]] == [
+                h["_id"] for h in rn["hits"]["hits"]
+            ]
+            for hj, hn in zip(rj["hits"]["hits"], rn["hits"]["hits"]):
+                assert hj["_score"] == pytest.approx(
+                    hn["_score"], rel=1e-5
+                )
+            # the sparse leg gets its own timing bucket
+            assert jx.rrf_leg_samples["sparse"]
+            assert jx.rrf_stats["sparse_leg_ms"] >= 0.0
+        finally:
+            jx.close()
+            nps.close()
+
+    def test_sparse_leg_contributes_to_fusion(self):
+        jx = make_service("rrf3-c", quant="none")
+        try:
+            qv = {"tok09": 3.0, "tok11": 2.5}
+            with_sparse = self._body(qv)
+            without = self._body(qv)
+            without["retriever"]["rrf"]["retrievers"] = without[
+                "retriever"
+            ]["rrf"]["retrievers"][:2]
+            ids_with = [
+                h["_id"]
+                for h in jx.search(with_sparse)["hits"]["hits"]
+            ]
+            ids_without = [
+                h["_id"] for h in jx.search(without)["hits"]["hits"]
+            ]
+            assert ids_with != ids_without
+        finally:
+            jx.close()
+
+
+# ---------------------------------------------------------------------------
+# DSL validation: request-scoped 400s
+# ---------------------------------------------------------------------------
+
+
+class TestSparseDsl400s:
+    BAD_BODIES = [
+        {"query": {"sparse_vector": {"query_vector": {"a": 1.0}}}},
+        {"query": {"sparse_vector": {"field": "ml"}}},
+        {"query": {"sparse_vector": {
+            "field": "ml", "query_vector": {}}}},
+        {"query": {"sparse_vector": {
+            "field": "ml", "query_vector": {"a": "x"}}}},
+        {"query": {"sparse_vector": {
+            "field": "ml", "query_vector": {"a": float("nan")}}}},
+        {"query": {"sparse_vector": {
+            "field": "body", "query_vector": {"a": 1.0}}}},
+        {"query": {"sparse_vector": {
+            "field": "missing", "query_vector": {"a": 1.0}}}},
+    ]
+
+    def test_malformed_queries_raise_parse_errors(self):
+        svc = make_service("sp-400", docs=sparse_docs(20))
+        try:
+            for bad in self.BAD_BODIES:
+                with pytest.raises(QueryParseError):
+                    svc.search(dict(bad))
+            # the same validation guards retriever-nested legs
+            with pytest.raises(QueryParseError):
+                svc.search(
+                    {
+                        "retriever": {
+                            "rrf": {
+                                "retrievers": [
+                                    {"standard": {"query": {
+                                        "sparse_vector": {
+                                            "field": "body",
+                                            "query_vector": {"a": 1.0},
+                                        }}}},
+                                    {"standard": {"query": {
+                                        "match": {"body": "tok00"}}}},
+                                ]
+                            }
+                        }
+                    }
+                )
+        finally:
+            svc.close()
+
+
+# ---------------------------------------------------------------------------
+# observability: the `sparse` block of _nodes/stats over REST
+# ---------------------------------------------------------------------------
+
+
+class TestNodesStatsSparse:
+    @pytest.fixture
+    def es(self):
+        import json as _json
+        import urllib.error
+        import urllib.request
+
+        from elasticsearch_tpu.rest.server import ElasticsearchTpuServer
+
+        srv = ElasticsearchTpuServer(port=0)
+        srv.start_background()
+        base = f"http://127.0.0.1:{srv.port}"
+
+        def call(method, path, body=None):
+            data = None
+            headers = {}
+            if body is not None:
+                data = _json.dumps(body).encode()
+                headers["Content-Type"] = "application/json"
+            req = urllib.request.Request(
+                base + path, data=data, method=method, headers=headers
+            )
+            try:
+                with urllib.request.urlopen(req) as resp:
+                    return resp.status, _json.loads(resp.read() or b"null")
+            except urllib.error.HTTPError as e:
+                return e.code, _json.loads(e.read() or b"null")
+
+        try:
+            yield call
+        finally:
+            srv.close()
+
+    def test_sparse_block_and_compression_gate(self, es):
+        sparse_mod.reset_stats()
+        status, _ = es(
+            "PUT", "/ml-idx",
+            {
+                "settings": {"index": {"search.backend": "jax"}},
+                "mappings": {"properties": {
+                    "ml": {"type": "sparse_vector"}}},
+            },
+        )
+        assert status == 200
+        rng = np.random.default_rng(13)
+        for i in range(80):
+            toks = [
+                str(t) for t in rng.choice(VOCAB, size=4, replace=False)
+            ]
+            es(
+                "PUT", f"/ml-idx/_doc/{i}",
+                {"ml": {
+                    t: float(np.round(rng.random() * 2 + 0.1, 4))
+                    for t in toks
+                }},
+            )
+        es("POST", "/ml-idx/_refresh")
+        status, r = es(
+            "POST", "/ml-idx/_search",
+            {
+                "query": {"sparse_vector": {
+                    "field": "ml",
+                    "query_vector": {"tok00": 1.0, "tok01": 0.5},
+                }},
+                "size": 10,
+            },
+        )
+        assert status == 200 and r["hits"]["hits"]
+        status, stats = es("GET", "/_nodes/stats")
+        assert status == 200
+        blk = stats["nodes"]["node-0"]["sparse"]
+        for key in (
+            "searches", "quantized_searches", "exact_searches",
+            "fallbacks", "tiles_scored", "tiles_pruned",
+            "pruned_searches", "impact_bytes",
+            "impact_fp32_equivalent_bytes", "ledger_bytes",
+            "batched_jobs",
+        ):
+            assert key in blk, key
+        assert blk["searches"] >= 1
+        assert blk["quantized_searches"] >= 1  # int8 is the default
+        assert blk["ledger_bytes"] > 0
+        # the headline: int8 impact postings at least 2x smaller than
+        # the fp32-equivalent column
+        assert blk["impact_bytes"] > 0
+        assert (
+            blk["impact_fp32_equivalent_bytes"]
+            >= 2 * blk["impact_bytes"]
+        )
+
+    def test_invalid_sparse_query_is_http_400(self, es):
+        es(
+            "PUT", "/ml-400",
+            {"mappings": {"properties": {
+                "ml": {"type": "sparse_vector"},
+                "body": {"type": "text"},
+            }}},
+        )
+        status, body = es(
+            "POST", "/ml-400/_search",
+            {"query": {"sparse_vector": {
+                "field": "body", "query_vector": {"a": 1.0}}}},
+        )
+        assert status == 400
+        assert "sparse_vector" in str(body["error"])
