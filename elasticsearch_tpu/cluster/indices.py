@@ -24,6 +24,7 @@ deployment shapes share this class:
 
 from __future__ import annotations
 
+import contextvars
 import os
 import threading
 import time
@@ -32,6 +33,8 @@ from typing import Any, Dict, List, Optional, Tuple
 
 from ..analysis import AnalysisRegistry
 from ..common.faults import faults
+from ..common.slowlog import FETCH_ACC, SearchSlowLog
+from ..common.tracing import OPAQUE_ID_CTX, TRACE_CTX
 from ..index.engine import OpResult, ShardEngine, VersionConflictError
 from ..index.mapping import Mappings
 from ..search import dsl
@@ -424,6 +427,10 @@ class IndexService:
             "query_time_in_millis": 0,
             "fetch_total": 0,
         }
+        # per-index search slow log (common/slowlog.py), thresholds
+        # from the dynamic search.slowlog.threshold.* index settings
+        self._slowlog = SearchSlowLog(self.name)
+        self._slowlog.configure(self.settings)
         # hybrid (RRF) execution breakdown: cumulative per-leg wall
         # times measured from leg fan-out start, so overlapped legs sum
         # to MORE than the request wall time — bench.py reports the
@@ -866,6 +873,11 @@ class IndexService:
         with self._refresh_cond:
             self._refresh_cond.notify_all()
 
+    def apply_slowlog_settings(self) -> None:
+        """Pushes dynamic `index.search.slowlog.threshold.*` updates
+        into the per-index slow log."""
+        self._slowlog.configure(self.settings)
+
     def _refresh_loop(self) -> None:
         while True:
             with self._refresh_cond:
@@ -1305,7 +1317,10 @@ class IndexService:
         if profile:
             from ..search.executor import PROFILE_CTX
 
-            prof_phases = {}
+            # one dict serves both sinks: unbatched executors write the
+            # PROFILE_CTX keys (device_scoring_ns/...), batched jobs
+            # carry it as j.prof and the dispatcher fills "families"
+            prof_phases = {"families": {}}
             prof_token = PROFILE_CTX.set(prof_phases)
         # ---- batched fast path: flat match plans on the jax backend go
         # through the cross-request micro-batching dispatcher (shared
@@ -1316,7 +1331,6 @@ class IndexService:
             and sort_specs is None
             and search_after is None
             and min_score is None
-            and not profile
             and pinned_executor is None
             and dfs_stats is None
             and str(self.settings.get("search.backend")) == "jax"
@@ -1360,7 +1374,7 @@ class IndexService:
                     try:
                         job = self._batcher.submit_nowait(
                             ex, plan, k, kind=kind, query=query,
-                            deadline=shard_deadline,
+                            deadline=shard_deadline, prof=prof_phases,
                         )
                         # the batcher future honors the shard's timeout
                         # budget: an expired wait abandons the job (the
@@ -1393,7 +1407,6 @@ class IndexService:
                 and search_after is None
                 and knn is None
                 and min_score is None
-                and not profile
                 and pinned_executor is None
                 and dfs_stats is None
                 and not isinstance(ex, NumpyExecutor)
@@ -1420,7 +1433,7 @@ class IndexService:
                     try:
                         job = self._batcher.submit_nowait(
                             ex, dplan, k, kind="agg",
-                            deadline=shard_deadline,
+                            deadline=shard_deadline, prof=prof_phases,
                         )
                         got = self._wait_batched(
                             job, sid, shard_deadline, task
@@ -1515,13 +1528,21 @@ class IndexService:
 
             rescore_spec = rescorer.parse_rescore(body, validate_size=False)
             if rescore_spec is not None:
+                t_resc = time.perf_counter_ns()
                 td = self._apply_rescore(
-                    ex, rescore_spec, td, sid, shard_deadline, task
+                    ex, rescore_spec, td, sid, shard_deadline, task,
+                    prof=prof_phases,
                 )
+                if prof_phases is not None:
+                    prof_phases["rescore_ns"] = (
+                        prof_phases.get("rescore_ns", 0)
+                        + time.perf_counter_ns() - t_resc
+                    )
 
         # ---- folded fetch phase: sources + highlight for this shard's
         # candidates (FetchPhase, SURVEY.md §3.3) ----
         _check_shard_deadline()
+        t_fetch = time.perf_counter_ns()
         highlight_specs = None
         highlight_terms = None
         if "highlight" in body:
@@ -1647,6 +1668,16 @@ class IndexService:
                         raise dsl.QueryParseError(str(e))
                     flds[fname] = v if isinstance(v, list) else [v]
             hits.append(entry)
+        fetch_ns = time.perf_counter_ns() - t_fetch
+        acc = FETCH_ACC.get()
+        if acc is not None:
+            # always-on fetch-phase accumulator: the coordinator's
+            # slowlog fetch threshold reads the request total
+            acc["fetch_ns"] += fetch_ns
+        if prof_phases is not None:
+            prof_phases["fetch_ns"] = (
+                prof_phases.get("fetch_ns", 0) + fetch_ns
+            )
         out = {
             "total": int(td.total),
             "relation": td.relation,
@@ -1657,6 +1688,13 @@ class IndexService:
             out["aggs"] = agg_partial
         if "suggest" in body:
             out["suggest"] = self._shard_suggest(ex, body["suggest"])
+        tr = TRACE_CTX.get()
+        if tr is not None:
+            tr.add_span(
+                "shard_search", ts, time.perf_counter_ns(),
+                index=self.name, shard=sid,
+                backend=str(self.settings.get("search.backend")),
+            )
         if profile:
             # per-shard query-phase breakdown ("profile": true —
             # Profilers/QueryProfiler response shape). The breakdown
@@ -1707,6 +1745,18 @@ class IndexService:
                     }
                 ],
                 "aggregations": [],
+                # batcher-family breakdown: one entry per plan family
+                # this request dispatched through (match/serve/knn/
+                # sparse/agg/rerank and the mesh_* variants) — launch
+                # count, kernel dispatch/collect wall time, queue wait,
+                # roofline flops, pad bucket, batch width, express-lane
+                # and pruning hits
+                "families": dict(phases.get("families", {})),
+                "phases": {
+                    "rescore_ns": int(phases.get("rescore_ns", 0)),
+                    "fetch_ns": int(phases.get("fetch_ns", 0)),
+                },
+                "pruned_jobs": int(phases.get("pruned_jobs", 0)),
             }
         if rc_key is not None:
             from ..search.query_cache import request_cache
@@ -2073,7 +2123,16 @@ class IndexService:
         if n == 1 and deadline is None and task is None:
             outcomes = [run(0)]
         else:
-            futs = [_FANOUT_POOL.submit(run, sid) for sid in range(n)]
+            # copy the caller's context per shard so contextvars (the
+            # request's Trace, the FETCH_ACC accumulator, X-Opaque-Id)
+            # reach the fan-out worker threads — the vars hold shared
+            # mutable objects, so writes made in the workers are visible
+            # to the coordinator
+            cctx = contextvars.copy_context()
+            futs = [
+                _FANOUT_POOL.submit(cctx.copy().run, run, sid)
+                for sid in range(n)
+            ]
             outcomes = []
             for sid, f in enumerate(futs):
                 outcomes.append(
@@ -2162,7 +2221,7 @@ class IndexService:
         {
             "query", "knn", "size", "from", "_source",
             "track_total_hits", "allow_partial_search_results",
-            "allow_degraded", "rescore", "exact",
+            "allow_degraded", "rescore", "exact", "profile",
         }
     )
 
@@ -2285,9 +2344,11 @@ class IndexService:
         from ..tasks import TaskCancelledException
 
         t0 = time.perf_counter()
+        tns0 = time.perf_counter_ns()
+        mesh_prof = {"families": {}} if body.get("profile") else None
         try:
             job = self._batcher.submit_nowait(
-                mesh, plan, from_ + size, kind=kind
+                mesh, plan, from_ + size, kind=kind, prof=mesh_prof,
             )
             td = QueryBatcher.wait(job)
         except MeshUnavailable as e:
@@ -2333,21 +2394,40 @@ class IndexService:
         self.search_stats["query_time_in_millis"] += took
         self.search_stats["fetch_total"] += 1
         mesh.note_routed()
+        tr = TRACE_CTX.get()
+        if tr is not None:
+            tr.add_span(
+                "mesh_search", tns0, time.perf_counter_ns(),
+                index=self.name, shards=self.num_shards, took_ms=took,
+            )
         n = self.num_shards
-        return {
+        resp = {
             "took": took,
             "timed_out": False,
             "_shards": {"total": n, "successful": n, "skipped": 0,
                         "failed": 0},
             "hits": hits_obj,
         }
+        if mesh_prof is not None:
+            resp["profile"] = {
+                "coordinator": {
+                    "phases": {"mesh_ns": int(
+                        (time.perf_counter() - t0) * 1e9
+                    )},
+                    "took_ns": int((time.perf_counter() - t0) * 1e9),
+                    "mesh": True,
+                },
+                "families": dict(mesh_prof.get("families", {})),
+                "shards": [],
+            }
+        return resp
 
     # body keys the mesh AGG path can serve (size:0, so no fetch keys)
     _MESH_AGG_BODY_KEYS = frozenset(
         {
             "query", "size", "aggs", "aggregations", "track_total_hits",
             "_source", "allow_partial_search_results", "allow_degraded",
-            "request_cache",
+            "request_cache", "profile",
         }
     )
 
@@ -2402,9 +2482,12 @@ class IndexService:
         from ..tasks import TaskCancelledException
 
         t0 = time.perf_counter()
+        mesh_prof = {"families": {}} if body.get("profile") else None
         try:
             plan = mesh.compile_agg(agg_nodes, mplan, self.mappings)
-            job = self._batcher.submit_nowait(mesh, plan, 0, kind="mesh_agg")
+            job = self._batcher.submit_nowait(
+                mesh, plan, 0, kind="mesh_agg", prof=mesh_prof,
+            )
             got = QueryBatcher.wait(job)
         except MeshUnavailable as e:
             if e.budget:
@@ -2434,7 +2517,7 @@ class IndexService:
         aggs_device.note_mesh_routed()
         aggs_device.note_kernel_ms((time.perf_counter() - t0) * 1000.0)
         n = self.num_shards
-        return {
+        resp = {
             "took": took,
             "timed_out": False,
             "_shards": {"total": n, "successful": n, "skipped": 0,
@@ -2442,6 +2525,19 @@ class IndexService:
             "hits": hits_obj,
             "aggregations": reduce_aggs(agg_nodes, [got["partials"]]),
         }
+        if mesh_prof is not None:
+            resp["profile"] = {
+                "coordinator": {
+                    "phases": {"mesh_ns": int(
+                        (time.perf_counter() - t0) * 1e9
+                    )},
+                    "took_ns": int((time.perf_counter() - t0) * 1e9),
+                    "mesh": True,
+                },
+                "families": dict(mesh_prof.get("families", {})),
+                "shards": [],
+            }
+        return resp
 
     def search(
         self,
@@ -2450,33 +2546,77 @@ class IndexService:
         task=None,
     ) -> dict:
         body = body or {}
-        if pinned_executors is not None:
-            # scroll/PIT continuations were admitted when the context
-            # opened; re-gating every page would double-charge them
-            return self._search_reduced(body, pinned_executors, task)
-        # ---- per-node admission gate (search/admission.py): weighted
-        # fair queueing across indices, AIMD concurrency limit, deadline
-        # shedding, brownout degraded modes. Raises EsOverloadedError
-        # (429 + Retry-After) when this request is shed. ----
-        ticket = admission.acquire(
-            self.name,
-            weight=float(self.settings.get("search.admission.weight", 1.0)),
-            deadline=deadline_from(body),
-        )
+        # arm the fetch-phase accumulator for this request: shard fetch
+        # loops add into the shared dict (it rides copied contexts into
+        # the fan-out pools), the slowlog fetch threshold reads the sum
+        acc_token = FETCH_ACC.set({"fetch_ns": 0})
         try:
-            degraded, actions = apply_brownout(body, ticket.tier)
-            resp = self._search_reduced(degraded, None, task)
-            if ticket.tier > 0:
-                # brownout visibility: every degraded response says
-                # which tier served it and what was shed
-                resp["_overload"] = {
-                    "pressure_tier": ticket.tier,
-                    "pressure_mode": ticket.mode,
-                    "actions": actions,
-                }
-            return resp
+            if pinned_executors is not None:
+                # scroll/PIT continuations were admitted when the
+                # context opened; re-gating every page would
+                # double-charge them
+                resp = self._search_reduced(body, pinned_executors, task)
+                self._slowlog_note(body, resp)
+                return resp
+            # ---- per-node admission gate (search/admission.py):
+            # weighted fair queueing across indices, AIMD concurrency
+            # limit, deadline shedding, brownout degraded modes. Raises
+            # EsOverloadedError (429 + Retry-After) when this request
+            # is shed. ----
+            ticket = admission.acquire(
+                self.name,
+                weight=float(
+                    self.settings.get("search.admission.weight", 1.0)
+                ),
+                deadline=deadline_from(body),
+            )
+            try:
+                degraded, actions = apply_brownout(body, ticket.tier)
+                resp = self._search_reduced(degraded, None, task)
+                if ticket.tier > 0:
+                    # brownout visibility: every degraded response says
+                    # which tier served it and what was shed
+                    resp["_overload"] = {
+                        "pressure_tier": ticket.tier,
+                        "pressure_mode": ticket.mode,
+                        "actions": actions,
+                    }
+                self._slowlog_note(degraded, resp)
+                return resp
+            finally:
+                admission.release(ticket)
         finally:
-            admission.release(ticket)
+            FETCH_ACC.reset(acc_token)
+
+    def _slowlog_note(self, body: dict, resp: dict) -> None:
+        """Feeds one completed coordinator search to the per-index slow
+        log. Fully fenced: a slowlog bug must never fail a search."""
+        try:
+            if not self._slowlog.enabled():
+                return
+            acc = FETCH_ACC.get()
+            fetch_ms = (
+                acc["fetch_ns"] / 1e6 if acc is not None else 0.0
+            )
+            summary = None
+            prof = resp.get("profile")
+            if prof:
+                coord = prof.get("coordinator") or {}
+                summary = {
+                    "phases_ns": dict(coord.get("phases", {})),
+                    "shards": len(prof.get("shards") or []),
+                }
+            shards = resp.get("_shards") or {}
+            self._slowlog.on_search(
+                float(resp.get("took", 0)),
+                fetch_ms,
+                shards=int(shards.get("total", self.num_shards)),
+                source=body,
+                opaque_id=OPAQUE_ID_CTX.get(),
+                profile_summary=summary,
+            )
+        except Exception:
+            pass
 
     def _search_reduced(
         self,
@@ -2555,6 +2695,7 @@ class IndexService:
             if mesh_resp is not None:
                 return mesh_resp, None, []
         t0 = time.perf_counter()
+        tns = time.perf_counter_ns()
         size = int(body.get("size", 10))
         from_ = int(body.get("from", 0))
         # coordinator-side parses (merge keys + agg reduce plan only; the
@@ -2579,6 +2720,10 @@ class IndexService:
 
         # every shard returns the full global page's worth of hits
         sub = {**body, "from": 0, "size": from_ + size}
+        # coordinator-phase marks (profile + tracing): the phase spans
+        # tile tns → the reduce mark, so their sum accounts the whole
+        # coordinator wall time up to response assembly
+        m_parse = time.perf_counter_ns()
         # can_match prefilter FIRST (the reference's phase order), so a
         # DFS round never fans out to shards about to be skipped; pinned
         # contexts pin every shard, so the prefilter only runs unpinned
@@ -2586,15 +2731,18 @@ class IndexService:
             skipped_shards, fixed_owners = self._can_match_round(body)
         else:
             skipped_shards, fixed_owners = set(), None
+        m_canmatch = time.perf_counter_ns()
         if body.get("search_type") == "dfs_query_then_fetch":
             dfs = self._dfs_round(body, skipped_shards)
             if dfs is not None:
                 sub["_dfs"] = dfs
+        m_dfs = time.perf_counter_ns()
         deadline = deadline_from(body)
         per_shard, failures, timed_out = self._fan_out(
             sub, pinned_executors, skipped_shards, fixed_owners,
             deadline=deadline, task=task,
         )
+        m_fanout = time.perf_counter_ns()
         allow_partial = parse_allow_partial(
             body.get("allow_partial_search_results")
         )
@@ -2651,6 +2799,7 @@ class IndexService:
         out_hits = [
             {"_index": self.name, **h} for _, _, _, h in entries[from_ : from_ + size]
         ]
+        m_reduce = time.perf_counter_ns()
         took = int((time.perf_counter() - t0) * 1000)
         self.search_stats["query_total"] += 1
         self.search_stats["query_time_in_millis"] += took
@@ -2683,11 +2832,36 @@ class IndexService:
             "_shards": shards_obj,
             "hits": hits_obj,
         }
+        coord_phases = {
+            "parse_ns": m_parse - tns,
+            "can_match_ns": m_canmatch - m_parse,
+            "dfs_ns": m_dfs - m_canmatch,
+            "fan_out_ns": m_fanout - m_dfs,
+            "reduce_ns": m_reduce - m_fanout,
+        }
+        tr = TRACE_CTX.get()
+        if tr is not None:
+            root = tr.add_span(
+                "coordinator", tns, m_reduce,
+                index=self.name, shards=n, took_ms=took,
+            )
+            prev = tns
+            for pname, mark in (
+                ("parse", m_parse), ("can_match", m_canmatch),
+                ("dfs", m_dfs), ("fan_out", m_fanout),
+                ("reduce", m_reduce),
+            ):
+                tr.add_span(pname, prev, mark, parent_id=root)
+                prev = mark
         if profile:
             resp["profile"] = {
+                "coordinator": {
+                    "phases": coord_phases,
+                    "took_ns": m_reduce - tns,
+                },
                 "shards": [
                     r["profile"] for r in shard_results if r.get("profile")
-                ]
+                ],
             }
         if "suggest" in body:
             resp["suggest"] = _reduce_suggest(
@@ -2741,7 +2915,8 @@ class IndexService:
                 out[fname] = frags
         return out
 
-    def _apply_rescore(self, ex, spec, td, sid, shard_deadline, task):
+    def _apply_rescore(self, ex, spec, td, sid, shard_deadline, task,
+                       prof=None):
         """Applies one shard's rescore phase to its first-stage
         TopDocs. numpy backend → the host float oracle; jax backend →
         the batcher `rerank` job family (maxsim kernel, ops/rerank.py).
@@ -2779,7 +2954,7 @@ class IndexService:
         try:
             job = self._batcher.submit_nowait(
                 ex, plan, len(td.hits), kind="rerank",
-                deadline=shard_deadline,
+                deadline=shard_deadline, prof=prof,
             )
             got = self._wait_batched(job, sid, shard_deadline, task)
         except (
@@ -2807,7 +2982,7 @@ class IndexService:
         return rescorer.apply_perm_to_topdocs(td, scores, perm)
 
     def _rescore_ranked(
-        self, spec, ranked: List[tuple], pins=None
+        self, spec, ranked: List[tuple], pins=None, prof=None
     ) -> List[tuple]:
         """Rescore phase for the retriever/rrf coordinator path over a
         fused ranked [(doc_id, score)] list. Single-local-shard jax
@@ -2866,7 +3041,8 @@ class IndexService:
                     plan = rescorer.build_plan(ex.reader, model, spec, cands)
                     try:
                         job = self._batcher.submit_nowait(
-                            ex, plan, len(cands), kind="rerank"
+                            ex, plan, len(cands), kind="rerank",
+                            prof=prof,
                         )
                         got = QueryBatcher.wait(job)
                     except (
@@ -2962,9 +3138,16 @@ class IndexService:
         a concurrent refresh could rescore or fetch the WRONG local
         doc."""
         t0 = time.perf_counter()
+        tns = time.perf_counter_ns()
         size = int(body.get("size", 10))
         from_ = int(body.get("from", 0))
         source_spec = body.get("_source", True)
+        profile = bool(body.get("profile"))
+        # retriever-path profile sink: per-leg breakdowns land in
+        # "legs", batcher families (the fused-rescore rerank launch)
+        # in "families" — the body is NEVER mutated, so the profiled
+        # request rides the identical execution path
+        prof: Optional[dict] = {"legs": []} if profile else None
 
         pins = None
         if self.routing is None:
@@ -2973,9 +3156,13 @@ class IndexService:
             except KeyError:
                 pins = None
         window = max(from_ + size, 10)
+        # the new kwargs ride only on profiled requests so external
+        # wrappers of the original signatures keep working
         ranked = self._run_retriever(
-            body["retriever"], window, size, extra_filter, pins
+            body["retriever"], window, size, extra_filter, pins,
+            **({"prof_out": prof} if prof is not None else {}),
         )
+        m_retr = time.perf_counter_ns()
         if "rescore" in body and ranked:
             from ..search import rescorer
 
@@ -2984,7 +3171,11 @@ class IndexService:
                 # second stage over the FUSED candidates (the RAG
                 # shape: filtered hybrid retrieval → rerank → fetch);
                 # sources are fetched below, after the window re-sort
-                ranked = self._rescore_ranked(rescore_spec, ranked, pins)
+                ranked = self._rescore_ranked(
+                    rescore_spec, ranked, pins,
+                    **({"prof": prof} if prof is not None else {}),
+                )
+        m_resc = time.perf_counter_ns()
         page = ranked[from_ : from_ + size]
         from ..search.executor import filter_source
 
@@ -3001,9 +3192,22 @@ class IndexService:
                 if filtered is not None:
                     entry["_source"] = filtered
             out_hits.append(entry)
+        m_fetch = time.perf_counter_ns()
+        acc = FETCH_ACC.get()
+        if acc is not None:
+            acc["fetch_ns"] += m_fetch - m_resc
         took = int((time.perf_counter() - t0) * 1000)
+        tr = TRACE_CTX.get()
+        if tr is not None:
+            root = tr.add_span(
+                "retriever_search", tns, m_fetch,
+                index=self.name, took_ms=took,
+            )
+            tr.add_span("retriever", tns, m_retr, parent_id=root)
+            tr.add_span("rescore", m_retr, m_resc, parent_id=root)
+            tr.add_span("fetch", m_resc, m_fetch, parent_id=root)
         n = self.num_shards
-        return {
+        resp = {
             "took": took,
             "timed_out": False,
             "_shards": {"total": n, "successful": n, "skipped": 0, "failed": 0},
@@ -3013,6 +3217,22 @@ class IndexService:
                 "hits": out_hits,
             },
         }
+        if prof is not None:
+            resp["profile"] = {
+                "coordinator": {
+                    "phases": {
+                        "retriever_ns": m_retr - tns,
+                        "rescore_ns": m_resc - m_retr,
+                        "fetch_ns": m_fetch - m_resc,
+                    },
+                    "took_ns": m_fetch - tns,
+                },
+                "legs": prof.get("legs", []),
+                "families": dict(prof.get("families", {})),
+                "fuse_ns": int(prof.get("fuse_ns", 0)),
+                "shards": [],
+            }
+        return resp
 
     # ---- hybrid retrieval: concurrent legs + RRF fusion ----
 
@@ -3033,7 +3253,7 @@ class IndexService:
 
     def _run_retriever(
         self, ret: dict, window: int, size: int,
-        extra_filter: Optional[dict], pins=None,
+        extra_filter: Optional[dict], pins=None, prof_out=None,
     ) -> List[tuple]:
         """ranked [(doc_id, score)] for one retriever node (sync)."""
         if not isinstance(ret, dict) or len(ret) != 1:
@@ -3041,6 +3261,10 @@ class IndexService:
         kind, params = next(iter(ret.items()))
         if kind == "standard":
             sub = {"size": window, "_source": False}
+            if prof_out is not None:
+                # sub-search rides the (parity-tested) profiled search
+                # path; its profile block becomes this leg's breakdown
+                sub["profile"] = True
             if "query" in params:
                 sub["query"] = params["query"]
             filters = [
@@ -3061,6 +3285,10 @@ class IndexService:
             # outer requests hold every slot. Pins ride along so every
             # leg scores against the request's snapshot generation.
             resp = self._search_reduced(sub, pins)
+            if prof_out is not None and resp.get("profile"):
+                prof_out.setdefault("legs", []).append(
+                    {"label": "bm25", "profile": resp["profile"]}
+                )
             return [(h["_id"], h["_score"]) for h in resp["hits"]["hits"]]
         if kind == "knn":
             knn_params = dict(params)
@@ -3072,17 +3300,24 @@ class IndexService:
                     if existing is not None
                     else extra_filter
                 )
-            resp = self._search_reduced(
-                {"knn": knn_params, "size": window, "_source": False}, pins
-            )
+            knn_sub = {"knn": knn_params, "size": window, "_source": False}
+            if prof_out is not None:
+                knn_sub["profile"] = True
+            resp = self._search_reduced(knn_sub, pins)
+            if prof_out is not None and resp.get("profile"):
+                prof_out.setdefault("legs", []).append(
+                    {"label": "knn", "profile": resp["profile"]}
+                )
             return [(h["_id"], h["_score"]) for h in resp["hits"]["hits"]]
         if kind == "rrf":
-            return self._run_rrf(params, window, size, extra_filter, pins)
+            return self._run_rrf(
+                params, window, size, extra_filter, pins, prof_out=prof_out
+            )
         raise dsl.QueryParseError(f"unknown retriever [{kind}]")
 
     def _run_rrf(
         self, params: dict, window: int, size: int,
-        extra_filter: Optional[dict], pins=None,
+        extra_filter: Optional[dict], pins=None, prof_out=None,
     ) -> List[tuple]:
         """Concurrent child legs + fusion. All legs share ONE
         rank_window_size candidate budget."""
@@ -3090,10 +3325,14 @@ class IndexService:
         window2 = int(params.get("rank_window_size", max(window, size)))
         children = params.get("retrievers", [])
         t_start = time.perf_counter()
+        t_start_ns = time.perf_counter_ns()
         # submit every leg before collecting any: plannable legs enter
         # the batcher (device overlap), the rest ride the thread pool
         handles = [
-            self._submit_leg(child, window2, extra_filter, pins)
+            self._submit_leg(
+                child, window2, extra_filter, pins,
+                profiled=prof_out is not None,
+            )
             for child in children
         ]
         legs = [self._wait_leg(h, window2, extra_filter, t_start, pins)
@@ -3131,11 +3370,42 @@ class IndexService:
                 if leg["label"] in ("bm25", "knn", "sparse"):
                     st[f"{leg['label']}_leg_ms"] += leg["ms"]
                     self.rrf_leg_samples[leg["label"]].append(leg["ms"])
+        if prof_out is not None:
+            out_legs = prof_out.setdefault("legs", [])
+            for leg in legs:
+                entry = {
+                    "label": leg["label"],
+                    "mode": leg.get("mode", "?"),
+                    "ms": leg["ms"],
+                }
+                lp = leg.get("prof")
+                if lp:
+                    entry["families"] = dict(lp.get("families", {}))
+                if leg.get("sub_profile"):
+                    entry["profile"] = leg["sub_profile"]
+                out_legs.append(entry)
+            prof_out["fuse_ns"] = prof_out.get("fuse_ns", 0) + int(
+                (t_end - t_fuse) * 1e9
+            )
+            prof_out["fused_on_device"] = device
+        tr = TRACE_CTX.get()
+        if tr is not None:
+            t_end_ns = time.perf_counter_ns()
+            root = tr.add_span(
+                "rrf", t_start_ns, t_end_ns,
+                index=self.name, legs=len(legs), device_fused=device,
+            )
+            for leg in legs:
+                tr.add_span(
+                    f"leg:{leg['label']}", t_start_ns,
+                    t_start_ns + int(leg["ms"] * 1e6), parent_id=root,
+                    mode=leg.get("mode", "?"),
+                )
         return fused
 
     def _submit_leg(
         self, child: dict, window: int, extra_filter: Optional[dict],
-        pins=None,
+        pins=None, profiled=False,
     ) -> dict:
         """Async leg submission: a batcher future when the child reduces
         to a device plan, else a thread-pool future running the sync
@@ -3157,30 +3427,43 @@ class IndexService:
         planned = self._plan_leg(kind, params, window, extra_filter, pins)
         if planned is not None:
             ex, plan, pkind, query = planned
+            leg_prof = {"families": {}} if profiled else None
             try:
                 job = self._batcher.submit_nowait(
-                    ex, plan, window, kind=pkind, query=query
+                    ex, plan, window, kind=pkind, query=query,
+                    prof=leg_prof,
                 )
                 return {
                     "mode": "batcher", "job": job, "ex": ex,
-                    "label": label, "child": child,
+                    "label": label, "child": child, "prof": leg_prof,
                 }
             except RuntimeError:
                 pass  # batcher closed → sync fallback below
+        sink = {"legs": []} if profiled else None
         if threading.current_thread().name.startswith(_LEG_POOL_PREFIX):
             # nested rrf: already on a leg thread — run inline rather
             # than wait on a pool slot a sibling may be starving
             return {
                 "mode": "done",
                 "ranked": self._run_retriever(
-                    child, window, window, extra_filter, pins
+                    child, window, window, extra_filter, pins,
+                    prof_out=sink,
                 ),
-                "label": label, "child": child,
+                "label": label, "child": child, "prof_sink": sink,
             }
+        # copied context per leg: the fetch accumulator, trace, and
+        # opaque id stay visible inside pool threads (each submit gets
+        # its own copy — one Context object cannot be entered twice)
+        cctx = contextvars.copy_context()
         fut = _LEG_POOL.submit(
-            self._run_retriever, child, window, window, extra_filter, pins
+            cctx.copy().run,
+            self._run_retriever, child, window, window, extra_filter,
+            pins, sink,
         )
-        return {"mode": "pool", "fut": fut, "label": label, "child": child}
+        return {
+            "mode": "pool", "fut": fut, "label": label, "child": child,
+            "prof_sink": sink,
+        }
 
     def _plan_leg(
         self, kind: str, params: dict, window: int,
@@ -3271,11 +3554,18 @@ class IndexService:
             ranked = handle["ranked"]
         else:
             ranked = handle["fut"].result()
+        sink = handle.get("prof_sink")
+        sub_profile = None
+        if sink and sink.get("legs"):
+            sub_profile = sink["legs"][0].get("profile")
         return {
             "ranked": ranked,
             "td": td,
             "ex": ex,
             "label": handle["label"],
+            "mode": handle["mode"],
+            "prof": handle.get("prof"),
+            "sub_profile": sub_profile,
             "ms": (time.perf_counter() - t_start) * 1000.0,
         }
 
@@ -3612,7 +3902,10 @@ class IndexService:
                 "index_time_in_millis": agg["index_time_in_nanos"] // 1_000_000,
                 "delete_total": agg["delete_total"],
             },
-            "search": dict(self.search_stats),
+            "search": {
+                **self.search_stats,
+                "slowlog": self._slowlog.stats(),
+            },
             "refresh": {"total": agg["refresh_total"]},
             "flush": {"total": agg["flush_total"]},
             "merges": {"total": agg["merge_total"]},

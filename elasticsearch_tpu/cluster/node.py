@@ -226,6 +226,7 @@ class DistributedClusterService(ClusterService):
                 idx.settings.update(flat)
                 idx.apply_translog_settings()
                 idx.apply_refresh_settings()
+                idx.apply_slowlog_settings()
                 idx.apply_routing(routing)
             needs = idx.recovery_needed()
             if needs:

@@ -261,6 +261,7 @@ class ClusterService:
             idx.settings.update(validated)
             idx.apply_translog_settings()
             idx.apply_refresh_settings()
+            idx.apply_slowlog_settings()
             self.version += 1
             self._persist()
             idx._persist_meta()

@@ -311,7 +311,9 @@ def _parse_bool(v) -> bool:
 def _parse_time(v) -> str:
     """TimeValue strings kept as-is but validated (e.g. '1s', '500ms')."""
     s = str(v)
-    if s in ("-1",):
+    if s in ("-1", "0"):
+        # -1 = disabled; bare 0 = zero time (the slowlog "always fire"
+        # threshold, matching the reference's TimeValue.ZERO)
         return s
     for suffix in ("nanos", "micros", "ms", "s", "m", "h", "d"):
         if s.endswith(suffix):
@@ -449,6 +451,17 @@ INDEX_SETTINGS: Dict[str, Setting] = {
         Setting("codec", "default", INDEX_SCOPE, dynamic=False),
         Setting("default_pipeline", None, INDEX_SCOPE),
         Setting("final_pipeline", None, INDEX_SCOPE),
+        # per-index search slow logs (common/slowlog.py): dynamic
+        # per-level thresholds for the query and fetch phases; "-1"
+        # disables a level, "0" fires it on every request
+        *[
+            Setting(
+                f"search.slowlog.threshold.{phase}.{lvl}", "-1",
+                INDEX_SCOPE, parser=_parse_time,
+            )
+            for phase in ("query", "fetch")
+            for lvl in ("warn", "info", "debug", "trace")
+        ],
     ]
 }
 

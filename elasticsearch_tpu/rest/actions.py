@@ -20,6 +20,7 @@ from typing import Any, Dict, List, Optional, Tuple
 
 from ..cluster import ClusterError, ClusterService
 from ..common import deep_merge
+from ..common import tracing
 from ..index.engine import VersionConflictError
 from ..search.dsl import QueryParseError
 from .router import Router, error_body
@@ -91,6 +92,10 @@ class RestActions:
         add("POST", "/_internal/faults", self.put_faults)
         add("GET", "/_internal/faults", self.get_faults)
         add("DELETE", "/_internal/faults", self.delete_faults)
+        # per-request span-tree ring (common/tracing.py): GET drains
+        # recent traces newest-first, DELETE clears the ring
+        add("GET", "/_internal/traces", self.get_traces)
+        add("DELETE", "/_internal/traces", self.delete_traces)
         # async search (x-pack async-search: submit/get/delete)
         add("POST", "/{index}/_async_search", self.submit_async_search)
         add("GET", "/_async_search/{id}", self.get_async_search)
@@ -281,6 +286,21 @@ class RestActions:
         from ..common.faults import faults
 
         faults.clear()
+        return 200, {"acknowledged": True}
+
+    # ---- per-request trace ring (GET /_internal/traces) ----
+
+    def get_traces(self, body, params, qs):
+        n = int(qs.get("n", ["50"])[0]) if qs else 50
+        traces = tracing.recent(n)
+        return 200, {
+            "enabled": tracing.enabled(),
+            "count": len(traces),
+            "traces": traces,
+        }
+
+    def delete_traces(self, body, params, qs):
+        tracing.clear()
         return 200, {"acknowledged": True}
 
     # ---- async search (SubmitAsyncSearchAction and friends) ----
@@ -1389,14 +1409,25 @@ class RestActions:
         # coordinator's gather loop polls check_cancelled(), so a
         # cancel landing mid-collect aborts the request promptly now
         # that timeout cancellation exists on the same path
+        desc = f"indices[{params['index']}]"
+        opaque = tracing.OPAQUE_ID_CTX.get()
+        if opaque:
+            # X-Opaque-Id lands in the task description so _tasks output
+            # attributes in-flight searches to their caller
+            desc = f"{desc} opaque_id[{opaque}]"
         task = self.cluster.tasks.register(
             "indices:data/read/search",
-            f"indices[{params['index']}]",
+            desc,
             cancellable=True,
+        )
+        handle = tracing.begin(
+            "search", index=str(params["index"]),
+            profile=bool(body.get("profile")),
         )
         try:
             return 200, self.cluster.search(params["index"], body, task=task)
         finally:
+            tracing.end(handle)
             self.cluster.tasks.unregister(task)
 
     def search_no_index(self, body, params, qs):
@@ -1731,6 +1762,7 @@ class RestActions:
 
     def msearch(self, body, params, qs):
         # body arrives pre-split as a list of (header, body) dicts
+        t0 = time.perf_counter()
         responses = []
         for header, sub in body:
             index = header.get("index", params.get("index"))
@@ -1741,7 +1773,10 @@ class RestActions:
                 status = e.status if isinstance(e, ClusterError) else 400
                 resp = error_body(status, "search_phase_execution_exception", str(e))
             responses.append(resp)
-        return 200, {"took": 0, "responses": responses}
+        # real coordinator wall-clock across every sub-search (the
+        # reference sums phase times; one monotonic clock here)
+        took = int((time.perf_counter() - t0) * 1000)
+        return 200, {"took": took, "responses": responses}
 
     # ------------------------------------------------------------------
     # bulk (NDJSON)

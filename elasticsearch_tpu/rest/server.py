@@ -21,6 +21,7 @@ from urllib.parse import parse_qs, unquote, urlparse
 
 from ..cluster import ClusterError, ClusterService
 from ..common.memory import CircuitBreakingException
+from ..common.tracing import OPAQUE_ID_CTX
 from ..index.engine import EngineError, VersionConflictError
 from ..index.mapping import MappingParseError
 from ..search.admission import EsOverloadedError, admission, overload_body
@@ -104,6 +105,9 @@ class ElasticHandler(BaseHTTPRequestHandler):
                 )
             return
         resp_headers: Optional[dict] = None
+        # X-Opaque-Id rides a contextvar for the request's lifetime so
+        # task descriptions, traces, and slow logs can stamp it
+        opaque_tok = OPAQUE_ID_CTX.set(self.headers.get("X-Opaque-Id"))
         try:
             body = self._parse_body(path, raw)
             status, payload = route.handler(body, params or {}, qs)
@@ -142,6 +146,8 @@ class ElasticHandler(BaseHTTPRequestHandler):
             )
         except Exception as e:  # the 500 of last resort
             status, payload = 500, error_body(500, "exception", repr(e))
+        finally:
+            OPAQUE_ID_CTX.reset(opaque_tok)
         self._respond(status, payload, head_only, headers=resp_headers)
 
     def _parse_body(self, path: str, raw: bytes):

@@ -244,6 +244,9 @@ class AdmissionController:
             "limit_increases": 0,
             "retries_granted": 0,
             "retries_denied": 0,
+            # brownout tier-1 stripped a "profile": true request — the
+            # shed profiling is attributable, not silent
+            "profiles_shed": 0,
         }
         # per-tier grant counts (index = tier)
         self._tier_grants = [0] * len(TIER_NAMES)
@@ -599,6 +602,8 @@ def apply_brownout(body: dict, tier: int) -> tuple:
     if out.get("profile"):
         out.pop("profile")
         actions.append("profile_dropped")
+        with admission._lock:
+            admission.stats_counters["profiles_shed"] += 1
     if tier >= 2:
         def shrink_knn(sec):
             k = int(sec.get("k", 10))

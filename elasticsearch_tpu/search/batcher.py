@@ -433,12 +433,12 @@ class _Job:
 
     __slots__ = (
         "executor", "kind", "plan", "k", "query", "event", "result",
-        "error", "deadline", "t_enq",
+        "error", "deadline", "t_enq", "prof",
     )
 
     def __init__(
         self, executor, plan, k: int, kind: str = "match", query=None,
-        deadline: Optional[float] = None,
+        deadline: Optional[float] = None, prof=None,
     ):
         self.executor = executor
         self.kind = kind  # "match" | "serve" | "knn"
@@ -452,6 +452,10 @@ class _Job:
         # still queued past it is dropped at dequeue, never dispatched
         self.deadline = deadline
         self.t_enq = time.monotonic()
+        # "profile": true — a shared mutable dict the dispatch/collect
+        # phases write per-family timing into (None = unprofiled; the
+        # submitter owns the dict and reads it after wait())
+        self.prof = prof
 
     def done(self) -> bool:
         return self.event.is_set()
@@ -586,6 +590,11 @@ class QueryBatcher:
         # groups attribute to device 0, mesh groups to every device in
         # the mesh (guarded by self._lock)
         self._devs: Dict[int, list] = {}
+        # per-worker profiling scratch: while a profiled group
+        # dispatches, `group_flops` accumulates the flops the group's
+        # launches report via _add_flops (thread-local — each worker
+        # dispatches one group at a time)
+        self._tl = threading.local()
 
     def _ensure_thread(self):
         with self._lock:
@@ -625,7 +634,7 @@ class QueryBatcher:
 
     def submit_nowait(
         self, executor, plan, k: int, kind: str = "match", query=None,
-        deadline: Optional[float] = None,
+        deadline: Optional[float] = None, prof=None,
     ) -> _Job:
         """Enqueues a job and returns its future handle WITHOUT waiting.
         Raises EsRejectedExecutionError (429) on queue overflow — the
@@ -638,7 +647,7 @@ class QueryBatcher:
         if self._closed:
             raise RuntimeError("query batcher closed")
         job = _Job(executor, plan, k, kind=kind, query=query,
-                   deadline=deadline)
+                   deadline=deadline, prof=prof)
         self._ensure_thread()
         try:
             self._queue.put_nowait(job)
@@ -779,7 +788,9 @@ class QueryBatcher:
                     # drain above yields batch > 1 and the ring engages.
                     with self._lock:
                         self.stats["express_lane_hits"] += 1
-                    self._collect_batch(self._dispatch_batch(batch))
+                    self._collect_batch(
+                        self._dispatch_batch(batch, express=True)
+                    )
                     continue
                 inflight.append(self._dispatch_batch(batch))
                 while len(inflight) >= max(1, self.pipeline_depth):
@@ -808,7 +819,9 @@ class QueryBatcher:
                 except queue.Full:  # pragma: no cover
                     pass
 
-    def _dispatch_batch(self, batch: List[_Job]) -> "_BatchCtx":
+    def _dispatch_batch(
+        self, batch: List[_Job], express: bool = False
+    ) -> "_BatchCtx":
         """Groups a batch and launches all its device work. serve/knn
         groups dispatch asynchronously (collected later by
         _collect_batch); match groups run dispatch+collect fused (their
@@ -910,6 +923,13 @@ class QueryBatcher:
                 dev_entered = False
                 self._enter_kind(fam)
                 dispatched = False
+                # "profile": true — arm the per-group scratch only when
+                # a job in the group carries a prof dict (zero cost on
+                # the unprofiled path beyond this any())
+                prof_on = any(j.prof is not None for j in jobs)
+                if prof_on:
+                    self._tl.group_flops = 0
+                    t_prof = time.perf_counter_ns()
                 try:
                     if not mesh:
                         self._dev_enter(dev_ids)
@@ -924,9 +944,18 @@ class QueryBatcher:
                         # record BEFORE dispatch: match groups complete
                         # their waiters inside _run_group, and a waiter
                         # must never observe its own launch missing
-                        # from the histogram
+                        # from the histogram — the profile mark rides a
+                        # callback for the same reason (it must land
+                        # before the events fire, and before warm loops:
+                        # bucket warming is compile time, not this
+                        # query's time)
                         self._record_bucket(rows, len(jobs))
-                        self._run_group(jobs, key[2], kb, rows=rows)
+                        cb = None
+                        if prof_on:
+                            cb = (lambda j=jobs, r=rows, t=t_prof, n=now,
+                                  e=express: self._prof_mark(j, r, t, n, e))
+                        self._run_group(jobs, key[2], kb, rows=rows,
+                                        prof_cb=cb)
                         self._maybe_warm(key, jobs, kb, rows)
                     elif kind == "s":
                         self._record_bucket(rows, len(jobs))
@@ -936,6 +965,9 @@ class QueryBatcher:
                              dev_ids)
                         )
                         dispatched = True
+                        if prof_on:
+                            self._prof_mark(jobs, rows, t_prof, now,
+                                            express)
                         self._maybe_warm(key, jobs, kb, rows)
                     elif kind == "k":
                         self._record_bucket(rows, len(jobs))
@@ -945,6 +977,9 @@ class QueryBatcher:
                              dev_ids)
                         )
                         dispatched = True
+                        if prof_on:
+                            self._prof_mark(jobs, rows, t_prof, now,
+                                            express)
                         self._maybe_warm(key, jobs, kb, rows)
                     elif kind == "a":
                         ctx.pending.append(
@@ -952,6 +987,9 @@ class QueryBatcher:
                              self._dispatch_agg_group(jobs), dev_ids)
                         )
                         dispatched = True
+                        if prof_on:
+                            self._prof_mark(jobs, rows, t_prof, now,
+                                            express)
                     elif kind == "r":
                         self._record_bucket(rows, len(jobs))
                         ctx.pending.append(
@@ -960,6 +998,9 @@ class QueryBatcher:
                              dev_ids)
                         )
                         dispatched = True
+                        if prof_on:
+                            self._prof_mark(jobs, rows, t_prof, now,
+                                            express)
                     elif kind == "v":
                         self._record_bucket(rows, len(jobs))
                         ctx.pending.append(
@@ -969,6 +1010,9 @@ class QueryBatcher:
                              dev_ids)
                         )
                         dispatched = True
+                        if prof_on:
+                            self._prof_mark(jobs, rows, t_prof, now,
+                                            express)
                         self._maybe_warm(key, jobs, kb, rows)
                     else:
                         mex = jobs[0].executor
@@ -996,12 +1040,19 @@ class QueryBatcher:
                         )
                         ctx.pending.append((key, jobs, fam, pend, dev_ids))
                         dispatched = True
+                        if prof_on:
+                            self._prof_mark(
+                                jobs, pend.get("rows", BPAD), t_prof,
+                                now, express,
+                            )
                 except BaseException as e:  # surface to waiters
                     for j in jobs:
                         if not j.event.is_set():
                             j.error = e
                             j.event.set()
                 finally:
+                    if prof_on:
+                        self._tl.group_flops = None
                     if not dispatched:
                         self._exit_kind(fam)
                         if dev_entered:
@@ -1024,6 +1075,8 @@ class QueryBatcher:
         try:
             for key, jobs, fam, pend, dev_ids in ctx.pending:
                 kind = key[1]
+                prof_on = any(j.prof is not None for j in jobs)
+                tc0 = time.perf_counter_ns() if prof_on else 0
                 try:
                     # fault site: a collect-phase failure (device→host
                     # transfer) fails this group's waiters only
@@ -1059,6 +1112,8 @@ class QueryBatcher:
                         self._add_stall(time.perf_counter() - t0)
                     else:
                         self._collect_knn_group(jobs, pend)
+                    if prof_on:
+                        self._prof_collect(jobs, tc0)
                 except BaseException as e:
                     for j in jobs:
                         if not j.event.is_set():
@@ -1087,6 +1142,11 @@ class QueryBatcher:
 
     def _add_flops(self, n: int, dev_ids: Tuple[int, ...] = (0,)):
         n = int(n)
+        gf = getattr(self._tl, "group_flops", None)
+        if gf is not None:
+            # a profiled group is dispatching on this worker: credit the
+            # flops to it as well as to the node-level roofline counters
+            self._tl.group_flops = gf + n
         with self._lock:
             self._flops += n
             if dev_ids:
@@ -1094,6 +1154,62 @@ class QueryBatcher:
                 for i, did in enumerate(dev_ids):
                     d = self._devs.setdefault(did, [0, 0.0, 0.0, 0])
                     d[3] += share + (n - share * len(dev_ids) if i == 0 else 0)
+
+    # ---- per-request profiling ("profile": true) ----
+
+    def _prof_mark(self, jobs, rows, t0_ns, now_mono, express=False):
+        """Writes the dispatch-side breakdown of one profiled group into
+        every carrying job's prof dict: wall time of the launch, queue
+        wait, the group's flops (even share — the launch is shared),
+        pad bucket, batch width, and express-lane membership. Entries
+        are built aside and dict-swapped in so a reader that races the
+        write never observes a half-built entry."""
+        dt = time.perf_counter_ns() - t0_ns
+        fl = int(getattr(self._tl, "group_flops", 0) or 0)
+        self._tl.group_flops = None
+        n = max(len(jobs), 1)
+        for j in jobs:
+            p = j.prof
+            if p is None:
+                continue
+            fams = p.setdefault("families", {})
+            prev = fams.get(j.kind)
+            e = dict(prev) if prev else {
+                "launches": 0, "dispatch_ns": 0, "collect_ns": 0,
+                "queue_wait_ns": 0, "flops": 0, "bucket": 0,
+                "batch_jobs": 0, "express_lane": False, "pruned": False,
+            }
+            e["launches"] += 1
+            e["dispatch_ns"] += dt
+            e["queue_wait_ns"] += max(0, int((now_mono - j.t_enq) * 1e9))
+            e["flops"] += fl // n
+            e["bucket"] = int(rows or 0)
+            e["batch_jobs"] = n
+            if express:
+                e["express_lane"] = True
+            if p.get("pruned_jobs"):
+                e["pruned"] = True
+            fams[j.kind] = e
+
+    def _prof_collect(self, jobs, t0_ns):
+        """Collect-side twin of _prof_mark: adds the device→host
+        transfer + host-merge wall time of one profiled group."""
+        dt = time.perf_counter_ns() - t0_ns
+        for j in jobs:
+            p = j.prof
+            if p is None:
+                continue
+            fams = p.setdefault("families", {})
+            prev = fams.get(j.kind)
+            e = dict(prev) if prev else {
+                "launches": 0, "dispatch_ns": 0, "collect_ns": 0,
+                "queue_wait_ns": 0, "flops": 0, "bucket": 0,
+                "batch_jobs": 0, "express_lane": False, "pruned": False,
+            }
+            e["collect_ns"] += dt
+            if p.get("pruned_jobs"):
+                e["pruned"] = True
+            fams[j.kind] = e
 
     def _add_stall(self, seconds: float):
         with self._lock:
@@ -1295,10 +1411,13 @@ class QueryBatcher:
         }
 
     def _run_group(self, jobs: List[_Job], field: str, kb: int,
-                   rows: Optional[int] = None, record: bool = True):
+                   rows: Optional[int] = None, record: bool = True,
+                   prof_cb=None):
         """`rows` is the group's padded launch width (a ladder bucket >=
         len(jobs); default BPAD); `record=False` (bucket warmup) skips
-        all stats/flop accounting."""
+        all stats/flop accounting. `prof_cb` (profiled groups) fires
+        after device work completes but BEFORE waiter events are set, so
+        a profiled request never observes its own launch missing."""
         ex = jobs[0].executor
         reader = ex.reader
         nj = len(jobs)
@@ -1451,6 +1570,8 @@ class QueryBatcher:
             ms = np.full((nj, 0), -np.inf, np.float32)
             mseg = mdoc = np.zeros((nj, 0), np.int32)
             mtot = np.zeros((nj, 0), np.int64)
+        if prof_cb is not None:
+            prof_cb()
         for ji, j in enumerate(jobs):
             finite = np.isfinite(ms[ji])
             hits = [
@@ -1472,6 +1593,10 @@ class QueryBatcher:
                 if record:
                     with self._lock:
                         self.stats["pruned_jobs"] += 1
+                if record and j.prof is not None:
+                    j.prof["pruned_jobs"] = (
+                        j.prof.get("pruned_jobs", 0) + 1
+                    )
                 # pruned tiles mean the collected count is a lower bound —
                 # never report it as exact, even at tth_cap == 0 where the
                 # REST layer omits totals (internal consumers of TopDocs
@@ -2085,6 +2210,10 @@ class QueryBatcher:
                 if record:
                     with self._lock:
                         self.stats["pruned_jobs"] += 1
+                if record and j.prof is not None:
+                    j.prof["pruned_jobs"] = (
+                        j.prof.get("pruned_jobs", 0) + 1
+                    )
                 relation = "gte"
             j.result = TopDocs(
                 total=int(totals[ji]),

@@ -1,0 +1,216 @@
+#!/usr/bin/env bash
+# Search profiling / observability smoke: `profile: true`, slow logs,
+# and the span-tree trace ring must observe without perturbing.
+#
+# Gates:
+#   1. Parity — profiling ON returns hits/aggs BIT-IDENTICAL to
+#      profiling OFF on every plan family (match, sparse, knn-ivf,
+#      device agg, hybrid rrf+rescore) on BOTH backends.
+#   2. Coverage — the profiled coordinator phases account for >= 90%
+#      of the reported `took` (the phase marks are consecutive, so
+#      anything the profile can't see is unattributed coordinator
+#      time).
+#   3. Slow log — threshold "0" fires a well-formed one-line JSON
+#      record on every search; threshold "-1" (the default) stays
+#      silent; the firing counters land in `_stats`.
+#   4. No thread leak — a profiled+traced+slow-logged search burst
+#      leaves the process thread count where it started (profiling
+#      must not spawn per-request machinery).
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+export JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}"
+export ES_TPU_ADMISSION=off
+export ES_TPU_BUCKET_WARMUP=0
+export ES_TPU_BG_REFRESH=off
+export ES_TPU_DEVICE_BUILD=off
+
+N_DOCS="${PROFILE_SMOKE_N_DOCS:-400}"
+N_BURST="${PROFILE_SMOKE_N_BURST:-40}"
+
+python - "$N_DOCS" "$N_BURST" <<'PY'
+import copy
+import json
+import logging
+import os
+import sys
+import threading
+
+n_docs, n_burst = int(sys.argv[1]), int(sys.argv[2])
+
+sys.path.insert(0, os.getcwd())
+from elasticsearch_tpu.cluster.indices import IndexService
+
+DIMS = 4
+MAPPINGS = {
+    "properties": {
+        "body": {"type": "text"},
+        "price": {"type": "float"},
+        "vec": {"type": "dense_vector", "dims": DIMS,
+                "similarity": "l2_norm"},
+        "ml": {"type": "sparse_vector"},
+        "toks": {"type": "rank_vectors", "dims": DIMS,
+                 "similarity": "dot_product"},
+    }
+}
+
+BODIES = {
+    "match": {"query": {"match": {"body": "alpha"}}, "size": 5},
+    "sparse": {"query": {"sparse_vector": {
+        "field": "ml", "query_vector": {"tok1": 2.0, "tok2": 1.0}}},
+        "size": 5},
+    "knn": {"knn": {"field": "vec", "query_vector": [1.0, 1.0, 2.0, 1.0],
+                    "k": 5, "num_candidates": 20}, "size": 5},
+    "agg": {"size": 0, "aggs": {
+        "avg_price": {"avg": {"field": "price"}},
+        "max_price": {"max": {"field": "price"}}}},
+    "hybrid_rrf": {
+        "retriever": {"rrf": {"rank_window_size": 20, "retrievers": [
+            {"standard": {"query": {"match": {"body": "alpha"}}}},
+            {"knn": {"field": "vec",
+                     "query_vector": [1.0, 1.0, 2.0, 1.0],
+                     "k": 10, "num_candidates": 20}},
+            {"standard": {"query": {"sparse_vector": {
+                "field": "ml",
+                "query_vector": {"tok1": 2.0, "tok2": 1.0}}}}},
+        ]}},
+        "rescore": {"window_size": 10, "query": {
+            "rescore_query": {"rank_vectors": {
+                "field": "toks",
+                "query_vectors": [[1.0, 0.5, 0.2, 1.0]]}},
+            "query_weight": 0.5, "rescore_query_weight": 2.0}},
+        "size": 5},
+}
+
+words = ["alpha", "beta", "gamma", "delta"]
+
+
+def make(name, backend, extra=None):
+    settings = {"number_of_shards": 1, "search.backend": backend}
+    settings.update(extra or {})
+    idx = IndexService(name, settings=settings, mappings_json=MAPPINGS)
+    for i in range(n_docs):
+        idx.index_doc(str(i), {
+            "body": f"{words[i % 4]} {words[(i + 1) % 4]} doc{i}",
+            "price": float(i),
+            "vec": [float(i % 7), 1.0, 2.0, float(i % 3)],
+            "ml": {f"tok{j}": 1.0 + (i * j) % 5 for j in range(4)},
+            "toks": [[float((i + t) % 5), 1.0, 0.5, 2.0]
+                     for t in range(1 + i % 3)],
+        })
+    idx.refresh()
+    return idx
+
+
+failures = []
+
+
+def gate(name, ok, detail=""):
+    print(f"  [{'PASS' if ok else 'FAIL'}] {name} {detail}")
+    if not ok:
+        failures.append(name)
+
+
+# ---- gate 1: parity + gate 2: coverage, per backend x family -------
+for backend in ("numpy", "jax"):
+    print(f"-- backend={backend}")
+    for kind, body in BODIES.items():
+        extra = ({"knn.type": "ivf", "knn.nlist": 8, "knn.nprobe": 4}
+                 if kind == "knn" else None)
+        idx = make(f"ps-{backend}-{kind}", backend, extra)
+        try:
+            idx.search(copy.deepcopy(body))  # warm the kernels
+            r_off = idx.search(copy.deepcopy(body))
+            r_on = idx.search({**copy.deepcopy(body), "profile": True})
+            prof = r_on.pop("profile", None)
+            took_on = r_on.pop("took")
+            r_off.pop("took")
+            same = json.dumps(r_on, sort_keys=True) == json.dumps(
+                r_off, sort_keys=True)
+            gate(f"parity {backend}/{kind}", same and prof is not None)
+
+            coord = (prof or {}).get("coordinator", {})
+            took_ns = int(coord.get("took_ns", 0))
+            phase_ns = sum(coord.get("phases", {}).values())
+            # `took` is ms-truncated; 90% of the floor is the gate
+            need = 0.9 * took_on * 1e6
+            cov_ok = took_ns >= need and (
+                coord.get("mesh") or phase_ns == took_ns)
+            gate(f"coverage {backend}/{kind}", cov_ok,
+                 f"(phases {phase_ns}ns / coord {took_ns}ns"
+                 f" / took {took_on}ms)")
+        finally:
+            idx.close()
+
+# ---- gate 3: slow log fires at 0, silent at -1 ---------------------
+print("-- slowlog")
+
+
+class Cap(logging.Handler):
+    def __init__(self):
+        super().__init__()
+        self.records = []
+
+    def emit(self, record):
+        self.records.append(record.getMessage())
+
+
+cap = Cap()
+parent = logging.getLogger("index.search.slowlog")
+parent.addHandler(cap)
+parent.setLevel(logging.DEBUG)
+try:
+    idx = make("ps-slow-on", "numpy")
+    try:
+        idx.settings["search.slowlog.threshold.query.warn"] = "0"
+        idx.apply_slowlog_settings()
+        for _ in range(3):
+            idx.search({"query": {"match": {"body": "alpha"}}})
+        recs = [json.loads(r) for r in cap.records]
+        ok = (len(recs) == 3
+              and all(r["type"] == "index_search_slowlog" for r in recs)
+              and all(r["level"] == "warn" for r in recs)
+              and all(r["index"] == "ps-slow-on" for r in recs))
+        counters = idx.stats()["primaries"]["search"]["slowlog"][
+            "counters"]
+        gate("slowlog fires at threshold 0",
+             ok and counters["query_warn"] == 3,
+             f"({len(recs)} records, query_warn={counters['query_warn']})")
+    finally:
+        idx.close()
+
+    cap.records.clear()
+    idx = make("ps-slow-off", "numpy")  # defaults: every threshold -1
+    try:
+        for _ in range(3):
+            idx.search({"query": {"match": {"body": "alpha"}}})
+        gate("slowlog silent at threshold -1", cap.records == [],
+             f"({len(cap.records)} records)")
+    finally:
+        idx.close()
+finally:
+    parent.removeHandler(cap)
+
+# ---- gate 4: no thread leak ----------------------------------------
+print("-- thread leak")
+idx = make("ps-leak", "jax")
+try:
+    # warm: first hybrid search may lazily start the shared leg pool
+    idx.search({**copy.deepcopy(BODIES["hybrid_rrf"]), "profile": True})
+    idx.settings["search.slowlog.threshold.query.trace"] = "0"
+    idx.apply_slowlog_settings()
+    before = threading.active_count()
+    for i in range(n_burst):
+        kind = list(BODIES)[i % len(BODIES)]
+        idx.search({**copy.deepcopy(BODIES[kind]), "profile": True})
+    after = threading.active_count()
+    gate("no thread leak", after <= before,
+         f"(threads {before} -> {after} over {n_burst} searches)")
+finally:
+    idx.close()
+
+if failures:
+    print(f"PROFILE SMOKE: FAIL ({failures})")
+    sys.exit(1)
+print("PROFILE SMOKE: OK")
+PY

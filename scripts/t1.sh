@@ -29,7 +29,12 @@
 # smoke (scripts/sparse_smoke.sh: fp32 impact serving float-identical
 # to the dense oracle + int8 recall@10 >= 0.95 + >= 2x value-plane
 # compression always; the >= 3x device-vs-host QPS gate on >= 8-core
-# hosts). The combined exit code fails if any enabled run fails.
+# hosts). T1_PROFILE=1 additionally runs the observability smoke
+# (scripts/profile_smoke.sh: profile-on vs profile-off bit-identical
+# on every plan family on both backends, profiled coordinator phases
+# >= 90% of took, slowlog fires at threshold 0 / silent at -1, and a
+# no-thread-leak burst — all gates always enforced). The combined exit
+# code fails if any enabled run fails.
 set -o pipefail; rm -f /tmp/_t1.log; timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' --continue-on-collection-errors -p no:cacheprovider -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log; rc=${PIPESTATUS[0]}; echo DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' /tmp/_t1.log | tr -cd . | wc -c)
 if [ "${T1_MESH:-0}" = "1" ]; then
     echo "--- T1_MESH: mesh-marked tests on the forced 8-device host platform ---"
@@ -81,5 +86,11 @@ if [ "${T1_SPARSE:-0}" = "1" ]; then
     bash scripts/sparse_smoke.sh
     sparse_rc=$?
     [ "$rc" -eq 0 ] && rc=$sparse_rc
+fi
+if [ "${T1_PROFILE:-0}" = "1" ]; then
+    echo "--- T1_PROFILE: observability smoke (profile parity + slowlog + thread-leak gates) ---"
+    bash scripts/profile_smoke.sh
+    prof_rc=$?
+    [ "$rc" -eq 0 ] && rc=$prof_rc
 fi
 exit $rc

@@ -1,0 +1,74 @@
+"""`_nodes/stats` schema stability gate.
+
+Every observability PR bolts counters onto `_nodes/stats`; dashboards
+and the bench driver read them by key. This test freezes the top-level
+node blocks and each block's required keys so a refactor that renames
+or drops one fails loudly here instead of silently zeroing a chart.
+Blocks may GROW (new keys are fine) — they may not lose keys.
+"""
+
+from elasticsearch_tpu.cluster import ClusterService
+from elasticsearch_tpu.rest.actions import RestActions
+
+REQUIRED = {
+    "pipeline": {
+        "depth", "in_flight", "device_busy_ms", "host_stall_ms",
+        "flops", "mfu", "devices", "batching", "mesh",
+    },
+    "pipeline.batching": {
+        "buckets", "launches_by_bucket", "occupancy_jobs",
+        "occupancy_slots", "express_lane_hits", "avg_occupancy",
+    },
+    "pipeline.mesh": {
+        "routed", "launches", "jobs", "rebuilds", "degraded",
+        "fallbacks",
+    },
+    "admission": {
+        "enabled", "limit", "inflight", "queued", "pressure",
+        "pressure_tier", "pressure_mode", "retry_after_s",
+        "tier_grants", "tenants", "admitted", "shed_rejected",
+        "brownouts", "retries_granted", "retries_denied",
+        "profiles_shed",
+    },
+    "aggs": {"batched_jobs"},
+    "knn.ann": set(),  # block presence is the contract
+    "rescore": {"batched_jobs"},
+    "sparse": {"batched_jobs"},
+    "translog": {
+        "uncommitted_ops", "uncommitted_bytes", "pending_unsynced_ops",
+        "fsyncs", "appended_ops", "torn_tails_truncated",
+    },
+    "recovery": {
+        "replayed_ops", "tail_replays", "quarantined_segments", "peer",
+    },
+    "ingest": {"refreshers_running"},
+    "breakers": {"hbm"},
+    "thread_pool": {"search"},
+}
+
+
+def test_nodes_stats_blocks_stable():
+    cluster = ClusterService()
+    try:
+        cluster.create_index("ns", {"settings": {"number_of_shards": 1}})
+        idx = cluster.indices["ns"]
+        idx.index_doc("1", {"body": "hello"})
+        idx.refresh()
+        idx.search({"query": {"match": {"body": "hello"}}})
+        actions = RestActions(cluster)
+        status, body = actions.nodes_stats(None, {}, {})
+        assert status == 200
+        node = body["nodes"]["node-0"]
+        for path, keys in REQUIRED.items():
+            cur = node
+            for part in path.split("."):
+                assert part in cur, f"missing block [{path}]"
+                cur = cur[part]
+            missing = keys - set(cur)
+            assert not missing, f"block [{path}] lost keys {sorted(missing)}"
+        # the search thread_pool keeps its queue/rejection counters
+        tp = node["thread_pool"]["search"]
+        for key in ("queue_capacity", "completed", "rejected", "launches"):
+            assert key in tp
+    finally:
+        cluster.close()

@@ -1,0 +1,322 @@
+"""Search profiling: `"profile": true` with device-kernel timings.
+
+Contract under test (the observability tentpole):
+  * profiling ON returns hits/aggs BIT-IDENTICAL to profiling OFF —
+    the profiled request rides the exact same execution path (batched
+    fast path, device aggs, mesh, request-cache exclusion aside) on
+    both backends, for every plan family;
+  * the per-shard profile block carries the ES-shaped `searches` tree
+    PLUS per-plan-family batcher timings (dispatch/collect ns, queue
+    wait, flops, pad bucket, express-lane/pruning markers);
+  * the coordinator block decomposes took into parse → can_match →
+    DFS → fan-out → reduce phases that tile the request;
+  * the hybrid `retriever` path reports every rrf leg separately
+    (label, mode, per-leg families) plus rescore/fetch phases;
+  * `_msearch` reports real coordinator wall-clock, not 0;
+  * brownout strips `profile` and counts it in `profiles_shed`.
+"""
+
+import copy
+import json
+
+import pytest
+
+from elasticsearch_tpu.cluster.indices import IndexService
+
+DIMS = 4
+
+MAPPINGS = {
+    "properties": {
+        "body": {"type": "text"},
+        "price": {"type": "float"},
+        "vec": {
+            "type": "dense_vector", "dims": DIMS, "similarity": "l2_norm",
+        },
+        "ml": {"type": "sparse_vector"},
+        "toks": {
+            "type": "rank_vectors", "dims": DIMS,
+            "similarity": "dot_product",
+        },
+    }
+}
+
+
+def make_service(name, backend="jax", shards=1, extra=None):
+    settings = {"number_of_shards": shards, "search.backend": backend}
+    settings.update(extra or {})
+    return IndexService(name, settings=settings, mappings_json=MAPPINGS)
+
+
+def seed_docs(idx, n=40):
+    words = ["alpha", "beta", "gamma", "delta"]
+    for i in range(n):
+        idx.index_doc(str(i), {
+            "body": f"{words[i % 4]} {words[(i + 1) % 4]} doc{i}",
+            "price": float(i),
+            "vec": [float(i % 7), 1.0, 2.0, float(i % 3)],
+            "ml": {f"tok{j}": 1.0 + (i * j) % 5 for j in range(4)},
+            "toks": [[float((i + t) % 5), 1.0, 0.5, 2.0]
+                     for t in range(1 + i % 3)],
+        })
+    idx.refresh()
+
+
+MATCH_BODY = {"query": {"match": {"body": "alpha"}}, "size": 5}
+SPARSE_BODY = {
+    "query": {"sparse_vector": {
+        "field": "ml", "query_vector": {"tok1": 2.0, "tok2": 1.0},
+    }},
+    "size": 5,
+}
+KNN_BODY = {
+    "knn": {"field": "vec", "query_vector": [1.0, 1.0, 2.0, 1.0],
+            "k": 5, "num_candidates": 20},
+    "size": 5,
+}
+AGG_BODY = {
+    "size": 0,
+    "aggs": {
+        "avg_price": {"avg": {"field": "price"}},
+        "max_price": {"max": {"field": "price"}},
+    },
+}
+HYBRID_BODY = {
+    "retriever": {"rrf": {"rank_window_size": 20, "retrievers": [
+        {"standard": {"query": {"match": {"body": "alpha"}}}},
+        {"knn": {"field": "vec", "query_vector": [1.0, 1.0, 2.0, 1.0],
+                 "k": 10, "num_candidates": 20}},
+        {"standard": {"query": {"sparse_vector": {
+            "field": "ml", "query_vector": {"tok1": 2.0, "tok2": 1.0},
+        }}}},
+    ]}},
+    "rescore": {
+        "window_size": 10,
+        "query": {
+            "rescore_query": {"rank_vectors": {
+                "field": "toks",
+                "query_vectors": [[1.0, 0.5, 0.2, 1.0]],
+            }},
+            "query_weight": 0.5,
+            "rescore_query_weight": 2.0,
+        },
+    },
+    "size": 5,
+}
+
+BODIES = {
+    "match": MATCH_BODY,
+    "sparse": SPARSE_BODY,
+    "knn": KNN_BODY,
+    "agg": AGG_BODY,
+    "hybrid_rrf": HYBRID_BODY,
+}
+
+
+def run_pair(idx, body):
+    """(response_without_profile, profile) for the profiled run, plus
+    the plain run — bodies deep-copied so neither run can mutate the
+    template."""
+    r_off = idx.search(copy.deepcopy(body))
+    r_on = idx.search({**copy.deepcopy(body), "profile": True})
+    prof = r_on.pop("profile", None)
+    r_on.pop("took")
+    r_off.pop("took")
+    return r_off, r_on, prof
+
+
+class TestProfileParity:
+    """Profiling must be a pure observer: bit-identical results."""
+
+    @pytest.mark.parametrize("backend", ["numpy", "jax"])
+    @pytest.mark.parametrize("kind", sorted(BODIES))
+    def test_bit_identical_on_vs_off(self, backend, kind):
+        extra = {"knn.type": "ivf", "knn.nlist": 8, "knn.nprobe": 4}
+        idx = make_service(f"pf-{backend}-{kind}", backend=backend,
+                           extra=extra if kind == "knn" else None)
+        try:
+            seed_docs(idx)
+            r_off, r_on, prof = run_pair(idx, BODIES[kind])
+            assert json.dumps(r_on, sort_keys=True) == json.dumps(
+                r_off, sort_keys=True
+            ), f"profile changed results for {kind} on {backend}"
+            assert prof is not None
+        finally:
+            idx.close()
+
+    def test_multi_shard_parity(self):
+        idx = make_service("pf-msh", backend="jax", shards=2)
+        try:
+            seed_docs(idx)
+            r_off, r_on, prof = run_pair(idx, MATCH_BODY)
+            assert r_on == r_off
+            if prof["coordinator"].get("mesh"):
+                # multi-shard jax rode the SPMD mesh: one fused launch,
+                # profiled at the mesh coordinator (no per-shard trees)
+                assert "families" in prof
+                assert prof["coordinator"]["took_ns"] > 0
+            else:
+                assert len(prof["shards"]) == 2
+        finally:
+            idx.close()
+
+
+class TestProfileContent:
+    def test_coordinator_phases_tile_the_request(self):
+        idx = make_service("pf-coord")
+        try:
+            seed_docs(idx)
+            _, _, prof = run_pair(idx, MATCH_BODY)
+            coord = prof["coordinator"]
+            phases = coord["phases"]
+            for key in ("parse_ns", "can_match_ns", "dfs_ns",
+                        "fan_out_ns", "reduce_ns"):
+                assert phases[key] >= 0
+            assert coord["took_ns"] > 0
+            # the phases are consecutive marks: they sum EXACTLY to the
+            # coordinator's took
+            assert sum(phases.values()) == coord["took_ns"]
+        finally:
+            idx.close()
+
+    def test_match_family_timings(self):
+        idx = make_service("pf-fam")
+        try:
+            seed_docs(idx)
+            idx.search(copy.deepcopy(MATCH_BODY))  # warm the kernel
+            _, _, prof = run_pair(idx, MATCH_BODY)
+            fams = prof["shards"][0]["families"]
+            assert "match" in fams
+            m = fams["match"]
+            assert m["launches"] >= 1
+            assert m["dispatch_ns"] >= 0
+            assert m["collect_ns"] >= 0
+            assert m["queue_wait_ns"] >= 0
+            assert m["flops"] > 0
+            assert m["bucket"] >= 1
+            assert m["batch_jobs"] >= 1
+        finally:
+            idx.close()
+
+    def test_legacy_query_tree_shape_kept(self):
+        idx = make_service("pf-legacy")
+        try:
+            seed_docs(idx)
+            _, _, prof = run_pair(idx, MATCH_BODY)
+            sh = prof["shards"][0]
+            q = sh["searches"][0]["query"][0]
+            assert q["type"] == "MatchQuery"
+            assert q["time_in_nanos"] >= 0
+            assert "collector" in sh["searches"][0]
+            assert sh["phases"]["fetch_ns"] >= 0
+            assert sh["phases"]["rescore_ns"] >= 0
+        finally:
+            idx.close()
+
+    def test_agg_family_present(self):
+        idx = make_service("pf-agg")
+        try:
+            seed_docs(idx)
+            idx.search(copy.deepcopy(AGG_BODY))  # warm
+            _, _, prof = run_pair(idx, AGG_BODY)
+            fams = prof["shards"][0]["families"]
+            assert "agg" in fams
+            assert fams["agg"]["launches"] >= 1
+        finally:
+            idx.close()
+
+    def test_sparse_family_present(self):
+        idx = make_service("pf-sparse")
+        try:
+            seed_docs(idx)
+            idx.search(copy.deepcopy(SPARSE_BODY))  # warm
+            _, _, prof = run_pair(idx, SPARSE_BODY)
+            fams = prof["shards"][0]["families"]
+            assert "sparse" in fams
+        finally:
+            idx.close()
+
+    def test_hybrid_legs_reported_separately(self):
+        idx = make_service("pf-hyb")
+        try:
+            seed_docs(idx)
+            idx.search(copy.deepcopy(HYBRID_BODY))  # warm all kernels
+            _, _, prof = run_pair(idx, HYBRID_BODY)
+            legs = prof["legs"]
+            labels = sorted(l["label"] for l in legs)
+            assert labels == ["bm25", "knn", "sparse"]
+            for leg in legs:
+                assert leg["ms"] >= 0
+                assert leg["mode"] in ("batcher", "pool", "done")
+            phases = prof["coordinator"]["phases"]
+            assert phases["retriever_ns"] > 0
+            assert phases["rescore_ns"] >= 0
+            assert phases["fetch_ns"] >= 0
+            # the fused-candidates rerank launch lands in the
+            # retriever-level families map
+            assert "rerank" in prof["families"]
+        finally:
+            idx.close()
+
+
+class TestMsearchTook:
+    def test_msearch_reports_real_wall_clock(self):
+        from elasticsearch_tpu.cluster import ClusterService
+        from elasticsearch_tpu.rest.actions import RestActions
+
+        cluster = ClusterService()
+        try:
+            cluster.create_index("ms", {
+                "settings": {"number_of_shards": 1},
+            })
+            idx = cluster.indices["ms"]
+            for i in range(5):
+                idx.index_doc(str(i), {"body": f"hello {i}"})
+            idx.refresh()
+            actions = RestActions(cluster)
+            pairs = [
+                ({"index": "ms"}, {"query": {"match": {"body": "hello"}}}),
+                ({"index": "ms"}, {"query": {"match_all": {}}}),
+            ]
+            status, out = actions.msearch(pairs, {}, {})
+            assert status == 200
+            assert len(out["responses"]) == 2
+            assert all(r["status"] == 200 for r in out["responses"])
+            # real coordinator wall-clock: at least the max sub-search
+            # took, and an int (the hardcoded 0 regression guard)
+            assert isinstance(out["took"], int)
+            assert out["took"] >= max(
+                r["took"] for r in out["responses"]
+            ) - 1  # ms truncation slack
+        finally:
+            cluster.close()
+
+
+class TestProfilesShed:
+    def test_brownout_strips_profile_and_counts(self):
+        from elasticsearch_tpu.search.admission import (
+            admission, apply_brownout,
+        )
+
+        admission.reset()
+        before = admission.stats()["profiles_shed"]
+        body = {"query": {"match_all": {}}, "profile": True}
+        out, actions = apply_brownout(dict(body), tier=2)
+        assert "profile" not in out
+        assert "profile_dropped" in actions
+        after = admission.stats()["profiles_shed"]
+        assert after == before + 1
+        admission.reset()
+
+    def test_no_shed_without_profile(self):
+        from elasticsearch_tpu.search.admission import (
+            admission, apply_brownout,
+        )
+
+        admission.reset()
+        before = admission.stats()["profiles_shed"]
+        out, actions = apply_brownout(
+            {"query": {"match_all": {}}}, tier=2
+        )
+        assert "profile_dropped" not in actions
+        assert admission.stats()["profiles_shed"] == before
+        admission.reset()

@@ -88,7 +88,14 @@ class TestStatsAndProfile:
         assert st["docs"]["count"] == 9
 
     def test_profile_response_shape(self):
-        idx = IndexService("pf", settings={"number_of_shards": 2})
+        # numpy pins the per-shard coordinator path: profiled requests
+        # ride the SAME route as unprofiled ones, so on the forced
+        # 8-device platform a 2-shard jax search would take the SPMD
+        # mesh and report the fused launch instead of per-shard trees
+        # (that branch is covered in tests/test_profile.py)
+        idx = IndexService("pf", settings={
+            "number_of_shards": 2, "search.backend": "numpy",
+        })
         idx.index_doc("1", {"body": "hello profile"})
         idx.refresh()
         r = idx.search(

@@ -1,0 +1,191 @@
+"""Per-index search slow logs (`index.search.slowlog.threshold.*`).
+
+Contract under test:
+  * threshold "0" fires on EVERY request, "-1" (the default) is
+    silent — per phase (query/fetch), per level;
+  * the record is one-line JSON through the per-index stdlib logger
+    `index.search.slowlog.<index>` carrying took/shards/source/
+    opaque-id (+ profile summary when profiled);
+  * level selection picks the MOST SEVERE enabled threshold the took
+    meets (warn > info > debug > trace);
+  * thresholds are dynamic index settings (`_settings` update applies
+    without reopening the index) and firing counters surface in
+    `{index}/_stats`.
+"""
+
+import json
+import logging
+
+import pytest
+
+from elasticsearch_tpu.cluster.indices import IndexService
+from elasticsearch_tpu.common.slowlog import (
+    SearchSlowLog,
+    parse_threshold_ms,
+    pick_level,
+)
+
+
+class _Capture(logging.Handler):
+    def __init__(self):
+        super().__init__()
+        self.records = []
+
+    def emit(self, record):
+        self.records.append(record.getMessage())
+
+
+@pytest.fixture
+def capture():
+    """Attaches a capture handler to every slowlog logger created
+    during the test (the parent logger propagates)."""
+    root = logging.getLogger("index.search.slowlog")
+    h = _Capture()
+    root.addHandler(h)
+    root.setLevel(logging.DEBUG)
+    yield h
+    root.removeHandler(h)
+
+
+def make_index(name, thresholds=None):
+    settings = {"number_of_shards": 1}
+    for k, v in (thresholds or {}).items():
+        settings[f"search.slowlog.threshold.{k}"] = v
+    idx = IndexService(name, settings=settings)
+    for i in range(5):
+        idx.index_doc(str(i), {"body": f"hello doc {i}"})
+    idx.refresh()
+    return idx
+
+
+class TestThresholdParsing:
+    def test_parse_forms(self):
+        assert parse_threshold_ms("-1") == -1.0
+        assert parse_threshold_ms("0") == 0.0
+        assert parse_threshold_ms("500ms") == 500.0
+        assert parse_threshold_ms("2s") == 2000.0
+        assert parse_threshold_ms("1m") == 60000.0
+        assert parse_threshold_ms("250micros") == 0.25
+        assert parse_threshold_ms("10nanos") == pytest.approx(1e-5)
+        assert parse_threshold_ms("garbage") == -1.0
+        assert parse_threshold_ms(None) == -1.0
+
+    def test_pick_most_severe(self):
+        th = {"warn": 100.0, "info": 50.0, "debug": 10.0, "trace": -1.0}
+        assert pick_level(150.0, th) == "warn"
+        assert pick_level(60.0, th) == "info"
+        assert pick_level(20.0, th) == "debug"
+        assert pick_level(5.0, th) is None
+
+    def test_zero_always_fires_minus_one_never(self):
+        assert pick_level(0.0, {"warn": 0.0, "info": -1.0,
+                                "debug": -1.0, "trace": -1.0}) == "warn"
+        assert pick_level(1e9, {"warn": -1.0, "info": -1.0,
+                                "debug": -1.0, "trace": -1.0}) is None
+
+
+class TestSlowLogEmission:
+    def test_threshold_zero_fires_every_search(self, capture):
+        idx = make_index("sl-fire", {"query.warn": "0"})
+        try:
+            idx.search({"query": {"match": {"body": "hello"}}})
+            idx.search({"query": {"match_all": {}}})
+            assert len(capture.records) == 2
+            rec = json.loads(capture.records[0])
+            assert rec["type"] == "index_search_slowlog"
+            assert rec["level"] == "warn"
+            assert rec["phase"] == "query"
+            assert rec["index"] == "sl-fire"
+            assert rec["took_ms"] >= 0
+            assert rec["shards"] == 1
+            assert "match" in rec["source"]
+            counters = idx.stats()["primaries"]["search"]["slowlog"][
+                "counters"
+            ]
+            assert counters["query_warn"] == 2
+        finally:
+            idx.close()
+
+    def test_disabled_is_silent(self, capture):
+        idx = make_index("sl-off")  # defaults: every threshold -1
+        try:
+            idx.search({"query": {"match": {"body": "hello"}}})
+            assert capture.records == []
+            assert not idx._slowlog.enabled()
+        finally:
+            idx.close()
+
+    def test_fetch_phase_threshold(self, capture):
+        idx = make_index("sl-fetch", {"fetch.debug": "0"})
+        try:
+            idx.search({"query": {"match": {"body": "hello"}}})
+            recs = [json.loads(r) for r in capture.records]
+            assert [r["phase"] for r in recs] == ["fetch"]
+            assert recs[0]["level"] == "debug"
+        finally:
+            idx.close()
+
+    def test_profile_summary_rides_the_record(self, capture):
+        idx = make_index("sl-prof", {"query.info": "0"})
+        try:
+            idx.search({"query": {"match": {"body": "hello"}},
+                        "profile": True})
+            rec = json.loads(capture.records[0])
+            assert "profile" in rec
+            assert "phases_ns" in rec["profile"]
+        finally:
+            idx.close()
+
+    def test_most_severe_level_wins(self, capture):
+        idx = make_index("sl-sev", {"query.warn": "0", "query.trace": "0"})
+        try:
+            idx.search({"query": {"match_all": {}}})
+            rec = json.loads(capture.records[0])
+            assert rec["level"] == "warn"
+            counters = idx.stats()["primaries"]["search"]["slowlog"][
+                "counters"
+            ]
+            assert counters["query_warn"] == 1
+            assert counters["query_trace"] == 0
+        finally:
+            idx.close()
+
+
+class TestDynamicUpdate:
+    def test_settings_update_applies_live(self, capture):
+        from elasticsearch_tpu.cluster import ClusterService
+
+        cluster = ClusterService()
+        try:
+            cluster.create_index("sl-dyn", {
+                "settings": {"number_of_shards": 1},
+            })
+            idx = cluster.indices["sl-dyn"]
+            idx.index_doc("1", {"body": "hello"})
+            idx.refresh()
+            idx.search({"query": {"match_all": {}}})
+            assert capture.records == []
+            cluster.update_settings("sl-dyn", {
+                "index": {"search.slowlog.threshold.query.warn": "0"},
+            })
+            idx.search({"query": {"match_all": {}}})
+            assert len(capture.records) == 1
+            # back to disabled
+            cluster.update_settings("sl-dyn", {
+                "index": {"search.slowlog.threshold.query.warn": "-1"},
+            })
+            idx.search({"query": {"match_all": {}}})
+            assert len(capture.records) == 1
+        finally:
+            cluster.close()
+
+    def test_threshold_validation(self):
+        from elasticsearch_tpu.common.settings import (
+            validate_index_settings,
+        )
+
+        out = validate_index_settings(
+            {"search.slowlog.threshold.query.warn": "500ms"},
+            creating=True,
+        )
+        assert out["search.slowlog.threshold.query.warn"] == "500ms"

@@ -1,0 +1,210 @@
+"""Per-request span-tree tracing + X-Opaque-Id propagation.
+
+Contract under test:
+  * a search request arms a `Trace`; shard/coordinator seams add spans
+    with monotonic clocks and parent/child ids; the completed trace
+    lands in the bounded ring queryable via GET /_internal/traces;
+  * `X-Opaque-Id` propagates from the HTTP header into the task
+    description, the trace, and (via OPAQUE_ID_CTX) slow-log records;
+  * the ring is bounded (`ES_TPU_TRACE_RING`) and a single trace caps
+    at MAX_SPANS with an explicit dropped counter;
+  * `ES_TPU_TRACING=off` disables arming entirely.
+"""
+
+import json
+import time
+import urllib.request
+
+import pytest
+
+from elasticsearch_tpu.common import tracing
+
+
+@pytest.fixture(autouse=True)
+def _clean_ring():
+    tracing.clear()
+    yield
+    tracing.clear()
+
+
+class TestTraceCore:
+    def test_span_tree_parents_and_clocks(self):
+        tr = tracing.Trace("t")
+        t0 = time.perf_counter_ns()
+        root = tr.add_span("coordinator", t0, t0 + 1000, shards=2)
+        child = tr.add_span("fan_out", t0 + 100, t0 + 900, parent_id=root)
+        tr.finish()
+        d = tr.to_dict()
+        assert d["span_count"] == 2
+        by_id = {s["id"]: s for s in d["spans"]}
+        assert by_id[child]["parent_id"] == root
+        assert by_id[root]["parent_id"] is None
+        assert by_id[root]["duration_ns"] == 1000
+        assert by_id[root]["tags"] == {"shards": 2}
+
+    def test_span_scope_nesting(self):
+        tr = tracing.Trace("t")
+        with tr.span("outer"):
+            with tr.span("inner"):
+                pass
+        tr.finish()
+        spans = {s["name"]: s for s in tr.to_dict()["spans"]}
+        assert spans["inner"]["parent_id"] == spans["outer"]["id"]
+        assert spans["outer"]["parent_id"] is None
+
+    def test_max_spans_cap_counts_drops(self):
+        tr = tracing.Trace("t")
+        for i in range(tracing.MAX_SPANS + 10):
+            tr.add_span(f"s{i}", 0, 1)
+        tr.finish()
+        d = tr.to_dict()
+        assert d["span_count"] == tracing.MAX_SPANS
+        assert d["dropped_spans"] == 10
+
+    def test_ring_is_bounded_and_newest_first(self):
+        for i in range(5):
+            tr = tracing.Trace(f"t{i}")
+            tr.finish()
+        out = tracing.recent(3)
+        assert len(out) == 3
+        assert out[0]["name"] == "t4"  # newest first
+
+    def test_finish_publishes_once(self):
+        tr = tracing.Trace("once")
+        tr.finish()
+        tr.finish()
+        assert len(tracing.recent(50)) == 1
+
+    def test_begin_end_arm_the_contextvar(self):
+        handle = tracing.begin("req", index="i")
+        assert tracing.current() is not None
+        tracing.end(handle)
+        assert tracing.current() is None
+        assert tracing.recent(1)[0]["name"] == "req"
+
+    def test_disabled_via_env(self, monkeypatch):
+        monkeypatch.setenv("ES_TPU_TRACING", "off")
+        assert tracing.begin("req") is None
+        tracing.end(None)  # no-op
+        assert tracing.recent(5) == []
+
+
+class TestSearchTracing:
+    def test_search_records_coordinator_and_shard_spans(self):
+        from elasticsearch_tpu.cluster.indices import IndexService
+
+        # numpy backend pins the per-shard coordinator path (the jax
+        # multi-shard default can ride the SPMD mesh on the forced
+        # 8-device platform, which records a single mesh_search span)
+        idx = IndexService("tr-idx", settings={
+            "number_of_shards": 2, "search.backend": "numpy",
+        })
+        try:
+            for i in range(6):
+                idx.index_doc(str(i), {"body": f"hello {i}"})
+            idx.refresh()
+            handle = tracing.begin("search", index="tr-idx")
+            idx.search({"query": {"match": {"body": "hello"}}})
+            tracing.end(handle)
+            d = tracing.recent(1)[0]
+            names = {s["name"] for s in d["spans"]}
+            assert "coordinator" in names
+            assert "shard_search" in names
+            # per-shard spans from BOTH fan-out workers landed in the
+            # same trace (copied contexts share the Trace object)
+            shard_spans = [s for s in d["spans"]
+                           if s["name"] == "shard_search"]
+            assert len(shard_spans) == 2
+            assert {s["tags"]["shard"] for s in shard_spans} == {0, 1}
+            # coordinator phase children parent onto the root span
+            root = next(s for s in d["spans"]
+                        if s["name"] == "coordinator")
+            phases = [s for s in d["spans"]
+                      if s["parent_id"] == root["id"]]
+            assert {s["name"] for s in phases} >= {
+                "parse", "can_match", "dfs", "fan_out", "reduce",
+            }
+        finally:
+            idx.close()
+
+
+class TestRestSurface:
+    @pytest.fixture
+    def server(self):
+        from elasticsearch_tpu.rest.server import ElasticsearchTpuServer
+
+        srv = ElasticsearchTpuServer(port=0)
+        srv.start_background()
+        yield srv
+        srv.close()
+
+    def _call(self, server, method, path, body=None, headers=None):
+        url = f"http://127.0.0.1:{server.port}{path}"
+        data = json.dumps(body).encode() if body is not None else None
+        req = urllib.request.Request(
+            url, data=data, method=method,
+            headers={"Content-Type": "application/json", **(headers or {})},
+        )
+        with urllib.request.urlopen(req) as resp:
+            return resp.status, json.loads(resp.read() or b"null")
+
+    def test_traces_endpoint_and_opaque_id(self, server):
+        self._call(server, "PUT", "/tr-rest", {
+            "settings": {"number_of_shards": 1},
+        })
+        self._call(server, "POST", "/tr-rest/_doc/1?refresh=true",
+                   {"body": "hello"})
+        status, _ = self._call(
+            server, "POST", "/tr-rest/_search",
+            {"query": {"match": {"body": "hello"}}},
+            headers={"X-Opaque-Id": "caller-42"},
+        )
+        assert status == 200
+        status, out = self._call(server, "GET", "/_internal/traces?n=5")
+        assert status == 200
+        assert out["enabled"] is True
+        search_traces = [t for t in out["traces"] if t["name"] == "search"]
+        assert search_traces, f"no search trace in {out['traces']}"
+        tr = search_traces[0]
+        assert tr["opaque_id"] == "caller-42"
+        assert tr["tags"]["index"] == "tr-rest"
+        assert any(s["name"] == "coordinator" for s in tr["spans"])
+        # DELETE clears the ring
+        status, _ = self._call(server, "DELETE", "/_internal/traces")
+        assert status == 200
+        _, out = self._call(server, "GET", "/_internal/traces")
+        assert out["count"] == 0
+
+    def test_opaque_id_in_slowlog_record(self, server):
+        import logging
+
+        class Cap(logging.Handler):
+            def __init__(self):
+                super().__init__()
+                self.records = []
+
+            def emit(self, record):
+                self.records.append(record.getMessage())
+
+        cap = Cap()
+        root = logging.getLogger("index.search.slowlog")
+        root.addHandler(cap)
+        root.setLevel(logging.DEBUG)
+        try:
+            self._call(server, "PUT", "/tr-slow", {
+                "settings": {
+                    "number_of_shards": 1,
+                    "index.search.slowlog.threshold.query.warn": "0",
+                },
+            })
+            self._call(server, "POST", "/tr-slow/_doc/1?refresh=true",
+                       {"body": "hello"})
+            self._call(
+                server, "POST", "/tr-slow/_search",
+                {"query": {"match_all": {}}},
+                headers={"X-Opaque-Id": "tenant-7"},
+            )
+            recs = [json.loads(r) for r in cap.records]
+            assert any(r.get("opaque_id") == "tenant-7" for r in recs), recs
+        finally:
+            root.removeHandler(cap)
