@@ -111,6 +111,27 @@ def _request_scoped_error(e: BaseException) -> bool:
     return isinstance(e, ClusterError) and e.status < 500
 
 
+def _retriable_routing_error(e: BaseException) -> bool:
+    """Write failures worth re-resolving the owner for: the drained
+    relocation source's shard_not_in_primary_mode refusal, a copy the
+    routing table moved off the contacted node, a node that vanished
+    from the membership table, and plain transport failures (the owner
+    crashed — failover promotes a replica within the retry window).
+    Everything request-scoped (conflicts, validation, red shards)
+    propagates immediately."""
+    from ..transport.service import TransportError
+    from .allocation import RELOCATED_MARKER
+
+    if isinstance(e, TransportError):
+        return True
+    msg = str(e)
+    return (
+        RELOCATED_MARKER in msg
+        or "not allocated to" in msg
+        or "unknown node" in msg
+    )
+
+
 def _tree_has_range(q) -> bool:
     if isinstance(q, dsl.RangeQuery):
         return True
@@ -289,7 +310,13 @@ def norm_shard_routing(entry) -> dict:
     """Normalizes a routing-table entry to the replicated shape
     {"primary", "replicas", "in_sync", "primary_term"} (ShardRouting +
     the in-sync allocation set that IndexMetadata carries, SURVEY §2.6).
-    Pre-replication states stored a bare primary node id string."""
+    Pre-replication states stored a bare primary node id string.
+
+    An in-flight relocation rides an optional ``relocating`` key:
+    ``{"from": node, "to": node, "copy": "primary"|"replica"}`` — the
+    target already sits in ``replicas`` (not in-sync) and peer-recovers
+    like any initializing copy; the cutover in
+    TpuNode._handle_shard_started retires the source atomically."""
     if isinstance(entry, str):
         return {"primary": entry, "replicas": [], "in_sync": [entry],
                 "primary_term": 1}
@@ -297,12 +324,15 @@ def norm_shard_routing(entry) -> dict:
     in_sync = entry.get("in_sync")
     if in_sync is None:
         in_sync = [primary] if primary is not None else []
-    return {
+    out = {
         "primary": primary,
         "replicas": list(entry.get("replicas", [])),
         "in_sync": list(in_sync),
         "primary_term": int(entry.get("primary_term", 1)),
     }
+    if entry.get("relocating"):
+        out["relocating"] = dict(entry["relocating"])
+    return out
 
 
 def _reader_locations(ex) -> Dict[str, Tuple[int, int]]:
@@ -382,6 +412,14 @@ class IndexService:
         # during peer recovery, before they enter the in-sync set
         # (ReplicationTracker.initiateTracking)
         self._tracked: Dict[int, set] = {}
+        # relocation handoff gate (IndexShardOperationPermits +
+        # relocated-state, radically simplified): per-shard in-flight
+        # write counts, plus the shards whose primary has completed the
+        # relocation drain — writes there are refused with a retryable
+        # marker until the cutover state lands (or the relocation dies)
+        self._op_permits: Dict[int, int] = {}
+        self._handed_off: set = set()
+        self._permit_cond = threading.Condition()
         # round-robin cursor for in-sync copy selection on search
         # (adaptive replica selection, radically simplified)
         self._ars_cursor = 0
@@ -627,6 +665,23 @@ class IndexService:
             return self.local_node
         return cands[0]
 
+    def _reresolve_copy(self, sid: int, exclude, e) -> Optional[str]:
+        """Last-resort read-copy re-resolution for topology races: a
+        relocation cutover (or failover) can retire the only copy a
+        stale coordinator knows about — `_retry_copy` then has nowhere
+        to go even though a freshly-promoted copy exists.  For transport
+        / allocation-shaped failures only, wait briefly for the next
+        cluster state to land here and pick again, so searches ride
+        through the publish window instead of failing."""
+        if self.routing is None or not _retriable_routing_error(e):
+            return None
+        for _ in range(8):
+            time.sleep(0.05)
+            cand = self._search_node(sid)
+            if cand is not None and cand not in exclude:
+                return cand
+        return None
+
     def _note_shard_failed(self, sid: int, node: Optional[str]) -> None:
         """Best-effort master notification that a remote copy failed a
         read (mirrors the write path's _report_shard_failed)."""
@@ -654,6 +709,55 @@ class IndexService:
 
     def add_tracked(self, sid: int, node: str) -> None:
         self._tracked.setdefault(sid, set()).add(node)
+
+    # ---- relocation handoff permits (IndexShardOperationPermits) ----
+
+    def begin_shard_op(self, sid: int) -> None:
+        """Takes a write permit on a locally-primaried shard; refused
+        with a retryable 503 once the relocation drain has completed
+        (ES: ShardNotInPrimaryModeException — the coordinator re-resolves
+        the owner and retries against the promoted target)."""
+        from .allocation import RELOCATED_MARKER
+        from .service import ClusterError
+
+        with self._permit_cond:
+            if sid in self._handed_off:
+                raise ClusterError(
+                    503,
+                    f"{RELOCATED_MARKER}: shard [{self.name}][{sid}] has "
+                    "handed off its primary during relocation; retry",
+                    "shard_not_in_primary_mode_exception",
+                )
+            self._op_permits[sid] = self._op_permits.get(sid, 0) + 1
+
+    def end_shard_op(self, sid: int) -> None:
+        with self._permit_cond:
+            left = self._op_permits.get(sid, 0) - 1
+            if left <= 0:
+                self._op_permits.pop(sid, None)
+            else:
+                self._op_permits[sid] = left
+            self._permit_cond.notify_all()
+
+    def drain_for_handoff(self, sid: int, timeout: float = 10.0) -> bool:
+        """Relocation cutover, source side: block NEW writes on the
+        shard, then wait for in-flight write handlers (local apply +
+        synchronous replica fan-out, which includes the recovery-tracked
+        relocation target) to finish.  After this returns, every acked
+        op lives on the target — the shard-started report that follows
+        makes the cutover a single atomic state publish."""
+        with self._permit_cond:
+            self._handed_off.add(sid)
+            return self._permit_cond.wait_for(
+                lambda: self._op_permits.get(sid, 0) == 0, timeout
+            )
+
+    def is_handed_off(self, sid: int) -> bool:
+        return sid in self._handed_off
+
+    def clear_handoff(self, sid: int) -> None:
+        with self._permit_cond:
+            self._handed_off.discard(sid)
 
     @property
     def shards(self) -> List[ShardEngine]:
@@ -715,6 +819,21 @@ class IndexService:
                     if tracked:
                         tracked &= set(e["replicas"]) - set(e["in_sync"])
         self._local = local
+        # a handoff gate stays closed only while ITS relocation is still
+        # in flight: the cutover routes the shard away (engine closed
+        # above), while a cancelled relocation / dead target leaves this
+        # node primary with no relocating marker — writes must resume
+        if self._handed_off:
+            with self._permit_cond:
+                for sid in list(self._handed_off):
+                    e = self._entry(sid)
+                    if (
+                        e is None
+                        or not e.get("relocating")
+                        or e["primary"] != self.local_node
+                    ):
+                        self._handed_off.discard(sid)
+                self._permit_cond.notify_all()
 
     def recovery_needed(self) -> List[int]:
         """Locally-assigned replica shards that are not yet in-sync —
@@ -749,26 +868,40 @@ class IndexService:
         Returns wire-shaped result dicts (TransportShardBulkAction)."""
         if self.routing is None:
             return apply_shard_ops(self.local_shard(sid), ops)
-        owner = self._owner(sid)
-        if owner is None:
-            # red shard: every copy died — refuse the write instead of
-            # acking it into a stale local replica (ES: 503 unavailable)
-            from .service import ClusterError
+        from .service import ClusterError
 
-            raise ClusterError(
-                503,
-                f"primary shard [{self.name}][{sid}] is not active",
-                "unavailable_shards_exception",
-            )
-        # distributed mode always rides the handler seam — even for the
-        # local owner (remote_call short-circuits) — because the handler
-        # is where dynamic-mapping updates round-trip to the master
-        out = self.remote_call(
-            owner,
-            ACTION_SHARD_OPS,
-            {"index": self.name, "shard": sid, "ops": ops},
-        )
-        return out["results"]
+        # bounded retry with owner re-resolution (TransportReplication-
+        # Action's retryable ReplicationOperation failures): a relocation
+        # cutover refuses writes at the drained source for the few ms
+        # until the new routing lands here — the retry hides the window,
+        # so clients never see a serving gap on topology changes
+        last: Optional[Exception] = None
+        for attempt in range(60):
+            owner = self._owner(sid)
+            if owner is None:
+                # red shard: every copy died — refuse the write instead
+                # of acking it into a stale local replica (ES: 503)
+                raise ClusterError(
+                    503,
+                    f"primary shard [{self.name}][{sid}] is not active",
+                    "unavailable_shards_exception",
+                )
+            # distributed mode always rides the handler seam — even for
+            # the local owner (remote_call short-circuits) — because the
+            # handler is where dynamic-mapping updates round-trip
+            try:
+                out = self.remote_call(
+                    owner,
+                    ACTION_SHARD_OPS,
+                    {"index": self.name, "shard": sid, "ops": ops},
+                )
+                return out["results"]
+            except Exception as e:
+                if attempt == 59 or not _retriable_routing_error(e):
+                    raise
+                last = e
+                time.sleep(0.05)
+        raise last  # pragma: no cover - loop always returns or raises
 
     def _one_op(self, sid: int, op: dict) -> OpResult:
         r = self._shard_ops(sid, [op])[0]
@@ -2100,6 +2233,10 @@ class IndexService:
                         ),
                     )
                 alt = self._retry_copy(sid, exclude={owner})
+                if alt is None:
+                    # stale routing (relocation cutover / failover mid-
+                    # publish): wait for the next state and pick again
+                    alt = self._reresolve_copy(sid, {owner}, e)
                 if alt is not None:
                     # node-wide retry budget (token bucket fed by live
                     # admitted traffic): during an incident, replica
@@ -3657,6 +3794,10 @@ class IndexService:
                     raise
                 self._note_shard_failed(sid, owner)
                 alt = self._retry_copy(sid, exclude={owner})
+                if alt is None:
+                    # stale routing (relocation cutover / failover):
+                    # wait for the next state and pick again
+                    alt = self._reresolve_copy(sid, {owner}, e)
                 if alt is not None:
                     if not admission.retry_allowed():
                         # node-wide retry budget: same cap as _fan_out

@@ -40,6 +40,7 @@ from ..common.faults import InjectedFault, faults
 from ..common.settings import SettingsError, validate_index_settings
 from ..index.translog import bump_durability_stat
 from ..index.mapping import MappingParseError, Mappings
+from .allocation import RELOCATED_MARKER, bump_relocation_stat
 from .indices import (
     ACTION_CTX_CLOSE,
     ACTION_CTX_OPEN,
@@ -163,6 +164,26 @@ class DistributedClusterService(ClusterService):
     def delete_pipeline(self, pid: str) -> dict:
         return self.node.master_request("cluster:pipeline/delete", {"id": pid})
 
+    def update_cluster_settings(self, body: dict) -> dict:
+        """Dynamic cluster settings ride the master and publish with the
+        state, so every node's deciders/rebalancer see the same values
+        (ClusterUpdateSettingsAction → state publication)."""
+        return self.node.master_request("cluster:settings/update", body or {})
+
+    def reroute(self, body: Optional[dict] = None, dry_run: bool = False) -> dict:
+        """POST /_cluster/reroute: explicit move / cancel /
+        allocate_replica commands against the master's routing table."""
+        payload = dict(body or {})
+        payload["dry_run"] = bool(dry_run)
+        return self.node.master_request("cluster:reroute", payload)
+
+    def allocation_explain(self, body: Optional[dict] = None) -> dict:
+        """GET /_cluster/allocation/explain: per-node decider verdicts
+        for an unassigned or relocating shard."""
+        return self.node.master_request(
+            "cluster:allocation/explain", body or {}
+        )
+
     def get_or_autocreate(self, name: str) -> IndexService:
         """Unlike the single-node base, this must NOT hold the service
         lock across the master round-trip (the publish-apply thread
@@ -188,6 +209,14 @@ class DistributedClusterService(ClusterService):
         state: creates/updates/removes IndexService instances, replaces
         alias and template metadata, and kicks off peer recoveries for
         newly-assigned out-of-sync replica copies."""
+        cs = state.get("cluster_settings")
+        if cs is not None:
+            # dynamic cluster settings ride the published state so every
+            # node's deciders see the same values; load_layers only fires
+            # consumers for keys whose effective value changed
+            self.cluster_settings.load_layers(
+                cs.get("persistent") or {}, cs.get("transient") or {}
+            )
         self.aliases = state.get("aliases", {})
         self.templates = state.get("templates", {})
         self.repositories = state.get("repositories", {})
@@ -279,16 +308,20 @@ class DistributedClusterService(ClusterService):
                 )
         idx.refresh()
 
-    def health(self) -> dict:
+    def _health_snapshot(self) -> dict:
         """Shard-level red/yellow/green from the routing table
         (TransportClusterHealthAction): red = a shard with no live
-        primary, yellow = desired replicas missing or out of sync."""
+        primary, yellow = desired replicas missing or out of sync.
+        A relocation target counts as `relocating_shards` — NOT as
+        initializing or missing, so a drain keeps the cluster green
+        (the source copy is still active and serving)."""
         state = self.node.state
         n_nodes = len(state.get("nodes", {}))
         active_primaries = 0
         active_shards = 0
         unassigned = 0
         initializing = 0
+        relocating = 0
         status = "green"
         for meta in state.get("indices", {}).values():
             desired = int(
@@ -306,7 +339,14 @@ class DistributedClusterService(ClusterService):
                     n for n in entry["replicas"] if n in entry["in_sync"]
                 ]
                 active_shards += len(in_sync_replicas)
-                recovering = len(entry["replicas"]) - len(in_sync_replicas)
+                out_of_sync = [
+                    n for n in entry["replicas"] if n not in entry["in_sync"]
+                ]
+                rel_target = (entry.get("relocating") or {}).get("to")
+                if rel_target in out_of_sync:
+                    relocating += 1
+                    out_of_sync.remove(rel_target)
+                recovering = len(out_of_sync)
                 initializing += recovering
                 missing = desired - len(in_sync_replicas)
                 if missing > 0:
@@ -322,7 +362,7 @@ class DistributedClusterService(ClusterService):
             "number_of_data_nodes": n_nodes,
             "active_primary_shards": active_primaries,
             "active_shards": active_shards,
-            "relocating_shards": 0,
+            "relocating_shards": relocating,
             "initializing_shards": initializing,
             "unassigned_shards": unassigned,
             "delayed_unassigned_shards": 0,
@@ -352,6 +392,7 @@ class TpuNode:
         port: int = 0,
         fd_interval: float = 1.0,
         fd_retries: int = 3,
+        rebalance_interval: Optional[float] = None,
     ):
         self.name = name
         self.seeds = [tuple(s) for s in (seeds or [])]
@@ -363,6 +404,11 @@ class TpuNode:
         self._fd_stop = threading.Event()
         self._fd_thread: Optional[threading.Thread] = None
         self._fd_failures: Dict[str, int] = {}
+        # background rebalancer cadence (BalancedShardsAllocator): only
+        # the elected master acts on a tick. Opt-in — when None, tests
+        # and operators drive rebalance_tick() / reroute explicitly.
+        self.rebalance_interval = rebalance_interval
+        self._rebalance_thread: Optional[threading.Thread] = None
         # fresh per process start — the allocation-id analog that lets
         # the master tell a restarted node from a live one on re-join
         self.incarnation = _uuidlib.uuid4().hex[:12]
@@ -452,6 +498,13 @@ class TpuNode:
             target=self._fd_loop, name=f"fd-{self.name}", daemon=True
         )
         self._fd_thread.start()
+        if self.rebalance_interval:
+            self._rebalance_thread = threading.Thread(
+                target=self._rebalance_loop,
+                name=f"rebalance-{self.name}",
+                daemon=True,
+            )
+            self._rebalance_thread.start()
         return self
 
     def close(self):
@@ -461,6 +514,8 @@ class TpuNode:
         self._fd_stop.set()
         if self._fd_thread is not None:
             self._fd_thread.join(timeout=5.0)
+        if self._rebalance_thread is not None:
+            self._rebalance_thread.join(timeout=5.0)
         self.cluster.close()
         self.transport.close()
 
@@ -479,6 +534,8 @@ class TpuNode:
         self._fd_stop.set()
         if self._fd_thread is not None:
             self._fd_thread.join(timeout=5.0)
+        if self._rebalance_thread is not None:
+            self._rebalance_thread.join(timeout=5.0)
         self.transport.close()
         for idx in list(self.cluster.indices.values()):
             try:
@@ -598,6 +655,16 @@ class TpuNode:
         t.register_handler(
             "cluster:pipeline/delete", self._handle_pipeline_delete
         )
+        t.register_handler("cluster:reroute", self._handle_reroute)
+        t.register_handler(
+            "cluster:allocation/explain", self._handle_allocation_explain
+        )
+        t.register_handler(
+            "cluster:settings/update", self._handle_settings_update
+        )
+        t.register_handler(
+            "internal:relocation/handoff", self._handle_relocation_handoff
+        )
 
     # ---- membership + publication ----
 
@@ -620,7 +687,7 @@ class TpuNode:
                 _demote_node_copies(new, p["node"])
             # a (re)joining node is a fresh allocation target for any
             # under-replicated shard (AllocationService.reroute on join)
-            _fill_replicas(new)
+            _fill_replicas(new, self.cluster.cluster_settings)
             new["version"] += 1
             self._publish(new)
             return self.state
@@ -721,6 +788,21 @@ class TpuNode:
                     self._check_master()
             except Exception:
                 pass  # the checker must survive anything a tick throws
+            try:
+                # recoveries are normally scheduled when a routing
+                # change applies; when one fails its in-place retries
+                # (injected faults, a source briefly unreachable) no
+                # further routing change may ever come — a relocation
+                # target stuck out of the in-sync set would also pin
+                # the rebalance budget forever. Re-offer needed
+                # recoveries every tick; schedule_recoveries dedupes
+                # against the ones already running.
+                for name, idx in list(self.cluster.indices.items()):
+                    needs = idx.recovery_needed()
+                    if needs:
+                        self.schedule_recoveries(name, needs)
+            except Exception:
+                pass
 
     def _check_followers(self):
         """Master pings every follower; a stale version gets the current
@@ -846,7 +928,7 @@ class TpuNode:
             new = _copy_state(self.state)
             new["master"] = self.name
             _remove_node_from_state(new, dead_master)
-            _fill_replicas(new)
+            _fill_replicas(new, self.cluster.cluster_settings)
             new["version"] += 1
             self._publish(new)
 
@@ -859,7 +941,7 @@ class TpuNode:
                 return
             new = _copy_state(self.state)
             _remove_node_from_state(new, nid)
-            _fill_replicas(new)
+            _fill_replicas(new, self.cluster.cluster_settings)
             new["version"] += 1
             self._publish(new)
 
@@ -893,17 +975,30 @@ class TpuNode:
                     entry["replicas"].remove(promote[0])
                     entry["primary_term"] += 1
                 changed = True
+            rel = entry.get("relocating") or {}
+            if node in (rel.get("from"), rel.get("to")):
+                # the relocation lost an endpoint: abandon it. A failed
+                # TARGET leaves the still-serving source untouched; a
+                # failed SOURCE leaves the target as a plain initializing
+                # replica that recovers from the promoted primary.
+                entry.pop("relocating", None)
+                bump_relocation_stat("failed")
+                changed = True
             if not changed:
                 return {"acknowledged": True}
             new["indices"][name]["routing"][sid] = entry
-            _fill_replicas(new)
+            _fill_replicas(new, self.cluster.cluster_settings)
             new["version"] += 1
             self._publish(new)
             return {"acknowledged": True}
 
     def _handle_shard_started(self, p: dict) -> dict:
-        """A peer-recovered replica reports readiness: join the in-sync
-        set (ShardStateAction.shardStarted)."""
+        """A peer-recovered copy reports readiness
+        (ShardStateAction.shardStarted). A plain replica joins the
+        in-sync set; a relocation TARGET triggers the atomic cutover:
+        ONE publish joins it in-sync and retires the source — never a
+        serving gap, never two writable copies (the source already
+        drained its write permits during the handoff)."""
         with self._state_lock:
             self._require_master()
             name, sid, node = p["index"], str(p["shard"]), p["node"]
@@ -913,8 +1008,28 @@ class TpuNode:
             new = _copy_state(self.state)
             entry = norm_shard_routing(new["indices"][name]["routing"][sid])
             if node not in entry["replicas"] and entry["primary"] != node:
-                entry["replicas"].append(node)
-            if node not in entry["in_sync"]:
+                # stale report: the copy was cancelled / failed out of
+                # the routing table while its recovery thread was still
+                # running — re-adding it would resurrect a retired copy
+                return {"acknowledged": False, "reason": "not an assigned copy"}
+            rel = entry.get("relocating") or {}
+            if rel.get("to") == node:
+                src = rel.get("from")
+                if node not in entry["in_sync"]:
+                    entry["in_sync"].append(node)
+                if rel.get("copy") == "primary" and entry["primary"] == src:
+                    # the target becomes the primary under a new term;
+                    # the drained source retires entirely
+                    entry["primary"] = node
+                    entry["replicas"].remove(node)
+                    entry["primary_term"] += 1
+                if src in entry["replicas"]:
+                    entry["replicas"].remove(src)
+                if src in entry["in_sync"]:
+                    entry["in_sync"].remove(src)
+                entry.pop("relocating", None)
+                bump_relocation_stat("completed")
+            elif node not in entry["in_sync"]:
                 entry["in_sync"].append(node)
             new["indices"][name]["routing"][sid] = entry
             new["version"] += 1
@@ -944,6 +1059,11 @@ class TpuNode:
             raise NodeError(
                 f"[{self.name}] is not the primary for [{p['index']}][{sid}]"
             )
+        rel = (idx._entry(sid) or {}).get("relocating") or {}
+        if rel.get("to") == p.get("target"):
+            # relocation phase 1 kicking off on the SOURCE: chaos site
+            faults.check("relocation.start", index=p["index"], shard=sid,
+                         node=self.name, role="source")
         if eng.path is None:
             return {"mode": "ops"}
         import base64
@@ -975,6 +1095,11 @@ class TpuNode:
             raise NodeError(
                 f"[{self.name}] is not the primary for [{p['index']}][{sid}]"
             )
+        rel = (idx._entry(sid) or {}).get("relocating") or {}
+        if rel.get("to") == p.get("target"):
+            # relocation ops-diff transfer on the SOURCE: chaos site
+            faults.check("relocation.transfer", index=p["index"], shard=sid,
+                         node=self.name, role="source")
         local_seq = int(p["local_seq"])
         with eng._lock:
             # at-least-once delivery: a re-delivered finalize (the target
@@ -1057,14 +1182,23 @@ class TpuNode:
             entry is None
             or entry["primary"] in (None, self.name)
             or self.name in entry["in_sync"]
+            or self.name not in entry["replicas"]
         ):
+            # the last clause: a cancelled relocation (or a copy failed
+            # out of the table) must not resurrect through a recovery
+            # thread that was already in flight
             return
+        rel = entry.get("relocating") or {}
+        relocating_here = rel.get("to") == self.name
         primary = entry["primary"]
         if first_attempt:
             # retries of the same recovery are counted in
             # recovery_retries, not as fresh starts — so the lifecycle
             # invariant started == completed + failed holds
             bump_durability_stat("recoveries_started")
+        if relocating_here:
+            faults.check("relocation.start", index=index_name, shard=sid,
+                         node=self.name, role="target")
         # phase-1 transfer failing (network, primary mid-restart, an
         # injected fault) must leave the copy OUT of the in-sync set —
         # the retry loop / next routing change re-runs the whole phase
@@ -1075,16 +1209,24 @@ class TpuNode:
             "internal:recovery/start",
             {"index": index_name, "shard": sid, "target": self.name},
         )
+        if relocating_here:
+            faults.check("relocation.transfer", index=index_name, shard=sid,
+                         node=self.name, role="target")
         shard_path = idx.begin_peer_recovery(sid)
         if out.get("mode") == "files" and shard_path is not None:
             import base64
 
-            for rel, b64 in out["files"].items():
-                full = os.path.join(shard_path, rel)
+            nbytes = 0
+            for relpath, b64 in out["files"].items():
+                full = os.path.join(shard_path, relpath)
                 os.makedirs(os.path.dirname(full), exist_ok=True)
+                data = base64.b64decode(b64)
                 with open(full, "wb") as f:
-                    f.write(base64.b64decode(b64))
+                    f.write(data)
+                nbytes += len(data)
             bump_durability_stat("recovered_files", len(out["files"]))
+            if relocating_here:
+                bump_relocation_stat("bytes", nbytes)
         eng = idx.finish_peer_recovery(sid)
         faults.check("recovery.finalize", index=index_name, shard=sid,
                      node=self.name)
@@ -1107,6 +1249,20 @@ class TpuNode:
                 eng.delete_replica(op["id"], op["version"], op["seq_no"])
         bump_durability_stat("recovered_ops", len(fin["ops"]))
         eng.refresh()
+        if relocating_here:
+            # ES-style handoff: before reporting started, ask the source
+            # to drain its write permits — between this call returning
+            # and the cutover publish there is exactly one writable copy
+            # (this already-tracked target). Writes reaching the drained
+            # source get a retryable shard_not_in_primary_mode and
+            # re-resolve to the new owner.
+            faults.check("relocation.handoff", index=index_name, shard=sid,
+                         node=self.name, role="target")
+            self.remote_call(
+                rel.get("from") or primary,
+                "internal:relocation/handoff",
+                {"index": index_name, "shard": sid, "target": self.name},
+            )
         bump_durability_stat("recoveries_completed")
         # the started report must land — a swallowed failure would strand
         # a fully-recovered copy out of the in-sync set forever (the fd
@@ -1429,6 +1585,301 @@ class TpuNode:
             return {"acknowledged": True}
 
     # ------------------------------------------------------------------
+    # cluster elasticity: reroute commands, allocation explain, dynamic
+    # cluster settings, relocation handoff, background rebalancer
+    # ------------------------------------------------------------------
+
+    def _handle_settings_update(self, p: dict) -> dict:
+        """PUT /_cluster/settings on the master: validate + update the
+        store, embed both layers in the state, publish — every node's
+        store reloads in apply_state (ClusterUpdateSettingsAction)."""
+        with self._state_lock:
+            self._require_master()
+            try:
+                out = self.cluster.cluster_settings.update(p or {})
+            except SettingsError as e:
+                raise ClusterError(400, str(e), "illegal_argument_exception")
+            store = self.cluster.cluster_settings
+            new = _copy_state(self.state)
+            new["cluster_settings"] = {
+                "persistent": dict(store.persistent),
+                "transient": dict(store.transient),
+            }
+            new["version"] += 1
+            self._publish(new)
+            return out
+
+    def _routing_entry(self, state: dict, name, sid: str) -> dict:
+        meta = (state.get("indices") or {}).get(name)
+        if meta is None:
+            raise IndexNotFoundError(str(name))
+        raw = (meta.get("routing") or {}).get(sid)
+        if raw is None:
+            raise ClusterError(
+                400,
+                f"no shard [{sid}] in index [{name}]",
+                "illegal_argument_exception",
+            )
+        entry = norm_shard_routing(raw)
+        meta["routing"][sid] = entry
+        return entry
+
+    def _handle_reroute(self, p: dict) -> dict:
+        """POST /_cluster/reroute: move / cancel / allocate_replica.
+        Explicit operator commands run the deciders with the enable
+        decider bypassed (RoutingAllocation.ignoreDisabled); the
+        background rebalancer calls in with explicit=False so
+        `cluster.routing.allocation.enable` is honored."""
+        with self._state_lock:
+            self._require_master()
+            p = p or {}
+            dry_run = bool(p.get("dry_run"))
+            explicit = bool(p.get("explicit", True))
+            commands = p.get("commands") or []
+            if not isinstance(commands, list) or not commands:
+                raise ClusterError(
+                    400,
+                    "reroute requires a non-empty [commands] list",
+                    "illegal_argument_exception",
+                )
+            new = _copy_state(self.state)
+            explanations: List[dict] = []
+            for cmd in commands:
+                if not isinstance(cmd, dict) or len(cmd) != 1:
+                    raise ClusterError(
+                        400,
+                        "malformed reroute command",
+                        "illegal_argument_exception",
+                    )
+                op, spec = next(iter(cmd.items()))
+                if op == "move":
+                    explanations.append(self._cmd_move(
+                        new, spec or {}, explicit=explicit, dry_run=dry_run))
+                elif op == "cancel":
+                    explanations.append(self._cmd_cancel(
+                        new, spec or {}, dry_run=dry_run))
+                elif op == "allocate_replica":
+                    explanations.append(self._cmd_allocate_replica(
+                        new, spec or {}, explicit=explicit, dry_run=dry_run))
+                else:
+                    raise ClusterError(
+                        400,
+                        f"unknown reroute command [{op}]",
+                        "illegal_argument_exception",
+                    )
+            if not dry_run:
+                new["version"] += 1
+                self._publish(new)
+            return {
+                "acknowledged": True,
+                "dry_run": dry_run,
+                "explanations": explanations,
+                "state_version": self.state["version"],
+            }
+
+    def _cmd_move(self, new: dict, spec: dict, *, explicit: bool,
+                  dry_run: bool) -> dict:
+        from . import allocation as alloc
+
+        name, sid = spec.get("index"), str(spec.get("shard"))
+        src, dst = spec.get("from_node"), spec.get("to_node")
+        entry = self._routing_entry(new, name, sid)
+        if dst not in new["nodes"]:
+            raise ClusterError(
+                400, f"unknown target node [{dst}]",
+                "illegal_argument_exception",
+            )
+        if entry.get("relocating"):
+            raise ClusterError(
+                400,
+                f"[move] shard [{name}][{sid}] is already relocating",
+                "illegal_argument_exception",
+            )
+        if entry["primary"] == src:
+            kind = "primary"
+        elif src in entry["replicas"]:
+            if src not in entry["in_sync"]:
+                raise ClusterError(
+                    400,
+                    f"[move] copy of [{name}][{sid}] on [{src}] is still "
+                    "initializing; cancel it or wait for recovery",
+                    "illegal_argument_exception",
+                )
+            kind = "replica"
+        else:
+            raise ClusterError(
+                400,
+                f"[move] node [{src}] holds no copy of [{name}][{sid}]",
+                "illegal_argument_exception",
+            )
+        ok, decisions = alloc.can_allocate(
+            self.cluster.cluster_settings, new, entry, dst, copy=kind,
+            explicit=explicit, moving_from=src)
+        if not ok:
+            blockers = "; ".join(
+                d["explanation"] for d in decisions if d["decision"] == "NO")
+            raise ClusterError(
+                400,
+                f"[move] cannot place [{name}][{sid}] on [{dst}]: {blockers}",
+                "illegal_argument_exception",
+            )
+        # the target joins as an out-of-sync replica and peer-recovers
+        # off the normal transfer path; the marker drives the cutover
+        entry["replicas"].append(dst)
+        entry["relocating"] = {"from": src, "to": dst, "copy": kind}
+        new["indices"][name]["routing"][sid] = entry
+        if not dry_run:
+            bump_relocation_stat("started")
+        return {"command": "move", "index": name, "shard": int(sid),
+                "from_node": src, "to_node": dst, "copy": kind,
+                "decisions": decisions}
+
+    def _cmd_cancel(self, new: dict, spec: dict, *, dry_run: bool) -> dict:
+        name, sid = spec.get("index"), str(spec.get("shard"))
+        entry = self._routing_entry(new, name, sid)
+        rel = entry.get("relocating")
+        if not rel:
+            raise ClusterError(
+                400,
+                f"[cancel] shard [{name}][{sid}] is not relocating",
+                "illegal_argument_exception",
+            )
+        entry.pop("relocating", None)
+        tgt = rel.get("to")
+        if tgt in entry["replicas"]:
+            entry["replicas"].remove(tgt)
+        if tgt in entry["in_sync"]:
+            entry["in_sync"].remove(tgt)
+        new["indices"][name]["routing"][sid] = entry
+        if not dry_run:
+            bump_relocation_stat("cancelled")
+        return {"command": "cancel", "index": name, "shard": int(sid),
+                "cancelled": rel}
+
+    def _cmd_allocate_replica(self, new: dict, spec: dict, *,
+                              explicit: bool, dry_run: bool) -> dict:
+        from . import allocation as alloc
+
+        name, sid = spec.get("index"), str(spec.get("shard"))
+        node = spec.get("node")
+        entry = self._routing_entry(new, name, sid)
+        if node not in new["nodes"]:
+            raise ClusterError(
+                400, f"unknown target node [{node}]",
+                "illegal_argument_exception",
+            )
+        if entry["primary"] is None:
+            raise ClusterError(
+                400,
+                f"[allocate_replica] shard [{name}][{sid}] has no live "
+                "primary to recover from",
+                "illegal_argument_exception",
+            )
+        ok, decisions = alloc.can_allocate(
+            self.cluster.cluster_settings, new, entry, node,
+            copy="replica", explicit=explicit)
+        if not ok:
+            blockers = "; ".join(
+                d["explanation"] for d in decisions if d["decision"] == "NO")
+            raise ClusterError(
+                400,
+                f"[allocate_replica] cannot place [{name}][{sid}] on "
+                f"[{node}]: {blockers}",
+                "illegal_argument_exception",
+            )
+        entry["replicas"].append(node)
+        new["indices"][name]["routing"][sid] = entry
+        return {"command": "allocate_replica", "index": name,
+                "shard": int(sid), "node": node, "decisions": decisions}
+
+    def _handle_allocation_explain(self, p: dict) -> dict:
+        from . import allocation as alloc
+
+        with self._state_lock:
+            self._require_master()
+            p = p or {}
+            name, sid = p.get("index"), p.get("shard")
+            if name is None or sid is None:
+                # ES explains the first unassigned/relocating/initializing
+                # shard when the body names none
+                for iname, s, raw in alloc.iter_routing(self.state):
+                    entry = norm_shard_routing(raw)
+                    if (entry["primary"] is None or entry.get("relocating")
+                            or set(entry["replicas"]) - set(entry["in_sync"])):
+                        name, sid = iname, s
+                        break
+                if name is None:
+                    raise ClusterError(
+                        400,
+                        "unable to find any unassigned or relocating "
+                        "shards to explain; specify [index] and [shard]",
+                        "illegal_argument_exception",
+                    )
+            try:
+                return alloc.explain_allocation(
+                    self.cluster.cluster_settings, self.state,
+                    name, str(sid))
+            except KeyError as e:
+                raise ClusterError(
+                    404, str(e).strip("'"), "resource_not_found_exception"
+                )
+
+    def _handle_relocation_handoff(self, p: dict) -> dict:
+        """Source side of the relocation cutover: refuse new writes and
+        wait out the in-flight write permits, so between this return and
+        the cutover publish there is exactly one writable copy (ES
+        IndexShard.relocated() + ShardNotInPrimaryModeException). The
+        fault site fires BEFORE the drain — an injected error/crash
+        leaves the source still serving writes cleanly."""
+        idx = self._index_service(p["index"])
+        sid = int(p["shard"])
+        faults.check("relocation.handoff", index=p["index"], shard=sid,
+                     node=self.name, role="source")
+        if idx._owner(sid) != self.name:
+            # replica-copy relocation: the primary (elsewhere) keeps
+            # fanning ops out to the tracked target — nothing to drain
+            return {"drained": True, "handoff_ms": 0.0}
+        t0 = time.perf_counter()
+        drained = idx.drain_for_handoff(sid)
+        ms = (time.perf_counter() - t0) * 1000.0
+        bump_relocation_stat("handoffs")
+        bump_relocation_stat("handoff_time_in_millis", ms)
+        return {"drained": bool(drained), "handoff_ms": ms}
+
+    def _rebalance_loop(self):
+        while not self._fd_stop.wait(self.rebalance_interval):
+            if self._closed:
+                return
+            try:
+                self.rebalance_tick()
+            except Exception:
+                pass  # next tick re-plans from fresh state
+
+    def rebalance_tick(self) -> List[dict]:
+        """One rebalancer pass (public so tests and the smoke script can
+        drive convergence deterministically): plan moves under the
+        deciders, then start each through the same reroute state machine
+        operators use — with explicit=False, so
+        `cluster.routing.allocation.enable` and the exclude filters are
+        honored (that is what makes a drain converge and `none` freeze
+        the layout)."""
+        if not self.is_master() or self._quorum_lost or self._closed:
+            return []
+        from . import allocation as alloc
+
+        with self._state_lock:
+            moves = alloc.plan_rebalance(
+                self.cluster.cluster_settings, self.state)
+        applied: List[dict] = []
+        for mv in moves:
+            try:
+                self._handle_reroute({"commands": [mv], "explicit": False})
+                applied.append(mv)
+            except (ClusterError, NodeError):
+                continue  # racing topology change; re-planned next tick
+        return applied
+
+    # ------------------------------------------------------------------
     # shard-level handlers (the owning-node side of the IndexService
     # remote actions)
     # ------------------------------------------------------------------
@@ -1467,55 +1918,77 @@ class TpuNode:
             raise NodeError(
                 f"shard [{p['index']}][{sid}] not allocated to [{self.name}]"
             )
-        results = apply_shard_ops(eng, p["ops"])
-        # ---- replication fan-out (ReplicationOperation.execute): the
-        # primary forwards seqno-stamped ops to every in-sync/tracked
-        # copy and only acks once they respond; a copy that fails is
-        # reported to the master and leaves the in-sync set ----
-        rops: List[dict] = []
-        for op, r in zip(p["ops"], results):
-            if not r.get("ok"):
-                continue
-            if op["op"] == "index":
-                rops.append(
-                    {"op": "index", "id": r["_id"], "source": op["source"],
-                     "version": r["_version"], "seq_no": r["_seq_no"]}
-                )
-            elif r.get("result") == "deleted":
-                rops.append(
-                    {"op": "delete", "id": r["_id"],
-                     "version": r["_version"], "seq_no": r["_seq_no"]}
-                )
-        if rops:
-            for target in idx.replica_targets(sid):
-                try:
-                    # a replica dying mid-replication is indistinguishable
-                    # from a dropped connection: InjectedFault here rides
-                    # the same handling as a real transport failure (the
-                    # copy leaves the in-sync set — never silent divergence)
-                    faults.check("replica.replicate", index=p["index"],
-                                 shard=sid, target=target)
-                    self.remote_call(
-                        target,
-                        ACTION_SHARD_REPLICA_OPS,
-                        {"index": p["index"], "shard": sid, "ops": rops,
-                         # primary-term fencing (ReplicationTracker /
-                         # IndexShard term checks): replicas reject ops
-                         # from a demoted primary that has not yet seen
-                         # the promotion's cluster state
-                         "primary_term": eng.primary_term},
+        # write permit (IndexShardOperationPermits): the relocation
+        # handoff drains these before cutover, so no op can ack on a
+        # source that is about to stop being the primary. Raises a
+        # retryable 503 once the shard has handed off.
+        idx.begin_shard_op(sid)
+        try:
+            results = apply_shard_ops(eng, p["ops"])
+            # ---- replication fan-out (ReplicationOperation.execute): the
+            # primary forwards seqno-stamped ops to every in-sync/tracked
+            # copy and only acks once they respond; a copy that fails is
+            # reported to the master and leaves the in-sync set ----
+            rops: List[dict] = []
+            for op, r in zip(p["ops"], results):
+                if not r.get("ok"):
+                    continue
+                if op["op"] == "index":
+                    rops.append(
+                        {"op": "index", "id": r["_id"], "source": op["source"],
+                         "version": r["_version"], "seq_no": r["_seq_no"]}
                     )
-                except (TransportError, NodeError, ClusterError,
-                        InjectedFault) as e:
-                    if STALE_PRIMARY_MARKER in str(e):
-                        # the REPLICA fenced US as stale: the failure is
-                        # ours, not the (likely promoted) target's —
-                        # reporting it shard-failed would knock the
-                        # healthy new primary out of the in-sync set
-                        continue
-                    # ClusterError covers re-hydrated remote failures
-                    # (e.g. the replica missed the index-creation publish)
-                    self._report_shard_failed(p["index"], sid, target)
+                elif r.get("result") == "deleted":
+                    rops.append(
+                        {"op": "delete", "id": r["_id"],
+                         "version": r["_version"], "seq_no": r["_seq_no"]}
+                    )
+            if rops:
+                for target in idx.replica_targets(sid):
+                    try:
+                        # a replica dying mid-replication is indistinguishable
+                        # from a dropped connection: InjectedFault here rides
+                        # the same handling as a real transport failure (the
+                        # copy leaves the in-sync set — never silent divergence)
+                        faults.check("replica.replicate", index=p["index"],
+                                     shard=sid, target=target)
+                        self.remote_call(
+                            target,
+                            ACTION_SHARD_REPLICA_OPS,
+                            {"index": p["index"], "shard": sid, "ops": rops,
+                             # primary-term fencing (ReplicationTracker /
+                             # IndexShard term checks): replicas reject ops
+                             # from a demoted primary that has not yet seen
+                             # the promotion's cluster state
+                             "primary_term": eng.primary_term},
+                        )
+                    except (TransportError, NodeError, ClusterError,
+                            InjectedFault) as e:
+                        if STALE_PRIMARY_MARKER in str(e):
+                            ent = idx._entry(sid) or {}
+                            if ent.get("relocating") or idx._owner(sid) != self.name:
+                                # mid-relocation (or just relocated) the
+                                # fence means the target was promoted by
+                                # the cutover — acking would lose the op
+                                # on the new primary. Fail retryable: the
+                                # coordinator re-resolves the owner.
+                                raise ClusterError(
+                                    503,
+                                    f"{RELOCATED_MARKER}: shard "
+                                    f"[{p['index']}][{sid}] primary handed "
+                                    "off during relocation; retry",
+                                    "shard_not_in_primary_mode_exception",
+                                )
+                            # the REPLICA fenced US as stale: the failure is
+                            # ours, not the (likely promoted) target's —
+                            # reporting it shard-failed would knock the
+                            # healthy new primary out of the in-sync set
+                            continue
+                        # ClusterError covers re-hydrated remote failures
+                        # (e.g. the replica missed the index-creation publish)
+                        self._report_shard_failed(p["index"], sid, target)
+        finally:
+            idx.end_shard_op(sid)
         # dynamic mapping changes must reach the master (and thus every
         # coordinator + the persisted state) before they are lost to a
         # restart — compare against the published metadata and round-trip
@@ -1721,6 +2194,13 @@ def _remove_node_from_state(state: dict, nid: str) -> None:
         routing = meta.get("routing", {})
         for sid, raw in routing.items():
             entry = norm_shard_routing(raw)
+            rel = entry.get("relocating") or {}
+            if nid in (rel.get("from"), rel.get("to")):
+                # a dead endpoint aborts the relocation; if the TARGET
+                # survives it stays behind as a plain initializing
+                # replica and recovers from whichever primary remains
+                entry.pop("relocating", None)
+                bump_relocation_stat("failed")
             if nid in entry["replicas"]:
                 entry["replicas"].remove(nid)
             if nid in entry["in_sync"]:
@@ -1744,6 +2224,12 @@ def _demote_node_copies(state: dict, nid: str) -> None:
         routing = meta.get("routing", {})
         for sid, raw in routing.items():
             entry = norm_shard_routing(raw)
+            rel = entry.get("relocating") or {}
+            if nid in (rel.get("from"), rel.get("to")):
+                # a restarted endpoint's relocation is void — its copy
+                # lost the in-memory recovery/tracking context
+                entry.pop("relocating", None)
+                bump_relocation_stat("failed")
             if entry["primary"] == nid:
                 promote = [
                     n for n in entry["in_sync"]
@@ -1763,12 +2249,25 @@ def _demote_node_copies(state: dict, nid: str) -> None:
             routing[sid] = entry
 
 
-def _fill_replicas(state: dict) -> None:
+def _fill_replicas(state: dict, settings=None) -> None:
     """Allocates missing replica copies onto nodes that hold no copy of
     the shard (BalancedShardsAllocator, radically simplified: spread by
     current copy count). Newly-assigned replicas are NOT in-sync — the
-    target node peer-recovers and then reports shard-started."""
-    nodes = sorted(state.get("nodes", {}))
+    target node peer-recovers and then reports shard-started.
+
+    With a cluster-settings store, the enable decider and the exclude
+    filter gate this auto-allocation path:
+    `cluster.routing.allocation.enable` of none/primaries skips replica
+    fill entirely, and excluded (draining) nodes never receive copies."""
+    excl: set = set()
+    if settings is not None:
+        from .allocation import ENABLE_SETTING, excluded_nodes
+
+        enable = settings.get(ENABLE_SETTING) or "all"
+        if enable in ("none", "primaries"):
+            return
+        excl = set(excluded_nodes(settings))
+    nodes = sorted(n for n in state.get("nodes", {}) if n not in excl)
     if not nodes:
         return
     # total copies per node, for least-loaded placement

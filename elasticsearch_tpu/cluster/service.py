@@ -1130,7 +1130,68 @@ class ClusterService:
                     )
                 eng.refresh()
 
-    def health(self) -> dict:
+    def health(self, params: Optional[dict] = None) -> dict:
+        """Cluster health with the wait semantics of
+        TransportClusterHealthAction: `wait_for_status` blocks until the
+        status is at least as good, `wait_for_no_relocating_shards`
+        until no relocation is in flight; `timeout` (default 30s) bounds
+        the wait and sets `timed_out` instead of raising."""
+        params = params or {}
+        wait_status = params.get("wait_for_status")
+        wait_reloc = str(
+            params.get("wait_for_no_relocating_shards", "")
+        ).lower() in ("1", "true")
+        snap = self._health_snapshot()
+        if wait_status is None and not wait_reloc:
+            return snap
+        rank = {"green": 0, "yellow": 1, "red": 2}
+        if wait_status is not None and wait_status not in rank:
+            raise ClusterError(
+                400,
+                "request [/_cluster/health] contains unrecognized "
+                f"wait_for_status: [{wait_status}]",
+                "illegal_argument_exception",
+            )
+        from ..search.failures import parse_timeout
+
+        try:
+            timeout = parse_timeout(params.get("timeout", "30s"))
+        except ValueError as e:
+            raise ClusterError(400, str(e), "illegal_argument_exception")
+        if timeout is None:
+            timeout = 30.0
+        deadline = time.monotonic() + timeout
+        while True:
+            ok = True
+            if wait_status is not None and rank[snap["status"]] > rank[wait_status]:
+                ok = False
+            if wait_reloc and snap.get("relocating_shards", 0) > 0:
+                ok = False
+            if ok:
+                return snap
+            if time.monotonic() >= deadline:
+                snap["timed_out"] = True
+                return snap
+            time.sleep(0.05)
+            snap = self._health_snapshot()
+
+    def reroute(self, body: Optional[dict] = None, dry_run: bool = False) -> dict:
+        raise ClusterError(
+            400,
+            "cluster reroute requires a multi-node cluster (single-node "
+            "mode has no routing table to move shards across)",
+            "illegal_argument_exception",
+        )
+
+    def allocation_explain(self, body: Optional[dict] = None) -> dict:
+        raise ClusterError(
+            400,
+            "unable to find any unassigned or relocating shards to "
+            "explain (single-node mode has no routing table)",
+            "illegal_argument_exception",
+        )
+
+    def _health_snapshot(self) -> dict:
         n_primaries = sum(i.num_shards for i in self.indices.values())
         n_replicas = sum(
             i.num_shards * int(i.settings.get("number_of_replicas", 1))

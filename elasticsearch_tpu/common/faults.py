@@ -75,6 +75,21 @@ Schedule shape (env `ES_TPU_FAULTS`, or `POST /_internal/faults`):
     side — ctx index/shard/node)
   - ``recovery.finalize``   (peer-recovery phase 2 ops replay, target
     side — ctx index/shard/node)
+  - ``relocation.start``    (shard relocation kicking off — fires on
+    BOTH endpoints with ctx index/shard/node/role: role=target before
+    the target's peer recovery begins, role=source when the source
+    receives the recovery/start request for its relocation target;
+    error/crash abort the attempt cleanly — the source keeps serving,
+    the recovery retry loop or a fresh reroute re-runs the move)
+  - ``relocation.transfer`` (the bulk transfer leg — role=target after
+    phase 1 returns, role=source inside recovery/finalize when the
+    requester is the relocation target; the same
+    abort-and-retry-cleanly contract as recovery.transfer)
+  - ``relocation.handoff``  (the cutover handoff — role=target before
+    the target asks the source to drain, role=source at the top of the
+    drain handler BEFORE any permit state changes, so an injected
+    error/crash leaves the source still serving writes; tests drive
+    error + crash + delay at every site × both roles)
 * ``match``: exact-equality filters over the ctx kwargs the site passes
   (string-compared, so {"shard": 1} matches shard=1).
 * ``kind``: ``error`` (raise InjectedFault, 500-shaped), ``drop``

@@ -470,7 +470,33 @@ INDEX_SETTINGS: Dict[str, Setting] = {
 CLUSTER_SETTINGS: Dict[str, Setting] = {
     s.key: s
     for s in [
-        Setting("cluster.routing.allocation.enable", "all"),
+        # allocation/rebalance master switch (EnableAllocationDecider):
+        # "all" (default) allows every copy to allocate/relocate,
+        # "primaries" restricts to primary copies, "none" freezes both
+        # replica allocation and rebalancing (explicit reroute `move`
+        # commands are operator intent and bypass only this decider)
+        Setting("cluster.routing.allocation.enable", "all",
+                validator=_one_of("cluster.routing.allocation.enable",
+                                  ("all", "primaries", "none"))),
+        # comma-separated node names to drain (FilterAllocationDecider's
+        # cluster.routing.allocation.exclude._name): no copy may
+        # allocate or rebalance onto an excluded node, and the
+        # background rebalancer actively moves copies off of it
+        Setting("cluster.routing.allocation.exclude._name", ""),
+        # concurrent relocations the rebalancer may keep in flight
+        # (ConcurrentRebalanceAllocationDecider)
+        Setting("cluster.routing.allocation.cluster_concurrent_rebalance",
+                2, parser=int,
+                validator=_positive(
+                    "cluster.routing.allocation.cluster_concurrent_rebalance")),
+        # HBM/disk watermark (DiskThresholdDecider analog reading the
+        # per-node circuit-breaker ledger): a node whose tracked-bytes
+        # utilisation exceeds this fraction of its breaker budget
+        # refuses new shard copies
+        Setting("cluster.routing.allocation.watermark.high", 0.9,
+                parser=float,
+                validator=_positive_f(
+                    "cluster.routing.allocation.watermark.high")),
         Setting("action.auto_create_index", True, parser=_parse_bool),
         Setting("search.default_search_timeout", "-1", parser=_parse_time),
         # request default for allow_partial_search_results: false turns
@@ -576,6 +602,25 @@ class ClusterSettingsStore:
             "persistent": _unflatten(self.persistent),
             "transient": _unflatten(self.transient),
         }
+
+    def load_layers(self, persistent: dict, transient: dict) -> None:
+        """Replaces both layers wholesale (cluster-state application on a
+        follower: the master published the authoritative settings).  Fires
+        consumers only for keys whose effective value actually changed."""
+        with self._lock:
+            keys = (set(self.persistent) | set(self.transient)
+                    | set(persistent) | set(transient))
+            before = {k: self.get(k) for k in keys}
+            self.persistent = dict(persistent)
+            self.transient = dict(transient)
+            fired = []
+            for k in keys:
+                after = self.get(k)
+                if after != before[k]:
+                    fired.append((k, after))
+            for key, value in fired:
+                for fn in self._consumers.get(key, []):
+                    fn(value)
 
 
 def _flatten(node: Any, prefix: str = "") -> Dict[str, Any]:

@@ -237,7 +237,9 @@ class MeshExecutor:
         if mode == "off":
             return False
         svc = self.service
-        if svc.routing is not None:  # shards on other nodes: no stack
+        if svc.routing is not None and not self._all_shards_local():
+            # distributed mode: the stack can only serve when every
+            # shard has a queryable copy on this node
             return False
         if str(svc.settings.get("search.backend")) != "jax":
             return False
@@ -248,6 +250,26 @@ class MeshExecutor:
         except Exception:  # pragma: no cover - no jax backend
             return False
         return n_dev >= 2 and svc.num_shards >= 2
+
+    def _all_shards_local(self) -> bool:
+        """Distributed-mode gate: every shard of the index must have a
+        QUERYABLE copy here — an installed engine whose node is the
+        primary or an in-sync replica. A relocation-driven routing
+        change that adds/removes local engines bumps the `_gens()` key
+        (engine set changes), so the next ensure_snapshot rebuilds
+        incrementally while in-flight launches keep serving off their
+        pinned snapshot reference."""
+        svc = self.service
+        if svc.local_node is None:
+            return True
+        for sid in range(svc.num_shards):
+            if sid not in svc._local:
+                return False
+            e = svc._entry(sid) or {}
+            if (e.get("primary") != svc.local_node
+                    and svc.local_node not in (e.get("in_sync") or [])):
+                return False
+        return True
 
     def _devices(self):
         devs = list(jax.devices())
@@ -267,10 +289,15 @@ class MeshExecutor:
 
     def _gens(self) -> tuple:
         svc = self.service
-        return tuple(
-            (sid, svc.local_shard(sid).change_generation)
-            for sid in range(svc.num_shards)
-        )
+        try:
+            return tuple(
+                (sid, svc.local_shard(sid).change_generation)
+                for sid in range(svc.num_shards)
+            )
+        except KeyError as e:
+            # a shard relocated away between available() and here: the
+            # caller degrades to the per-shard path for this request
+            raise MeshUnavailable(str(e))
 
     def fresh(self) -> bool:
         snap = self._snapshot
@@ -302,7 +329,10 @@ class MeshExecutor:
         executors = {}
         entries = []
         for sid in range(svc.num_shards):
-            shard = svc.local_shard(sid)
+            try:
+                shard = svc.local_shard(sid)
+            except KeyError as e:
+                raise MeshUnavailable(str(e))
             ex = svc._executor(shard)
             from ..search.executor import NumpyExecutor
 

@@ -67,6 +67,9 @@ class RestActions:
         add("GET", "/_cluster/state", self.cluster_state)
         add("GET", "/_cluster/settings", self.get_cluster_settings)
         add("PUT", "/_cluster/settings", self.put_cluster_settings)
+        add("POST", "/_cluster/reroute", self.cluster_reroute)
+        add("GET", "/_cluster/allocation/explain", self.allocation_explain)
+        add("POST", "/_cluster/allocation/explain", self.allocation_explain)
         add("GET", "/_nodes/stats", self.nodes_stats)
         add("GET", "/_stats", self.all_stats)
         add("GET", "/_cat/indices", self.cat_indices)
@@ -199,7 +202,18 @@ class RestActions:
         }
 
     def cluster_health(self, body, params, qs):
-        return 200, self.cluster.health()
+        # qs carries wait_for_status / wait_for_no_relocating_shards /
+        # timeout (TransportClusterHealthAction wait semantics);
+        # parse_qs values are lists — flatten to scalars
+        flat = {k: v[0] for k, v in (qs or {}).items() if v}
+        return 200, self.cluster.health(flat)
+
+    def cluster_reroute(self, body, params, qs):
+        dry_run = (qs or {}).get("dry_run", [""])[0].lower() in ("1", "true")
+        return 200, self.cluster.reroute(body or {}, dry_run=dry_run)
+
+    def allocation_explain(self, body, params, qs):
+        return 200, self.cluster.allocation_explain(body or {})
 
     def cluster_state(self, body, params, qs):
         return 200, {
@@ -787,6 +801,9 @@ class RestActions:
                 "finalize_redelivered": dur["finalize_redelivered"],
             },
         }
+        from ..cluster.allocation import relocation_stats_snapshot
+
+        relocation_block = relocation_stats_snapshot()
         return 200, {
             "cluster_name": self.cluster.cluster_name,
             "nodes": {
@@ -828,6 +845,10 @@ class RestActions:
                     "translog": translog_block,
                     "ingest": ingest_block,
                     "recovery": recovery_block,
+                    # relocation lifecycle counters (cluster/allocation.py):
+                    # started/completed/cancelled/failed moves, transferred
+                    # bytes, handoff drains and their cumulative latency
+                    "relocation": relocation_block,
                     # overload-protection block (search/admission.py):
                     # per-tenant queue depths, the adaptive concurrency
                     # limit, pressure tier, shed/brownout/retry-budget

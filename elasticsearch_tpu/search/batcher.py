@@ -619,6 +619,13 @@ class QueryBatcher:
                 self._queue.put_nowait(None)  # wake blocked workers
             except queue.Full:  # pragma: no cover - submitters raced
                 break
+        # wait the workers out (bounded): a daemon worker still inside
+        # a device dispatch when the interpreter finalizes takes the
+        # process down with a C++ terminate, not a Python exception
+        for t in self._threads:
+            if t is not threading.current_thread():
+                t.join(timeout=5.0)
+        self._threads = []
 
     def _drain_queue(self, err: BaseException):
         while True:

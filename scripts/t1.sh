@@ -33,8 +33,14 @@
 # (scripts/profile_smoke.sh: profile-on vs profile-off bit-identical
 # on every plan family on both backends, profiled coordinator phases
 # >= 90% of took, slowlog fires at threshold 0 / silent at -1, and a
-# no-thread-leak burst — all gates always enforced). The combined exit
-# code fails if any enabled run fails.
+# no-thread-leak burst — all gates always enforced). T1_RELOC=1
+# additionally runs the relocation smoke (scripts/relocation_smoke.sh:
+# seeded 3-node drain + rebalance + source-crash round under a 10%
+# fault schedule over the relocation sites with live write+query
+# traffic; zero acked-loss, green terminal health, checksum
+# convergence, and thread-leak gates always; the query-p99 <= 2x quiet
+# gate on >= 8-core hosts). The combined exit code fails if any
+# enabled run fails.
 set -o pipefail; rm -f /tmp/_t1.log; timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' --continue-on-collection-errors -p no:cacheprovider -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log; rc=${PIPESTATUS[0]}; echo DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' /tmp/_t1.log | tr -cd . | wc -c)
 if [ "${T1_MESH:-0}" = "1" ]; then
     echo "--- T1_MESH: mesh-marked tests on the forced 8-device host platform ---"
@@ -92,5 +98,11 @@ if [ "${T1_PROFILE:-0}" = "1" ]; then
     bash scripts/profile_smoke.sh
     prof_rc=$?
     [ "$rc" -eq 0 ] && rc=$prof_rc
+fi
+if [ "${T1_RELOC:-0}" = "1" ]; then
+    echo "--- T1_RELOC: relocation smoke (drain + rebalance + crash under faults) ---"
+    bash scripts/relocation_smoke.sh
+    reloc_rc=$?
+    [ "$rc" -eq 0 ] && rc=$reloc_rc
 fi
 exit $rc

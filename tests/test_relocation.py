@@ -1,0 +1,703 @@
+"""Live shard relocation & self-healing allocation.
+
+Reference analogs (SURVEY.md §2.6, §5): `POST /_cluster/reroute`
+move/cancel commands (AllocationCommands), allocation deciders
+(EnableAllocationDecider, FilterAllocationDecider,
+SameShardAllocationDecider, DiskThresholdDecider), the relocation
+handoff (IndexShardOperationPermits drain +
+ShardNotInPrimaryModeException retry), BalancedShardsAllocator
+rebalancing, and ClusterAllocationExplain.
+
+The chaos matrix injects error and crash faults at the three
+relocation sites (`relocation.start`, `relocation.transfer`,
+`relocation.handoff`) on both the source and target node and asserts
+the two invariants that matter: no acknowledged write is ever lost,
+and surviving copies converge checksum-identical.
+"""
+
+import threading
+import time
+
+import pytest
+
+from elasticsearch_tpu.cluster.allocation import (
+    relocation_stats_snapshot,
+    reset_relocation_stats,
+)
+from elasticsearch_tpu.cluster.node import TpuNode
+from elasticsearch_tpu.cluster.service import ClusterError
+from elasticsearch_tpu.common.faults import faults
+from elasticsearch_tpu.index.crashpoints import engine_state_checksum
+
+FD = {"fd_interval": 0.1, "fd_retries": 2}
+
+
+def wait_until(cond, timeout=15.0, interval=0.05, msg="condition"):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if cond():
+            return
+        time.sleep(interval)
+    raise AssertionError(f"timed out waiting for {msg}")
+
+
+def make_cluster(n, tmp_path=None, **kw):
+    kw = {**FD, **kw}
+    nodes = [
+        TpuNode(
+            "node-0",
+            data_path=str(tmp_path / "node-0") if tmp_path else None,
+            **kw,
+        ).start()
+    ]
+    for i in range(1, n):
+        nodes.append(
+            TpuNode(
+                f"node-{i}",
+                seeds=[nodes[0].address],
+                data_path=str(tmp_path / f"node-{i}") if tmp_path else None,
+                **kw,
+            ).start()
+        )
+    return nodes
+
+
+@pytest.fixture(autouse=True)
+def _clean_harness():
+    faults.clear()
+    reset_relocation_stats()
+    yield
+    faults.clear()
+    reset_relocation_stats()
+
+
+def routing(node, index, sid=0):
+    return node.state["indices"][index]["routing"][str(sid)]
+
+
+def copies_of(entry):
+    return [entry["primary"]] + list(entry["replicas"])
+
+
+def move_body(index, sid, src, dst):
+    return {"commands": [{"move": {
+        "index": index, "shard": sid, "from_node": src, "to_node": dst,
+    }}]}
+
+
+def shard_checksum(nodes, name, index, sid=0):
+    node = next(n for n in nodes if n.name == name)
+    return engine_state_checksum(node.indices[index].local_shards[sid])
+
+
+def assert_copies_converged(nodes, index, sid=0):
+    entry = routing(nodes[0], index, sid)
+    sums = {c: shard_checksum(nodes, c, index, sid) for c in copies_of(entry)}
+    assert len(set(sums.values())) == 1, f"copies diverged: {sums}"
+
+
+def wait_relocation_done(node, index, sid=0, timeout=30.0):
+    wait_until(
+        lambda: not routing(node, index, sid).get("relocating"),
+        timeout=timeout, msg="relocation marker to clear",
+    )
+    wait_until(
+        lambda: node.cluster.health()["status"] == "green",
+        timeout=timeout, msg="green health after relocation",
+    )
+
+
+def hit_ids(node, index, size=500):
+    node.refresh(index)
+    resp = node.search(index, {"query": {"match_all": {}}, "size": size})
+    return {h["_id"] for h in resp["hits"]["hits"]}
+
+
+class LiveWriter:
+    """Background indexer recording which writes were acknowledged."""
+
+    def __init__(self, node, index, prefix="w"):
+        self.node, self.index, self.prefix = node, index, prefix
+        self.acked = set()
+        self.errors = []
+        self._stop = threading.Event()
+        self._thread = threading.Thread(target=self._run, daemon=True)
+
+    def _run(self):
+        i = 0
+        while not self._stop.is_set():
+            doc_id = f"{self.prefix}{i}"
+            try:
+                r = self.node.index_doc(
+                    self.index, doc_id, {"body": f"live doc {i}", "n": i})
+                if r.get("result") in ("created", "updated"):
+                    self.acked.add(doc_id)
+            except Exception as e:  # unacked — allowed to be lost
+                self.errors.append(str(e))
+            i += 1
+            time.sleep(0.01)
+
+    def __enter__(self):
+        self._thread.start()
+        return self
+
+    def __exit__(self, *exc):
+        self._stop.set()
+        self._thread.join(timeout=5.0)
+
+
+def seed_index(master, index, docs=20, shards=1, replicas=1):
+    master.create_index(index, {"settings": {
+        "number_of_shards": shards, "number_of_replicas": replicas}})
+    for i in range(docs):
+        master.index_doc(index, f"d{i}", {"body": f"doc {i}", "n": i})
+    master.refresh(index)
+    wait_until(lambda: master.cluster.health()["status"] == "green",
+               msg="initial green")
+
+
+class TestRerouteMove:
+    def test_move_replica_to_empty_node(self):
+        nodes = make_cluster(3)
+        a = nodes[0]
+        try:
+            seed_index(a, "mv")
+            entry = routing(a, "mv")
+            src = entry["replicas"][0]
+            dst = next(n.name for n in nodes
+                       if n.name not in copies_of(entry))
+            r = a.cluster.reroute(move_body("mv", 0, src, dst))
+            assert r["acknowledged"] and not r["dry_run"]
+            assert r["explanations"][0]["copy"] == "replica"
+            wait_relocation_done(a, "mv")
+            after = routing(a, "mv")
+            assert src not in copies_of(after)
+            assert dst in after["replicas"] and dst in after["in_sync"]
+            assert after["primary"] == entry["primary"]
+            assert after["primary_term"] == entry["primary_term"]
+            assert_copies_converged(nodes, "mv")
+            stats = relocation_stats_snapshot()
+            assert stats["started"] == stats["completed"] == 1
+        finally:
+            for n in nodes:
+                n.close()
+
+    def test_move_primary_bumps_term_and_retires_source(self):
+        nodes = make_cluster(3)
+        a = nodes[0]
+        try:
+            seed_index(a, "mvp")
+            entry = routing(a, "mvp")
+            src = entry["primary"]
+            dst = next(n.name for n in nodes
+                       if n.name not in copies_of(entry))
+            r = a.cluster.reroute(move_body("mvp", 0, src, dst))
+            assert r["explanations"][0]["copy"] == "primary"
+            wait_relocation_done(a, "mvp")
+            after = routing(a, "mvp")
+            assert after["primary"] == dst
+            assert src not in copies_of(after)
+            assert src not in after["in_sync"]
+            assert after["primary_term"] == entry["primary_term"] + 1
+            # the relocated primary keeps taking writes
+            w = a.index_doc("mvp", "post-move", {"body": "after cutover"})
+            assert w["result"] in ("created", "updated")
+            assert_copies_converged(nodes, "mvp")
+        finally:
+            for n in nodes:
+                n.close()
+
+    def test_dry_run_changes_nothing(self):
+        nodes = make_cluster(3)
+        a = nodes[0]
+        try:
+            seed_index(a, "dry")
+            before = routing(a, "dry")
+            src = before["primary"]
+            dst = next(n.name for n in nodes
+                       if n.name not in copies_of(before))
+            r = a.cluster.reroute(move_body("dry", 0, src, dst),
+                                  dry_run=True)
+            assert r["dry_run"] is True
+            assert r["explanations"][0]["to_node"] == dst
+            time.sleep(0.3)
+            assert routing(a, "dry") == before
+            assert relocation_stats_snapshot()["started"] == 0
+        finally:
+            for n in nodes:
+                n.close()
+
+    def test_move_validation_errors(self):
+        nodes = make_cluster(3)
+        a = nodes[0]
+        try:
+            seed_index(a, "val")
+            entry = routing(a, "val")
+            with pytest.raises(ClusterError, match="unknown target node"):
+                a.cluster.reroute(
+                    move_body("val", 0, entry["primary"], "node-99"))
+            holder = entry["replicas"][0]
+            with pytest.raises(ClusterError, match="already holds a copy"):
+                a.cluster.reroute(
+                    move_body("val", 0, entry["primary"], holder))
+            outsider = next(
+                n.name for n in nodes
+                if n.name not in copies_of(entry))
+            with pytest.raises(ClusterError, match="holds no copy"):
+                a.cluster.reroute(move_body("val", 0, outsider, holder))
+        finally:
+            for n in nodes:
+                n.close()
+
+    def test_cancel_mid_transfer(self):
+        nodes = make_cluster(3)
+        a = nodes[0]
+        try:
+            seed_index(a, "cx", docs=30)
+            entry = routing(a, "cx")
+            src = entry["primary"]
+            dst = next(n.name for n in nodes
+                       if n.name not in copies_of(entry))
+            # hold the transfer open long enough to race the cancel
+            faults.configure({"seed": 11, "rules": [
+                {"site": "relocation.transfer", "kind": "delay",
+                 "delay_ms": 3000, "times": 1, "match": {"role": "target"}},
+            ]})
+            a.cluster.reroute(move_body("cx", 0, src, dst))
+            wait_until(lambda: routing(a, "cx").get("relocating"),
+                       msg="relocation marker to appear")
+            r = a.cluster.reroute({"commands": [{"cancel": {
+                "index": "cx", "shard": 0}}]})
+            assert r["explanations"][0]["cancelled"]["to"] == dst
+            after = routing(a, "cx")
+            assert not after.get("relocating")
+            assert dst not in after["replicas"]
+            assert dst not in after["in_sync"]
+            assert after["primary"] == src
+            faults.clear()
+            # the late shard-started report from the cancelled target
+            # must not resurrect it
+            time.sleep(0.5)
+            final = routing(a, "cx")
+            assert dst not in copies_of(final)
+            wait_until(lambda: a.cluster.health()["status"] == "green",
+                       msg="green after cancel")
+            assert a.count("cx")["count"] == 30
+            assert relocation_stats_snapshot()["cancelled"] == 1
+        finally:
+            faults.clear()
+            for n in nodes:
+                n.close()
+
+
+SITES = ["relocation.start", "relocation.transfer", "relocation.handoff"]
+
+
+class TestChaosMatrix:
+    """Faults at every relocation site, on both endpoints.
+
+    ``error`` faults must be absorbed: recovery retries and the
+    relocation still completes. ``crash`` faults kill the faulted
+    thread (the SimulatedCrash BaseException), after which the test
+    kills the whole node — the cluster must clean up the relocation
+    and converge on the survivors with zero acked-write loss.
+    """
+
+    @pytest.mark.parametrize("site", SITES)
+    @pytest.mark.parametrize("role", ["source", "target"])
+    def test_error_fault_retried_to_completion(self, site, role):
+        nodes = make_cluster(3)
+        a = nodes[0]
+        try:
+            seed_index(a, "chaos", docs=25)
+            entry = routing(a, "chaos")
+            src = entry["primary"]
+            dst = next(n.name for n in nodes
+                       if n.name not in copies_of(entry))
+            faults.configure({"seed": 7, "rules": [
+                {"site": site, "kind": "error", "times": 1,
+                 "match": {"role": role}},
+            ]})
+            with LiveWriter(a, "chaos") as writer:
+                a.cluster.reroute(move_body("chaos", 0, src, dst))
+                wait_relocation_done(a, "chaos")
+            faults.clear()
+            wait_until(lambda: a.cluster.health()["status"] == "green",
+                       msg="green after fault retry")
+            after = routing(a, "chaos")
+            assert after["primary"] == dst
+            assert src not in copies_of(after)
+            ids = hit_ids(a, "chaos")
+            missing = writer.acked - ids
+            assert not missing, f"acked writes lost: {sorted(missing)}"
+            assert_copies_converged(nodes, "chaos")
+            assert relocation_stats_snapshot()["completed"] >= 1
+        finally:
+            faults.clear()
+            for n in nodes:
+                n.close()
+
+    # the SimulatedCrash deliberately kills recovery/handler threads;
+    # pytest reports those as unhandled thread exceptions
+    @pytest.mark.filterwarnings(
+        "ignore::pytest.PytestUnhandledThreadExceptionWarning")
+    @pytest.mark.parametrize("site", SITES)
+    @pytest.mark.parametrize("role", ["source", "target"])
+    def test_crash_fault_node_death_heals(self, site, role):
+        nodes = make_cluster(3)
+        a = nodes[0]
+        try:
+            seed_index(a, "boom", docs=25)
+            entry = routing(a, "boom")
+            src = entry["primary"]
+            dst = next(n.name for n in nodes
+                       if n.name not in copies_of(entry))
+            victim_name = src if role == "source" else dst
+            victim = next(n for n in nodes if n.name == victim_name)
+            survivors = [n for n in nodes if n.name != victim_name]
+            coordinator = survivors[0]
+            faults.configure({"seed": 13, "rules": [
+                {"site": site, "kind": "crash", "times": 1,
+                 "match": {"role": role}},
+            ]})
+            with LiveWriter(coordinator, "boom") as writer:
+                a.cluster.reroute(move_body("boom", 0, src, dst))
+                wait_until(
+                    lambda: faults.describe()["rules"][0]["trips"] >= 1,
+                    timeout=20.0, msg=f"crash fault at {site}/{role}")
+                victim.crash()
+                faults.clear()
+                wait_until(
+                    lambda: victim_name not in
+                    coordinator.state["nodes"],
+                    timeout=30.0, msg="victim removed from cluster state")
+                wait_until(
+                    lambda: (coordinator.cluster.health()["status"]
+                             == "green"
+                             and not routing(coordinator, "boom")
+                             .get("relocating")),
+                    timeout=30.0, msg="green convergence after crash")
+            after = routing(coordinator, "boom")
+            assert victim_name not in copies_of(after)
+            ids = hit_ids(coordinator, "boom")
+            missing = writer.acked - ids
+            assert not missing, f"acked writes lost: {sorted(missing)}"
+            assert_copies_converged(survivors, "boom")
+        finally:
+            faults.clear()
+            for n in nodes:
+                n.close()
+
+
+class TestDrainAndRebalance:
+    def test_drain_node_to_empty(self):
+        nodes = make_cluster(3)
+        a = nodes[0]
+        try:
+            seed_index(a, "drain", docs=15, shards=2, replicas=1)
+            target = "node-2"
+            a.cluster.update_cluster_settings({"transient": {
+                "cluster.routing.allocation.exclude._name": target,
+            }})
+
+            def held_by_target():
+                return sum(
+                    1 for e in routing_all(a, "drain")
+                    if target in copies_of(e))
+
+            def drained():
+                for _ in range(3):
+                    a.rebalance_tick()
+                h = a.cluster.health()
+                return (held_by_target() == 0
+                        and h["relocating_shards"] == 0
+                        and h["status"] == "green")
+
+            def routing_all(node, index):
+                return list(
+                    node.state["indices"][index]["routing"].values())
+
+            wait_until(drained, timeout=60.0, interval=0.2,
+                       msg="excluded node to drain to empty")
+            # data still fully present and queryable after the drain
+            assert a.count("drain")["count"] == 15
+            assert_copies_converged(
+                [n for n in nodes if n.name != target], "drain")
+            assert_copies_converged(
+                [n for n in nodes if n.name != target], "drain", sid=1)
+        finally:
+            for n in nodes:
+                n.close()
+
+    def test_rebalance_converges_skewed_layout(self):
+        nodes = make_cluster(2)
+        a = nodes[0]
+        c = None
+        try:
+            seed_index(a, "bal", docs=12, shards=4, replicas=0)
+            c = TpuNode("node-2", seeds=[a.address], **FD).start()
+            wait_until(lambda: "node-2" in a.state["nodes"],
+                       msg="third node to join")
+
+            def counts():
+                per = {n: 0 for n in a.state["nodes"]}
+                for e in a.state["indices"]["bal"]["routing"].values():
+                    for copy in copies_of(e):
+                        per[copy] += 1
+                return per
+
+            def balanced():
+                for _ in range(3):
+                    a.rebalance_tick()
+                h = a.cluster.health()
+                per = counts()
+                return (max(per.values()) - min(per.values()) <= 1
+                        and h["relocating_shards"] == 0
+                        and h["status"] == "green")
+
+            wait_until(balanced, timeout=60.0, interval=0.2,
+                       msg="rebalance to even the shard spread")
+            assert a.count("bal")["count"] == 12
+        finally:
+            if c is not None:
+                c.close()
+            for n in nodes:
+                n.close()
+
+    def test_background_rebalancer_thread(self):
+        nodes = make_cluster(2, rebalance_interval=0.2)
+        a = nodes[0]
+        c = None
+        try:
+            seed_index(a, "auto", docs=8, shards=4, replicas=0)
+            c = TpuNode("node-2", seeds=[a.address],
+                        rebalance_interval=0.2, **FD).start()
+
+            def spread():
+                per = {n: 0 for n in a.state["nodes"]}
+                for e in a.state["indices"]["auto"]["routing"].values():
+                    for copy in copies_of(e):
+                        per[copy] += 1
+                return max(per.values()) - min(per.values())
+
+            wait_until(
+                lambda: spread() <= 1
+                and a.cluster.health()["status"] == "green",
+                timeout=60.0, interval=0.2,
+                msg="background rebalancer to converge unaided")
+        finally:
+            if c is not None:
+                c.close()
+            for n in nodes:
+                n.close()
+
+
+class TestAllocationEnableSetting:
+    def test_invalid_value_rejected(self):
+        nodes = make_cluster(2)
+        a = nodes[0]
+        try:
+            with pytest.raises(ClusterError):
+                a.cluster.update_cluster_settings({"transient": {
+                    "cluster.routing.allocation.enable": "sometimes",
+                }})
+        finally:
+            for n in nodes:
+                n.close()
+
+    def test_none_freezes_rebalancer_but_not_explicit_reroute(self):
+        nodes = make_cluster(3)
+        a = nodes[0]
+        try:
+            seed_index(a, "frz", docs=6, shards=4, replicas=0)
+            a.cluster.update_cluster_settings({"transient": {
+                "cluster.routing.allocation.enable": "none",
+            }})
+            assert a.rebalance_tick() == []
+            # an explicit operator command bypasses the enable decider
+            entry = routing(a, "frz")
+            src = entry["primary"]
+            dst = next(n.name for n in nodes
+                       if n.name not in copies_of(entry))
+            r = a.cluster.reroute(move_body("frz", 0, src, dst))
+            assert r["acknowledged"]
+            wait_relocation_done(a, "frz")
+            # flipping back re-enables the rebalancer
+            a.cluster.update_cluster_settings({"transient": {
+                "cluster.routing.allocation.enable": "all",
+            }})
+            a.rebalance_tick()  # unfrozen: runs the planner again
+        finally:
+            for n in nodes:
+                n.close()
+
+    def test_setting_propagates_to_all_nodes(self):
+        nodes = make_cluster(3)
+        a, b, c = nodes
+        try:
+            a.cluster.update_cluster_settings({"persistent": {
+                "cluster.routing.allocation.enable": "primaries",
+            }})
+            key = "cluster.routing.allocation.enable"
+            wait_until(
+                lambda: all(
+                    n.cluster.cluster_settings.get(key) == "primaries"
+                    for n in nodes),
+                msg="setting to propagate through cluster state")
+        finally:
+            for n in nodes:
+                n.close()
+
+
+class TestAllocationExplain:
+    def test_explain_shape_and_decider_verdicts(self):
+        nodes = make_cluster(3)
+        a = nodes[0]
+        try:
+            seed_index(a, "exp")
+            a.cluster.update_cluster_settings({"transient": {
+                "cluster.routing.allocation.exclude._name": "node-2",
+            }})
+            r = a.cluster.allocation_explain({"index": "exp", "shard": 0})
+            assert r["index"] == "exp" and r["shard"] == 0
+            assert r["current_state"] == "started"
+            decisions = {d["node_name"]: d for d in
+                         r["node_allocation_decisions"]}
+            excluded = decisions["node-2"]
+            assert excluded["node_decision"] == "no"
+            assert any(
+                dec["decider"] == "filter" and dec["decision"] == "NO"
+                for dec in excluded["deciders"])
+            for d in decisions.values():
+                assert {"node_name", "node_decision", "deciders"} <= set(d)
+        finally:
+            for n in nodes:
+                n.close()
+
+    def test_explain_missing_index_404(self):
+        nodes = make_cluster(2)
+        a = nodes[0]
+        try:
+            with pytest.raises(ClusterError):
+                a.cluster.allocation_explain({"index": "nope", "shard": 0})
+        finally:
+            for n in nodes:
+                n.close()
+
+
+class TestHealthWaitParams:
+    def test_wait_for_no_relocating_shards_times_out_then_succeeds(self):
+        nodes = make_cluster(3)
+        a = nodes[0]
+        try:
+            seed_index(a, "hw", docs=10)
+            entry = routing(a, "hw")
+            src = entry["primary"]
+            dst = next(n.name for n in nodes
+                       if n.name not in copies_of(entry))
+            faults.configure({"seed": 21, "rules": [
+                {"site": "relocation.transfer", "kind": "delay",
+                 "delay_ms": 2500, "times": 1,
+                 "match": {"role": "target"}},
+            ]})
+            a.cluster.reroute(move_body("hw", 0, src, dst))
+            wait_until(lambda: routing(a, "hw").get("relocating"),
+                       msg="relocation to be in flight")
+            h = a.cluster.health({
+                "wait_for_no_relocating_shards": "true",
+                "timeout": "200ms",
+            })
+            assert h["timed_out"] is True
+            assert h["relocating_shards"] >= 1
+            h2 = a.cluster.health({
+                "wait_for_no_relocating_shards": "true",
+                "timeout": "30s",
+            })
+            assert h2["timed_out"] is False
+            assert h2["relocating_shards"] == 0
+        finally:
+            faults.clear()
+            for n in nodes:
+                n.close()
+
+    def test_wait_for_status_and_invalid_param(self):
+        nodes = make_cluster(2)
+        a = nodes[0]
+        try:
+            seed_index(a, "hs", docs=4)
+            h = a.cluster.health({"wait_for_status": "green",
+                                  "timeout": "10s"})
+            assert h["status"] == "green" and h["timed_out"] is False
+            with pytest.raises(ClusterError):
+                a.cluster.health({"wait_for_status": "chartreuse"})
+            with pytest.raises(ClusterError):
+                a.cluster.health({"wait_for_status": "green",
+                                  "timeout": "bogus"})
+        finally:
+            for n in nodes:
+                n.close()
+
+
+class TestRelocatingCopyQueryParity:
+    def test_search_results_float_exact_during_relocation(self):
+        nodes = make_cluster(3)
+        a = nodes[0]
+        try:
+            seed_index(a, "par", docs=40)
+            body = {"query": {"match": {"body": "doc"}}, "size": 50}
+            baseline = a.search("par", body)["hits"]
+            entry = routing(a, "par")
+            src = entry["primary"]
+            dst = next(n.name for n in nodes
+                       if n.name not in copies_of(entry))
+            faults.configure({"seed": 31, "rules": [
+                {"site": "relocation.transfer", "kind": "delay",
+                 "delay_ms": 2000, "times": 1,
+                 "match": {"role": "target"}},
+            ]})
+            a.cluster.reroute(move_body("par", 0, src, dst))
+            wait_until(lambda: routing(a, "par").get("relocating"),
+                       msg="relocation to be in flight")
+            # every query against the relocating copy must be
+            # byte-identical to the quiet baseline — same hits, same
+            # float scores, no serving gap
+            for _ in range(10):
+                during = a.search("par", body)["hits"]
+                assert during["total"] == baseline["total"]
+                assert ([(h["_id"], h["_score"]) for h in during["hits"]]
+                        == [(h["_id"], h["_score"])
+                            for h in baseline["hits"]])
+                time.sleep(0.05)
+            faults.clear()
+            wait_relocation_done(a, "par")
+            after = a.search("par", body)["hits"]
+            assert ([(h["_id"], h["_score"]) for h in after["hits"]]
+                    == [(h["_id"], h["_score"])
+                        for h in baseline["hits"]])
+        finally:
+            faults.clear()
+            for n in nodes:
+                n.close()
+
+
+class TestRelocationStats:
+    def test_nodes_stats_relocation_block(self):
+        nodes = make_cluster(3)
+        a = nodes[0]
+        try:
+            seed_index(a, "st", docs=8)
+            entry = routing(a, "st")
+            src = entry["primary"]
+            dst = next(n.name for n in nodes
+                       if n.name not in copies_of(entry))
+            a.cluster.reroute(move_body("st", 0, src, dst))
+            wait_relocation_done(a, "st")
+            stats = relocation_stats_snapshot()
+            assert stats["started"] == 1
+            assert stats["completed"] == 1
+            assert stats["failed"] == 0
+            assert stats["handoffs"] == 1
+            assert stats["handoff_time_in_millis"] >= 0
+        finally:
+            for n in nodes:
+                n.close()
